@@ -1,0 +1,38 @@
+"""
+skdist_tpu: TPU-native distributed scikit-learn meta-estimators.
+
+A ground-up re-design of the capabilities of Ibotta/sk-dist
+(reference: /root/reference/skdist/__init__.py:4-13) for TPU hardware.
+
+Where sk-dist fans embarrassingly-parallel model fits out over a PySpark
+cluster (``sc.parallelize(...).map(fit).collect()``), skdist_tpu batches
+them into single XLA programs: many fits of the same shape become one
+``vmap``-ed, ``jit``-compiled kernel whose task axis is sharded over a
+``jax.sharding.Mesh`` of TPU devices. Training data lives HBM-resident
+and replicated; per-task hyperparameters and fold masks ride the mapped
+axis; scores gather over ICI collectives instead of a Spark ``collect()``.
+
+Every distributed estimator also runs without any accelerator: passing
+``backend=None`` (the analogue of sk-dist's ``sc=None``) selects a local
+thread/serial execution path with identical semantics, so the full test
+suite runs on CPU.
+
+Public surface (mirrors sk-dist's component inventory):
+
+- ``skdist_tpu.distribute.search``: ``DistGridSearchCV``,
+  ``DistRandomizedSearchCV``, ``DistMultiModelSearch``
+- ``skdist_tpu.distribute.multiclass``: ``DistOneVsRestClassifier``,
+  ``DistOneVsOneClassifier``
+- ``skdist_tpu.distribute.ensemble``: ``DistRandomForestClassifier/Regressor``,
+  ``DistExtraTreesClassifier/Regressor``, ``DistRandomTreesEmbedding``
+- ``skdist_tpu.distribute.eliminate``: ``DistFeatureEliminator``
+- ``skdist_tpu.distribute.encoder``: ``Encoderizer``, ``EncoderizerExtractor``
+- ``skdist_tpu.distribute.predict``: batched large-scale inference
+- ``skdist_tpu.models``: JAX/XLA estimator kernels (logistic regression,
+  linear SVC, SGD, ridge, decision trees and forests) replacing the
+  sklearn Cython / liblinear compute the reference leaned on
+- ``skdist_tpu.preprocessing`` / ``skdist_tpu.postprocessing``: pipeline
+  transformers and ``SimpleVoter``
+"""
+
+__version__ = "0.1.0"

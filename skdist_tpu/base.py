@@ -1,0 +1,204 @@
+"""
+Estimator base protocol and backend-aware cloning.
+
+Plays the role of the reference's distribution primitives
+(``/root/reference/skdist/distribute/base.py:8-72``): an sc-aware
+``_clone`` that skips copying the cluster handle and reattaches it, a
+partition-count policy, and broadcast unwrapping. Here the "cluster
+handle" is a :class:`skdist_tpu.parallel.backend.TaskBackend` (or a
+``jax.sharding.Mesh``), which must never be deep-copied or pickled into
+a fitted artifact.
+"""
+
+import copy
+import inspect
+from collections import defaultdict
+
+import numpy as np
+
+# Constructor attribute names that hold live runtime handles. They are
+# excluded from deep-copy during clone and stripped after fit so fitted
+# estimators stay picklable (reference strips `sc`: search.py:568-570).
+_RUNTIME_ATTRS = ("backend", "sc", "mesh")
+
+
+def _jax_leaves(obj):
+    import jax
+
+    return [x for x in jax.tree_util.tree_leaves(obj) if hasattr(x, "dtype")]
+
+
+class BaseEstimator:
+    """sklearn-protocol base: introspective ``get_params``/``set_params``.
+
+    Implemented from the protocol (not vendored from sklearn) so our
+    estimators compose with sklearn pipelines, ``sklearn.base.clone``,
+    and each other. Parameters are the constructor arguments, like
+    sklearn; fitted state is attributes with trailing underscores.
+    """
+
+    @classmethod
+    def _get_param_names(cls):
+        init = cls.__init__
+        if init is object.__init__:
+            return []
+        sig = inspect.signature(init)
+        names = [
+            p.name
+            for p in sig.parameters.values()
+            if p.name != "self" and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+        ]
+        return sorted(names)
+
+    def get_params(self, deep=True):
+        out = {}
+        for key in self._get_param_names():
+            value = getattr(self, key, None)
+            if deep and hasattr(value, "get_params") and not isinstance(value, type):
+                for sub_key, sub_value in value.get_params(deep=True).items():
+                    out[f"{key}__{sub_key}"] = sub_value
+            out[key] = value
+        return out
+
+    def set_params(self, **params):
+        if not params:
+            return self
+        valid = set(self._get_param_names())
+        nested = defaultdict(dict)
+        for key, value in params.items():
+            key, delim, sub_key = key.partition("__")
+            if key not in valid:
+                raise ValueError(
+                    f"Invalid parameter {key!r} for estimator {self!r}. "
+                    f"Valid parameters are: {sorted(valid)}."
+                )
+            if delim:
+                nested[key][sub_key] = value
+            else:
+                setattr(self, key, value)
+        for key, sub_params in nested.items():
+            getattr(self, key).set_params(**sub_params)
+        return self
+
+    def __repr__(self):
+        params = ", ".join(
+            f"{k}={getattr(self, k, None)!r}"
+            for k in self._get_param_names()
+            if not isinstance(getattr(self, k, None), np.ndarray)
+        )
+        return f"{type(self).__name__}({params})"
+
+    # -- sklearn duck-typing helpers ------------------------------------
+    def _more_tags(self):
+        return {}
+
+    def __sklearn_tags__(self):  # pragma: no cover - sklearn >=1.6 interop
+        from sklearn.utils import Tags, InputTags, TargetTags
+
+        est_type = getattr(self, "_estimator_type", None)
+        tags = Tags(
+            estimator_type=est_type,
+            target_tags=TargetTags(required=est_type in ("classifier", "regressor")),
+            input_tags=InputTags(sparse=True, allow_nan=False),
+        )
+        if est_type == "classifier":
+            from sklearn.utils import ClassifierTags
+
+            tags.classifier_tags = ClassifierTags()
+        elif est_type == "regressor":
+            from sklearn.utils import RegressorTags
+
+            tags.regressor_tags = RegressorTags()
+        return tags
+
+
+class ClassifierMixin:
+    _estimator_type = "classifier"
+
+    def score(self, X, y, sample_weight=None):
+        from sklearn.metrics import accuracy_score
+
+        return accuracy_score(y, self.predict(X), sample_weight=sample_weight)
+
+
+class RegressorMixin:
+    _estimator_type = "regressor"
+
+    def score(self, X, y, sample_weight=None):
+        from sklearn.metrics import r2_score
+
+        return r2_score(y, self.predict(X), sample_weight=sample_weight)
+
+
+class TransformerMixin:
+    def fit_transform(self, X, y=None, **fit_params):
+        return self.fit(X, y, **fit_params).transform(X)
+
+
+def clone(estimator, safe=True):
+    """Backend-aware clone (reference ``_clone``, base.py:8-50).
+
+    Returns an unfitted copy with the same parameters. Runtime handles
+    (``backend``/``sc``/``mesh`` constructor params) are carried over by
+    *reference*, never deep-copied — a backend may hold live device
+    buffers, thread pools, or a ``Mesh``.
+    """
+    if estimator is None:
+        return None
+    if isinstance(estimator, (list, tuple)):
+        return type(estimator)(clone(e, safe=safe) for e in estimator)
+    if not hasattr(estimator, "get_params"):
+        if not safe:
+            return copy.deepcopy(estimator)
+        raise TypeError(
+            f"Cannot clone object {estimator!r}: it does not implement get_params."
+        )
+    params = estimator.get_params(deep=False)
+    handles = {}
+    for name in _RUNTIME_ATTRS:
+        if name in params:
+            handles[name] = params.pop(name)
+    new_params = {}
+    for name, value in params.items():
+        if hasattr(value, "get_params") and not isinstance(value, type):
+            new_params[name] = clone(value, safe=safe)
+        else:
+            new_params[name] = copy.deepcopy(value)
+    new_params.update(handles)
+    new_object = type(estimator)(**new_params)
+    # post-clone identity check, as the reference does (base.py:38-46)
+    check_params = new_object.get_params(deep=False)
+    for name in params:
+        if check_params[name] is not new_params[name] and not isinstance(
+            new_params[name], (int, float, str, bool, type(None))
+        ):
+            raise RuntimeError(
+                f"Cannot clone {estimator!r}: constructor does not set "
+                f"parameter {name!r} verbatim."
+            )
+    return new_object
+
+
+def strip_runtime(estimator):
+    """Remove live runtime handles post-fit so the artifact pickles clean.
+
+    The analogue of the reference's ``del self.sc`` at the end of every
+    fit (search.py:568-570, multiclass.py:283-285, ensemble.py:335).
+    Recurses into nested estimators.
+    """
+    if estimator is None or not hasattr(estimator, "get_params"):
+        return estimator
+    for name in _RUNTIME_ATTRS:
+        if hasattr(estimator, name) and getattr(estimator, name) is not None:
+            try:
+                setattr(estimator, name, None)
+            except AttributeError:
+                pass
+    for value in vars(estimator).values():
+        if hasattr(value, "get_params"):
+            strip_runtime(value)
+        elif isinstance(value, (list, tuple)):
+            for item in value:
+                if hasattr(item, "get_params"):
+                    strip_runtime(item)
+    return estimator

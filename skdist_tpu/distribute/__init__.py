@@ -1,0 +1,8 @@
+"""
+Distributed meta-estimators — the core product surface, mirroring the
+reference's ``skdist/distribute/__init__.py``.
+"""
+
+# extended as subsystems land (multiclass, ensemble, eliminate,
+# encoder, predict follow the reference inventory)
+__all__ = ["search"]

@@ -1,0 +1,593 @@
+"""
+Distributed hyperparameter search: ``DistGridSearchCV``,
+``DistRandomizedSearchCV`` (and, in a later milestone,
+``DistMultiModelSearch``).
+
+Re-design of the reference flagship (``/root/reference/skdist/distribute/
+search.py:291-714``). The reference enumerates ``fit_sets =
+product(candidate_params, cv_splits)`` and ships each ``_fit_and_score``
+closure to a Spark executor (search.py:378-437). Here the same task set
+takes one of two execution paths:
+
+- **batched device path** (JAX estimators, device-supported scorers):
+  candidates are bucketed by compile-shaping params, numeric
+  hyperparameters are stacked onto a task axis together with a fold id,
+  and the whole bucket runs as ONE vmapped, jit-compiled XLA program
+  whose task axis shards across the TPU mesh. CV folds are 0/1 weight
+  masks (static shapes); scores come back as a single gathered array.
+  This is the "many fits = one program" win Spark cannot express.
+
+- **generic host path** (any sklearn-compatible estimator): the same
+  task list fans out over backend threads, preserving sk-dist's ability
+  to wrap arbitrary estimators; semantics match sklearn exactly.
+
+``cv_results_`` reproduces sklearn's schema: ``split{i}_test_*``,
+``mean/std/rank_test_*`` (rank via min-method rankdata, reference
+search.py:481-484), masked param arrays, fit/score times. The best
+candidate is refit on the driver (search.py:543-550) and all runtime
+handles are stripped post-fit so the artifact pickles clean
+(search.py:568-570).
+"""
+
+import time
+import warnings
+from itertools import product
+
+import numpy as np
+from numpy.ma import MaskedArray
+from scipy.stats import rankdata
+
+from ..base import BaseEstimator, clone, strip_runtime
+from ..metrics import (
+    DEVICE_SCORERS,
+    aggregate_score_dicts,
+    check_multimetric_scoring,
+    default_device_scorer,
+)
+from ..parallel import parse_partitions, resolve_backend
+from ..utils.validation import (
+    check_estimator_backend,
+    check_is_fitted,
+    check_n_iter,
+    safe_split,
+)
+
+__all__ = ["DistBaseSearchCV", "DistGridSearchCV", "DistRandomizedSearchCV"]
+
+
+# ---------------------------------------------------------------------------
+# generic per-task closure (host path) — reference _fit_and_score
+# (search.py:180-288)
+# ---------------------------------------------------------------------------
+
+def _fit_and_score(estimator, X, y, scorers, train, test, parameters,
+                   fit_params=None, error_score=np.nan,
+                   return_train_score=False):
+    est = clone(estimator)
+    if parameters:
+        est.set_params(**parameters)
+    X_train, y_train = safe_split(est, X, y, train)
+    X_test, y_test = safe_split(est, X, y, test, train)
+    fit_params = fit_params or {}
+    start = time.perf_counter()
+    result = {}
+    try:
+        if y_train is None:
+            est.fit(X_train, **fit_params)
+        else:
+            est.fit(X_train, y_train, **fit_params)
+        fit_time = time.perf_counter() - start
+        score_start = time.perf_counter()
+        for name, scorer in scorers.items():
+            result[f"test_{name}"] = scorer(est, X_test, y_test)
+        score_time = time.perf_counter() - score_start
+        if return_train_score:
+            for name, scorer in scorers.items():
+                result[f"train_{name}"] = scorer(est, X_train, y_train)
+    except Exception:
+        # reference error_score policy (search.py:232-259): 'raise' or a
+        # numeric substitute recorded with a warning
+        fit_time = time.perf_counter() - start
+        score_time = 0.0
+        if error_score == "raise":
+            raise
+        if not isinstance(error_score, (int, float)):
+            raise ValueError(
+                "error_score must be 'raise' or numeric"
+            ) from None
+        warnings.warn(
+            f"Estimator fit failed; score set to {error_score}.",
+            FitFailedWarning,
+        )
+        for name in scorers:
+            result[f"test_{name}"] = float(error_score)
+            if return_train_score:
+                result[f"train_{name}"] = float(error_score)
+    result["fit_time"] = fit_time
+    result["score_time"] = score_time
+    return result
+
+
+class FitFailedWarning(RuntimeWarning):
+    """Raised-as-warning marker for failed per-task fits (the reference
+    referenced sklearn's FitFailedWarning without importing it —
+    search.py:248-253 — a dead path we make real)."""
+
+
+# ---------------------------------------------------------------------------
+# batched device path helpers
+# ---------------------------------------------------------------------------
+
+def _candidate_buckets(estimator, candidate_params):
+    """Group candidate indices by compile-shaping ("static") params.
+
+    Returns None if any candidate touches a param that is neither a
+    batchable hyper nor a declared static — those need the generic path.
+    """
+    from ..models.linear import _freeze
+
+    hyper_names = set(getattr(type(estimator), "_hyper_names", ()))
+    static_names = set(getattr(type(estimator), "_static_names", ()))
+    buckets = {}
+    for idx, cand in enumerate(candidate_params):
+        for name in cand:
+            if name not in hyper_names and name not in static_names:
+                return None
+        overrides = {k: v for k, v in cand.items() if k in static_names}
+        key = _freeze(overrides)
+        buckets.setdefault(key, (overrides, []))[1].append(idx)
+    return buckets
+
+
+def _resolve_device_scoring(estimator, scoring):
+    """Map the user ``scoring`` arg to device scorer specs, or None if
+    any requested metric has no device kernel."""
+    if scoring is None:
+        names = [("score", default_device_scorer(estimator))]
+    elif isinstance(scoring, str):
+        names = [("score", scoring)]
+    elif isinstance(scoring, (list, tuple, set)):
+        names = [(s, s) for s in scoring]
+    else:
+        return None  # dict-of-callables etc: host path
+    specs = []
+    for out_name, metric in names:
+        if metric not in DEVICE_SCORERS:
+            return None
+        kernel, kind = DEVICE_SCORERS[metric]
+        specs.append((out_name, kernel, kind))
+    return specs
+
+
+_CV_KERNEL_CACHE = {}
+
+
+def _cached_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
+    """Cache cv kernels on their semantic key so repeated searches reuse
+    both the closure and (via the backend's jit cache) the compiled XLA
+    program."""
+    from ..models.linear import _meta_signature
+
+    sig = (
+        est_cls,
+        static,
+        tuple((name, fn, kind) for name, fn, kind in scorer_specs),
+        return_train_score,
+        _meta_signature(meta),
+    )
+    fn = _CV_KERNEL_CACHE.get(sig)
+    if fn is None:
+        fn = _build_cv_kernel(est_cls, meta, static, scorer_specs,
+                              return_train_score)
+        _CV_KERNEL_CACHE[sig] = fn
+    return fn
+
+
+def _build_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
+    """One (fold-masked fit + scores) program; vmapped by the backend."""
+    fit_kernel = est_cls._build_fit_kernel(meta, static)
+    decision_kernel = est_cls._build_decision_kernel(meta, static)
+    needs_proba = any(kind == "proba" for _, _, kind in scorer_specs)
+    proba_kernel = (
+        est_cls._build_proba_kernel(meta, static) if needs_proba else None
+    )
+
+    def kernel(shared, task):
+        X, y, sw = shared["X"], shared["y"], shared["sw"]
+        train_w = sw * shared["train_masks"][task["split"]]
+        test_w = sw * shared["test_masks"][task["split"]]
+        params = fit_kernel(X, y, train_w, task["hyper"])
+        outputs = {"decision": decision_kernel(params, X)}
+        outputs["predict"] = outputs["decision"]
+        if proba_kernel is not None:
+            outputs["proba"] = proba_kernel(params, X)
+        scores = {}
+        for out_name, score_kernel, kind in scorer_specs:
+            scores[f"test_{out_name}"] = score_kernel(y, outputs[kind], test_w, meta)
+            if return_train_score:
+                scores[f"train_{out_name}"] = score_kernel(
+                    y, outputs[kind], train_w, meta
+                )
+        return scores
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# the meta-estimator
+# ---------------------------------------------------------------------------
+
+class DistBaseSearchCV(BaseEstimator):
+    """Base class for distributed CV search (reference search.py:291-581)."""
+
+    def __init__(self, estimator, backend=None, partitions="auto", cv=5,
+                 scoring=None, refit=True, return_train_score=False,
+                 error_score=np.nan, n_jobs=None, preds=False, verbose=0):
+        self.estimator = estimator
+        self.backend = backend
+        self.partitions = partitions
+        self.cv = cv
+        self.scoring = scoring
+        self.refit = refit
+        self.return_train_score = return_train_score
+        self.error_score = error_score
+        self.n_jobs = n_jobs
+        self.preds = preds
+        self.verbose = verbose
+
+    # subclasses supply the candidate enumeration
+    def _get_param_iterator(self):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def fit(self, X, y=None, groups=None, **fit_params):
+        from sklearn.model_selection import check_cv
+
+        check_estimator_backend(self, self.verbose)
+        backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
+        estimator = self.estimator
+        is_classifier = getattr(estimator, "_estimator_type", None) == "classifier"
+        cv = check_cv(self.cv, y, classifier=is_classifier)
+        n_splits = cv.get_n_splits(X, y, groups)
+        candidate_params = list(self._get_param_iterator())
+        n_candidates = len(candidate_params)
+        if self.verbose:
+            print(
+                f"Fitting {n_splits} folds for each of {n_candidates} "
+                f"candidates, totalling {n_candidates * n_splits} fits"
+            )
+        splits = list(cv.split(X, y, groups))
+
+        scorers, multimetric = check_multimetric_scoring(estimator, self.scoring)
+        self.multimetric_ = multimetric
+        refit_metric = self._refit_metric(scorers, multimetric)
+
+        out = self._run_search_tasks(
+            backend, estimator, X, y, candidate_params, splits, scorers,
+            fit_params,
+        )
+
+        results = self._format_results(
+            candidate_params, scorers, n_splits, out
+        )
+        self.cv_results_ = results
+        self.scorer_ = scorers if multimetric else scorers["score"]
+        self.n_splits_ = n_splits
+
+        if self.refit:
+            self.best_index_ = int(results[f"rank_test_{refit_metric}"].argmin())
+            self.best_params_ = candidate_params[self.best_index_]
+            self.best_score_ = results[f"mean_test_{refit_metric}"][self.best_index_]
+            best = clone(estimator).set_params(**self.best_params_)
+            refit_start = time.perf_counter()
+            if y is not None:
+                best.fit(X, y, **fit_params)
+            else:
+                best.fit(X, **fit_params)
+            self.refit_time_ = time.perf_counter() - refit_start
+            self.best_estimator_ = best
+            if self.preds:
+                self.preds_ = self._out_of_fold_preds(
+                    estimator, X, y, splits, fit_params
+                )
+        # detach from the user's template before stripping runtime
+        # handles (the reference mutates the template via `del
+        # estimator.sc`, search.py:568-570 — a footgun we avoid: the
+        # user's own estimator object keeps its backend)
+        self.estimator = clone(self.estimator)
+        strip_runtime(self)
+        return self
+
+    def _refit_metric(self, scorers, multimetric):
+        if multimetric:
+            if not isinstance(self.refit, str) or self.refit not in scorers:
+                if self.refit:
+                    raise ValueError(
+                        "For multi-metric scoring, refit must be the name "
+                        "of the scorer used to find the best parameters."
+                    )
+            return self.refit if isinstance(self.refit, str) else None
+        return "score"
+
+    # ------------------------------------------------------------------
+    def _run_search_tasks(self, backend, estimator, X, y, candidate_params,
+                          splits, scorers, fit_params):
+        """Dispatch (candidate × fold) tasks; returns a list of per-task
+        score dicts in task order (candidate-major, split fastest)."""
+        n_splits = len(splits)
+        batched = None
+        if not fit_params:
+            batched = self._try_batched(
+                backend, estimator, X, y, candidate_params, splits
+            )
+        if batched is not None:
+            return batched
+
+        # generic host fan-out (reference joblib path, search.py:388-409)
+        tasks = [
+            (cand_idx, params, train, test)
+            for cand_idx, params in enumerate(candidate_params)
+            for (train, test) in splits
+        ]
+
+        def run_one(task):
+            _, params, train, test = task
+            return _fit_and_score(
+                estimator, X, y, scorers, train, test, params,
+                fit_params=fit_params, error_score=self.error_score,
+                return_train_score=self.return_train_score,
+            )
+
+        return backend.run_tasks(run_one, tasks, verbose=self.verbose)
+
+    def _try_batched(self, backend, estimator, X, y, candidate_params, splits):
+        """Attempt the batched device path; None → fall back to generic."""
+        if not hasattr(type(estimator), "_build_fit_kernel"):
+            return None
+        scorer_specs = _resolve_device_scoring(estimator, self.scoring)
+        if scorer_specs is None:
+            return None
+        buckets = _candidate_buckets(estimator, candidate_params)
+        if buckets is None:
+            return None
+        needs_proba = any(kind == "proba" for _, _, kind in scorer_specs)
+        if needs_proba and not hasattr(type(estimator), "_build_proba_kernel"):
+            return None
+
+        from ..models.linear import as_dense_f32, _freeze
+        import jax.numpy as jnp
+
+        try:
+            X_arr = as_dense_f32(X)
+        except Exception:
+            return None
+
+        n = X_arr.shape[0]
+        n_splits = len(splits)
+        train_masks = np.zeros((n_splits, n), dtype=np.float32)
+        test_masks = np.zeros((n_splits, n), dtype=np.float32)
+        for i, (train, test) in enumerate(splits):
+            train_masks[i, train] = 1.0
+            test_masks[i, test] = 1.0
+
+        n_candidates = len(candidate_params)
+        n_tasks_total = n_candidates * n_splits
+        out = [None] * n_tasks_total
+        est_cls = type(estimator)
+        hyper_names = list(getattr(est_cls, "_hyper_names", ()))
+
+        wall_start = time.perf_counter()
+        for static_overrides, cand_indices in buckets.values():
+            bucket_est = clone(estimator)
+            if static_overrides:
+                bucket_est.set_params(**static_overrides)
+            data, meta = bucket_est._prep_fit_data(X_arr, y, None)
+            static = _freeze(bucket_est._static_config(meta))
+            kernel = _cached_cv_kernel(
+                est_cls, meta, static, scorer_specs, self.return_train_score
+            )
+            shared = {
+                "X": data["X"],
+                "y": data["y"],
+                "sw": data["sw"],
+                "train_masks": jnp.asarray(train_masks),
+                "test_masks": jnp.asarray(test_masks),
+            }
+            # stack task axis: bucket candidates × folds, split fastest
+            task_hyper = {name: [] for name in hyper_names}
+            split_ids = []
+            for cand_idx in cand_indices:
+                cand = candidate_params[cand_idx]
+                for s in range(n_splits):
+                    for name in hyper_names:
+                        task_hyper[name].append(
+                            float(cand.get(name, getattr(bucket_est, name)))
+                        )
+                    split_ids.append(s)
+            task_args = {
+                "hyper": {
+                    k: np.asarray(v, dtype=np.float32)
+                    for k, v in task_hyper.items()
+                },
+                "split": np.asarray(split_ids, dtype=np.int32),
+            }
+            round_size = parse_partitions(self.partitions, len(split_ids))
+            scores = backend.batched_map(
+                kernel, task_args, shared, round_size=round_size
+            )
+            # unpack into global task order
+            t = 0
+            for cand_idx in cand_indices:
+                for s in range(n_splits):
+                    out[cand_idx * n_splits + s] = {
+                        k: float(v[t]) for k, v in scores.items()
+                    }
+                    t += 1
+        wall = time.perf_counter() - wall_start
+        per_task = wall / max(n_tasks_total, 1)
+        for d in out:
+            d["fit_time"] = per_task
+            d["score_time"] = 0.0
+        return out
+
+    # ------------------------------------------------------------------
+    def _format_results(self, candidate_params, scorers, n_splits, out):
+        """sklearn-schema cv_results_ (reference search.py:457-533)."""
+        n_candidates = len(candidate_params)
+        agg = aggregate_score_dicts(out)
+        results = {}
+
+        def _store(key_name, array, weights=None, splits=False, rank=False):
+            array = np.asarray(array, dtype=np.float64).reshape(
+                n_candidates, n_splits
+            )
+            if splits:
+                for i in range(n_splits):
+                    results[f"split{i}_{key_name}"] = array[:, i]
+            means = np.average(array, axis=1, weights=weights)
+            results[f"mean_{key_name}"] = means
+            stds = np.sqrt(
+                np.average((array - means[:, None]) ** 2, axis=1, weights=weights)
+            )
+            results[f"std_{key_name}"] = stds
+            if rank:
+                results[f"rank_{key_name}"] = np.asarray(
+                    rankdata(-means, method="min"), dtype=np.int32
+                )
+
+        _store("fit_time", agg["fit_time"])
+        _store("score_time", agg["score_time"])
+
+        param_results = {}
+        for cand_idx, params in enumerate(candidate_params):
+            for name, value in params.items():
+                key = f"param_{name}"
+                if key not in param_results:
+                    param_results[key] = MaskedArray(
+                        np.empty(n_candidates, dtype=object), mask=True
+                    )
+                param_results[key][cand_idx] = value
+        results.update(param_results)
+        results["params"] = candidate_params
+
+        scorer_names = (
+            scorers.keys() if isinstance(scorers, dict) else ["score"]
+        )
+        for name in scorer_names:
+            _store(f"test_{name}", agg[f"test_{name}"], splits=True, rank=True)
+            if self.return_train_score:
+                _store(f"train_{name}", agg[f"train_{name}"], splits=True)
+        return results
+
+    def _out_of_fold_preds(self, estimator, X, y, splits, fit_params):
+        """Out-of-fold predict_proba at the best params (reference
+        search.py:551-560)."""
+        preds = []
+        for train, test in splits:
+            est = clone(estimator).set_params(**self.best_params_)
+            X_train, y_train = safe_split(est, X, y, train)
+            X_test, _ = safe_split(est, X, y, test, train)
+            est.fit(X_train, y_train, **fit_params)
+            preds.append(est.predict_proba(X_test))
+        return np.vstack(preds)
+
+    # ------------------------------------------------------------------
+    # post-fit delegation (reference search.py:875-908 used
+    # if_delegate_has_method; we delegate dynamically)
+    def _check_refit(self, method):
+        if not self.refit:
+            raise AttributeError(
+                f"{method} is not available: refit=False. "
+            )
+
+    @property
+    def classes_(self):
+        self._check_refit("classes_")
+        check_is_fitted(self, "best_estimator_")
+        return self.best_estimator_.classes_
+
+    def predict(self, X):
+        self._check_refit("predict")
+        check_is_fitted(self, "best_estimator_")
+        return self.best_estimator_.predict(X)
+
+    def predict_proba(self, X):
+        self._check_refit("predict_proba")
+        check_is_fitted(self, "best_estimator_")
+        return self.best_estimator_.predict_proba(X)
+
+    def predict_log_proba(self, X):
+        self._check_refit("predict_log_proba")
+        check_is_fitted(self, "best_estimator_")
+        return self.best_estimator_.predict_log_proba(X)
+
+    def decision_function(self, X):
+        self._check_refit("decision_function")
+        check_is_fitted(self, "best_estimator_")
+        return self.best_estimator_.decision_function(X)
+
+    def transform(self, X):
+        self._check_refit("transform")
+        check_is_fitted(self, "best_estimator_")
+        return self.best_estimator_.transform(X)
+
+    def score(self, X, y=None):
+        check_is_fitted(self, "best_estimator_")
+        if self.scorer_ is None:
+            raise ValueError("No scorer available")
+        scorer = (
+            self.scorer_[self.refit] if self.multimetric_ else self.scorer_
+        )
+        return scorer(self.best_estimator_, X, y)
+
+
+class DistGridSearchCV(DistBaseSearchCV):
+    """Exhaustive grid search with distributed fits (reference
+    search.py:584-645).
+
+    Same contract as sklearn's GridSearchCV; ``backend`` plays the role
+    of sk-dist's ``sc`` (``backend=None`` = local, the sc=None analogue).
+    """
+
+    def __init__(self, estimator, param_grid, backend=None, partitions="auto",
+                 cv=5, scoring=None, refit=True, return_train_score=False,
+                 error_score=np.nan, n_jobs=None, preds=False, verbose=0):
+        super().__init__(
+            estimator, backend=backend, partitions=partitions, cv=cv,
+            scoring=scoring, refit=refit,
+            return_train_score=return_train_score, error_score=error_score,
+            n_jobs=n_jobs, preds=preds, verbose=verbose,
+        )
+        self.param_grid = param_grid
+
+    def _get_param_iterator(self):
+        from sklearn.model_selection import ParameterGrid
+
+        return ParameterGrid(self.param_grid)
+
+
+class DistRandomizedSearchCV(DistBaseSearchCV):
+    """Randomized search over param distributions (reference
+    search.py:648-714)."""
+
+    def __init__(self, estimator, param_distributions, backend=None,
+                 partitions="auto", n_iter=10, random_state=None, cv=5,
+                 scoring=None, refit=True, return_train_score=False,
+                 error_score=np.nan, n_jobs=None, preds=False, verbose=0):
+        super().__init__(
+            estimator, backend=backend, partitions=partitions, cv=cv,
+            scoring=scoring, refit=refit,
+            return_train_score=return_train_score, error_score=error_score,
+            n_jobs=n_jobs, preds=preds, verbose=verbose,
+        )
+        self.param_distributions = param_distributions
+        self.n_iter = n_iter
+        self.random_state = random_state
+
+    def _get_param_iterator(self):
+        from sklearn.model_selection import ParameterSampler
+
+        n_iter = check_n_iter(self.n_iter, self.param_distributions)
+        return ParameterSampler(
+            self.param_distributions, n_iter, random_state=self.random_state
+        )

@@ -1,0 +1,234 @@
+"""
+Scoring: device-side (batched, mask-weighted) scorer kernels plus host
+scorer resolution.
+
+The reference vendored sklearn's scoring internals (``_score``,
+``_multimetric_score``, ``_check_multimetric_scoring`` —
+``/root/reference/skdist/distribute/utils.py:18-143``) and ran one
+scorer call per task on an executor. Here scoring happens in two modes:
+
+- **device scorers**: pure functions of ``(y, model_outputs, weights)``
+  evaluated *inside* the same compiled program as the fit, one vmap lane
+  per task, with CV fold selection expressed as 0/1 weight masks. No
+  predictions ever leave the device.
+- **host scorers**: sklearn scorer objects, used by the generic
+  (arbitrary-estimator) fan-out path for exact sklearn semantics.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# device scorer kernels
+# ---------------------------------------------------------------------------
+# Each kernel: (y, out, w, meta) -> scalar.  ``out`` is the estimator's
+# raw output: decision scores (n,) / (n,k) for classifiers, predictions
+# (n,) for regressors, probabilities (n,k) where required.  ``w`` is the
+# fold mask times sample weight.
+
+
+def _pred_idx(out):
+    if out.ndim == 1:
+        return (out > 0).astype(jnp.int32)
+    return jnp.argmax(out, axis=1).astype(jnp.int32)
+
+
+def _wsum(x, w):
+    return jnp.sum(x * w)
+
+
+def accuracy(y, out, w, meta):
+    correct = (_pred_idx(out) == y).astype(jnp.float32)
+    return _wsum(correct, w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def _confusion(y, out, w, k):
+    """Weighted confusion matrix C[t, p]."""
+    pred = _pred_idx(out)
+    oh_t = jax.nn.one_hot(y, k, dtype=jnp.float32)
+    oh_p = jax.nn.one_hot(pred, k, dtype=jnp.float32)
+    return (oh_t * w[:, None]).T @ oh_p
+
+
+def _prf(C):
+    tp = jnp.diag(C)
+    support = jnp.sum(C, axis=1)
+    pred_tot = jnp.sum(C, axis=0)
+    precision = tp / jnp.maximum(pred_tot, 1e-12)
+    recall = tp / jnp.maximum(support, 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return precision, recall, f1, support
+
+
+def _f1_avg(y, out, w, meta, average):
+    k = meta["n_classes"]
+    C = _confusion(y, out, w, k)
+    precision, recall, f1, support = _prf(C)
+    if average == "micro":
+        return jnp.sum(jnp.diag(C)) / jnp.maximum(jnp.sum(C), 1e-12)
+    if average == "macro":
+        # sklearn macro averages over all classes present in y ∪ pred;
+        # with a fixed label set we average over classes with support>0
+        # or predicted mass>0 — matches sklearn when all classes appear
+        present = (support > 0) | (jnp.sum(C, axis=0) > 0)
+        return jnp.sum(jnp.where(present, f1, 0.0)) / jnp.maximum(
+            jnp.sum(present.astype(jnp.float32)), 1e-12
+        )
+    # weighted
+    return jnp.sum(f1 * support) / jnp.maximum(jnp.sum(support), 1e-12)
+
+
+def f1_macro(y, out, w, meta):
+    return _f1_avg(y, out, w, meta, "macro")
+
+
+def f1_micro(y, out, w, meta):
+    return _f1_avg(y, out, w, meta, "micro")
+
+
+def f1_weighted(y, out, w, meta):
+    return _f1_avg(y, out, w, meta, "weighted")
+
+
+def f1_binary(y, out, w, meta):
+    C = _confusion(y, out, w, meta["n_classes"])
+    _, _, f1, _ = _prf(C)
+    return f1[meta["n_classes"] - 1]
+
+
+def precision_weighted(y, out, w, meta):
+    C = _confusion(y, out, w, meta["n_classes"])
+    precision, _, _, support = _prf(C)
+    return jnp.sum(precision * support) / jnp.maximum(jnp.sum(support), 1e-12)
+
+
+def recall_weighted(y, out, w, meta):
+    C = _confusion(y, out, w, meta["n_classes"])
+    _, recall, _, support = _prf(C)
+    return jnp.sum(recall * support) / jnp.maximum(jnp.sum(support), 1e-12)
+
+
+def balanced_accuracy(y, out, w, meta):
+    C = _confusion(y, out, w, meta["n_classes"])
+    _, recall, _, support = _prf(C)
+    present = support > 0
+    return jnp.sum(jnp.where(present, recall, 0.0)) / jnp.maximum(
+        jnp.sum(present.astype(jnp.float32)), 1e-12
+    )
+
+
+def neg_log_loss(y, proba, w, meta):
+    p = jnp.clip(proba, 1e-15, 1.0 - 1e-15)
+    k = meta["n_classes"]
+    ll = jnp.sum(jax.nn.one_hot(y, k) * jnp.log(p), axis=1)
+    return _wsum(ll, w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def roc_auc_binary(y, out, w, meta):
+    """Weighted binary ROC-AUC with average-rank tie handling.
+
+    out: decision scores (n,) or proba (n,2) → positive-class score.
+    """
+    s = out[:, -1] if out.ndim == 2 else out
+    pos = (y == (meta["n_classes"] - 1)).astype(jnp.float32) * w
+    neg = (y != (meta["n_classes"] - 1)).astype(jnp.float32) * w
+    order = jnp.argsort(s)
+    s_s, pos_s, neg_s = s[order], pos[order], neg[order]
+    cneg = jnp.cumsum(neg_s) - neg_s  # negatives strictly before (by sort pos)
+    # ties: group equal scores; each positive gets credit for negatives
+    # strictly below its group plus half the group's own negative mass
+    same_prev = jnp.concatenate([jnp.array([False]), s_s[1:] == s_s[:-1]])
+    grp = jnp.cumsum(~same_prev) - 1
+    n = s_s.shape[0]
+    total_neg_per_grp = jax.ops.segment_sum(neg_s, grp, num_segments=n)
+    first_of_grp = ~same_prev
+    # cneg at the first element of each group = negatives strictly below
+    neg_before_grp = jax.ops.segment_max(
+        jnp.where(first_of_grp, cneg, -jnp.inf), grp, num_segments=n
+    )[grp]
+    tie_neg = total_neg_per_grp[grp]
+    auc_num = jnp.sum(pos_s * (neg_before_grp + 0.5 * tie_neg))
+    denom = jnp.sum(pos) * jnp.sum(neg)
+    return auc_num / jnp.maximum(denom, 1e-12)
+
+
+def r2(y, pred, w, meta):
+    wsum = jnp.maximum(jnp.sum(w), 1e-12)
+    ybar = _wsum(y, w) / wsum
+    ss_res = _wsum((y - pred) ** 2, w)
+    ss_tot = _wsum((y - ybar) ** 2, w)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-12)
+
+
+def neg_mean_squared_error(y, pred, w, meta):
+    return -_wsum((y - pred) ** 2, w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def neg_root_mean_squared_error(y, pred, w, meta):
+    return -jnp.sqrt(-neg_mean_squared_error(y, pred, w, meta))
+
+
+def neg_mean_absolute_error(y, pred, w, meta):
+    return -_wsum(jnp.abs(y - pred), w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+#: name → (kernel, required estimator output kind)
+#: output kinds: 'decision' (default raw scores), 'proba', 'predict'
+DEVICE_SCORERS = {
+    "accuracy": (accuracy, "decision"),
+    "f1": (f1_binary, "decision"),
+    "f1_macro": (f1_macro, "decision"),
+    "f1_micro": (f1_micro, "decision"),
+    "f1_weighted": (f1_weighted, "decision"),
+    "precision_weighted": (precision_weighted, "decision"),
+    "recall_weighted": (recall_weighted, "decision"),
+    "balanced_accuracy": (balanced_accuracy, "decision"),
+    "neg_log_loss": (neg_log_loss, "proba"),
+    "roc_auc": (roc_auc_binary, "decision"),
+    "r2": (r2, "predict"),
+    "neg_mean_squared_error": (neg_mean_squared_error, "predict"),
+    "neg_root_mean_squared_error": (neg_root_mean_squared_error, "predict"),
+    "neg_mean_absolute_error": (neg_mean_absolute_error, "predict"),
+}
+
+
+def device_scorer_supported(name):
+    return name in DEVICE_SCORERS
+
+
+def default_device_scorer(estimator):
+    """Mirror estimator.score defaults: accuracy / r2."""
+    kind = getattr(estimator, "_estimator_type", None)
+    return "accuracy" if kind == "classifier" else "r2"
+
+
+# ---------------------------------------------------------------------------
+# host scorer resolution (generic path), sklearn-backed
+# ---------------------------------------------------------------------------
+
+def check_multimetric_scoring(estimator, scoring):
+    """Normalise ``scoring`` to (dict name → sklearn scorer, is_multimetric).
+
+    Behavioural port of the vendored sklearn helper the reference used
+    (``utils.py:75-143``), delegating to modern sklearn.
+    """
+    from sklearn.metrics import check_scoring
+
+    if scoring is None or isinstance(scoring, str) or callable(scoring):
+        return {"score": check_scoring(estimator, scoring=scoring)}, False
+    if isinstance(scoring, (list, tuple, set)):
+        keys = list(scoring)
+        if len(set(keys)) != len(keys):
+            raise ValueError(f"Duplicate scorer names: {keys}")
+        return {name: check_scoring(estimator, scoring=name) for name in keys}, True
+    if isinstance(scoring, dict):
+        return {
+            name: check_scoring(estimator, scoring=s) for name, s in scoring.items()
+        }, True
+    raise ValueError(f"Invalid scoring: {scoring!r}")
+
+
+def aggregate_score_dicts(scores):
+    """list of dicts → dict of arrays (reference ``utils.py:13-15``)."""
+    return {key: np.asarray([s[key] for s in scores]) for key in scores[0]}

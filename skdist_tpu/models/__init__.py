@@ -1,0 +1,52 @@
+"""
+JAX/XLA estimator kernels.
+
+The reference (sk-dist) borrowed all its per-task compute from sklearn's
+native code: liblinear/lbfgs C solvers for linear models and Cython tree
+builders for forests (SURVEY §2.2). skdist_tpu supplies that compute as
+jit/vmap-able JAX kernels so that *many fits of the same shape compile
+into one XLA program* — the core idiomatic win over per-task Spark
+dispatch. Every model exposes the sklearn estimator protocol
+(``fit/predict/predict_proba/score/get_params/set_params``) plus a
+batched-fit contract consumed by the distributed meta-estimators.
+"""
+
+from .linear import (
+    LinearRegression,
+    LinearSVC,
+    LogisticRegression,
+    Ridge,
+    RidgeClassifier,
+    SGDClassifier,
+)
+from .tree import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    ExtraTreeClassifier,
+    ExtraTreeRegressor,
+)
+from .forest import (
+    ExtraTreesClassifier,
+    ExtraTreesRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    RandomTreesEmbedding,
+)
+
+__all__ = [
+    "LogisticRegression",
+    "LinearSVC",
+    "SGDClassifier",
+    "Ridge",
+    "RidgeClassifier",
+    "LinearRegression",
+    "DecisionTreeClassifier",
+    "DecisionTreeRegressor",
+    "ExtraTreeClassifier",
+    "ExtraTreeRegressor",
+    "RandomForestClassifier",
+    "RandomForestRegressor",
+    "ExtraTreesClassifier",
+    "ExtraTreesRegressor",
+    "RandomTreesEmbedding",
+]

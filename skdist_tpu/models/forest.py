@@ -1,0 +1,38 @@
+"""
+Forest kernels (placeholder — implemented in the ensemble milestone).
+"""
+
+from ..base import BaseEstimator, ClassifierMixin, RegressorMixin, TransformerMixin
+
+__all__ = [
+    "RandomForestClassifier",
+    "RandomForestRegressor",
+    "ExtraTreesClassifier",
+    "ExtraTreesRegressor",
+    "RandomTreesEmbedding",
+]
+
+
+class _ForestStub(BaseEstimator):
+    def fit(self, X, y=None, sample_weight=None):
+        raise NotImplementedError("forest kernels land in the ensemble milestone")
+
+
+class RandomForestClassifier(_ForestStub, ClassifierMixin):
+    pass
+
+
+class RandomForestRegressor(_ForestStub, RegressorMixin):
+    pass
+
+
+class ExtraTreesClassifier(_ForestStub, ClassifierMixin):
+    pass
+
+
+class ExtraTreesRegressor(_ForestStub, RegressorMixin):
+    pass
+
+
+class RandomTreesEmbedding(_ForestStub, TransformerMixin):
+    pass

@@ -1,0 +1,34 @@
+"""
+Histogram-based decision trees in XLA (placeholder — implemented with
+forests in the ensemble milestone).
+"""
+
+from ..base import BaseEstimator, ClassifierMixin, RegressorMixin
+
+__all__ = [
+    "DecisionTreeClassifier",
+    "DecisionTreeRegressor",
+    "ExtraTreeClassifier",
+    "ExtraTreeRegressor",
+]
+
+
+class _TreeStub(BaseEstimator):
+    def fit(self, X, y, sample_weight=None):
+        raise NotImplementedError("tree kernels land in the ensemble milestone")
+
+
+class DecisionTreeClassifier(_TreeStub, ClassifierMixin):
+    pass
+
+
+class DecisionTreeRegressor(_TreeStub, RegressorMixin):
+    pass
+
+
+class ExtraTreeClassifier(DecisionTreeClassifier):
+    pass
+
+
+class ExtraTreeRegressor(DecisionTreeRegressor):
+    pass

@@ -1,0 +1,24 @@
+"""
+Fan-out runtime: the TPU-native replacement for Spark's
+``sc.parallelize(...).map(fn).collect()`` + ``sc.broadcast`` idiom that
+every reference estimator is built on (reference ``search.py:411-437``,
+``multiclass.py:316-331``, ``ensemble.py:304-322``).
+"""
+
+from .backend import (
+    LocalBackend,
+    TPUBackend,
+    TaskBackend,
+    get_value,
+    parse_partitions,
+    resolve_backend,
+)
+
+__all__ = [
+    "TaskBackend",
+    "LocalBackend",
+    "TPUBackend",
+    "resolve_backend",
+    "parse_partitions",
+    "get_value",
+]

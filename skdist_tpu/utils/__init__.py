@@ -1,0 +1,15 @@
+from .validation import (
+    check_estimator_backend,
+    check_is_fitted,
+    check_n_iter,
+    safe_indexing,
+    safe_split,
+)
+
+__all__ = [
+    "check_estimator_backend",
+    "check_is_fitted",
+    "check_n_iter",
+    "safe_indexing",
+    "safe_split",
+]

@@ -1,0 +1,96 @@
+"""
+Validation and indexing helpers.
+
+Behavioural counterparts of the reference's vendored sklearn utilities
+(``/root/reference/skdist/distribute/validation.py:14-264`` and
+``utils.py:146-223``) — re-implemented against the protocols, not
+copied: row indexing across numpy / scipy sparse / pandas / list,
+fitted-state checks, backend banner printing, and n_iter capping.
+"""
+
+import numbers
+
+import numpy as np
+
+
+def check_estimator_backend(estimator, verbose=False):
+    """Print which execution path a fit will use (reference
+    ``_check_estimator``, validation.py:14-20, printed spark-vs-local)."""
+    if verbose:
+        backend = getattr(estimator, "backend", None)
+        if backend is None:
+            print("Will fit using local backend")
+        else:
+            print(f"Will fit using {type(backend).__name__ if not isinstance(backend, str) else backend}")
+
+
+def check_is_fitted(estimator, attributes=None):
+    """Raise if estimator has no fitted attributes (version-portable,
+    reference validation.py:23-29)."""
+    if attributes is not None:
+        if isinstance(attributes, str):
+            attributes = [attributes]
+        fitted = all(hasattr(estimator, a) for a in attributes)
+    else:
+        fitted = any(
+            v for v in vars(estimator) if v.endswith("_") and not v.startswith("__")
+        )
+    if not fitted:
+        raise AttributeError(
+            f"This {type(estimator).__name__} instance is not fitted yet. "
+            "Call 'fit' before using this estimator."
+        )
+
+
+def check_n_iter(n_iter, param_distributions):
+    """Cap n_iter at the size of a fully-enumerable grid (reference
+    ``_check_n_iter``, validation.py:99-110)."""
+    all_lists = all(
+        not hasattr(v, "rvs") for v in param_distributions.values()
+    )
+    if all_lists:
+        from sklearn.model_selection import ParameterGrid
+
+        grid_size = len(ParameterGrid(param_distributions))
+        return min(grid_size, n_iter)
+    return n_iter
+
+
+def safe_indexing(X, indices):
+    """Row-subset X across container types (reference
+    ``_safe_indexing``, validation.py:146-183)."""
+    if X is None:
+        return None
+    if hasattr(X, "iloc"):
+        return X.iloc[indices]
+    if hasattr(X, "shape"):  # numpy / scipy sparse
+        return X[indices]
+    return [X[i] for i in indices]
+
+
+def safe_split(estimator, X, y, indices, train_indices=None):
+    """Train/test subset respecting precomputed kernels (reference
+    ``_safe_split``, utils.py:171-209)."""
+    if getattr(estimator, "kernel", None) == "precomputed":
+        if not hasattr(X, "shape"):
+            raise ValueError("Precomputed kernels require array X")
+        if train_indices is None:
+            X_subset = X[np.ix_(indices, indices)]
+        else:
+            X_subset = X[np.ix_(indices, train_indices)]
+    else:
+        X_subset = safe_indexing(X, indices)
+    y_subset = safe_indexing(y, indices) if y is not None else None
+    return X_subset, y_subset
+
+
+def num_samples(x):
+    """Number of samples in array-like x (reference utils.py:146-168)."""
+    if hasattr(x, "shape") and x.shape is not None:
+        if len(x.shape) == 0:
+            raise TypeError("Singleton array cannot be considered a valid collection.")
+        if isinstance(x.shape[0], numbers.Integral):
+            return x.shape[0]
+    if hasattr(x, "__len__"):
+        return len(x)
+    raise TypeError(f"Expected sequence or array-like, got {type(x)}")

@@ -1,0 +1,73 @@
+"""
+Test harness configuration.
+
+The unit tier runs on the JAX CPU backend with 8 virtual host devices —
+the analogue of the reference's pytest-spark local-mode JVM
+(`/root/reference/skdist/tests/test_spark.py:33`): the same sharding,
+replication and gather code paths execute without TPU hardware.
+
+NOTE: must run before anything imports jax; the environment pins
+JAX_PLATFORMS=axon (TPU tunnel) via sitecustomize, so we override
+in-process.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devices = jax.devices()
+    assert len(devices) == 8
+    return devices
+
+
+@pytest.fixture(scope="session")
+def tpu_backend():
+    """A TPUBackend over the 8 virtual CPU devices."""
+    from skdist_tpu.parallel import TPUBackend
+
+    return TPUBackend()
+
+
+@pytest.fixture
+def clf_data():
+    """Tiny deterministic classification problem (mirrors the synthetic
+    arrays used throughout the reference tests, e.g. test_search.py:38-45)."""
+    rng = np.random.RandomState(0)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.5, size=(60, 8)) for c in (-2.0, 0.0, 2.0)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1, 2], 60)
+    perm = rng.permutation(len(y))
+    return X[perm], y[perm]
+
+
+@pytest.fixture
+def binary_data():
+    rng = np.random.RandomState(1)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.7, size=(80, 6)) for c in (-1.0, 1.0)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1], 80)
+    perm = rng.permutation(len(y))
+    return X[perm], y[perm]
+
+
+@pytest.fixture
+def reg_data():
+    rng = np.random.RandomState(2)
+    X = rng.normal(size=(200, 10)).astype(np.float32)
+    w = rng.normal(size=10)
+    y = (X @ w + 0.1 * rng.normal(size=200)).astype(np.float32)
+    return X, y

@@ -1,0 +1,114 @@
+"""
+Base protocol tests (reference: skdist/distribute/tests/test_base.py).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from skdist_tpu.base import BaseEstimator, clone, strip_runtime
+from skdist_tpu.parallel import LocalBackend, TPUBackend, get_value, parse_partitions
+
+
+class Toy(BaseEstimator):
+    def __init__(self, a=1, b="x", backend=None):
+        self.a = a
+        self.b = b
+        self.backend = backend
+
+
+def test_get_set_params():
+    t = Toy(a=3)
+    assert t.get_params()["a"] == 3
+    t.set_params(a=5, b="y")
+    assert t.a == 5 and t.b == "y"
+    with pytest.raises(ValueError):
+        t.set_params(nope=1)
+
+
+def test_clone_carries_backend_by_reference():
+    backend = LocalBackend(n_jobs=2)
+    t = Toy(a=2, backend=backend)
+    c = clone(t)
+    assert c is not t
+    assert c.a == 2
+    assert c.backend is backend  # reference semantics: reattached, not copied
+
+
+def test_clone_nested():
+    inner = Toy(a=7)
+    outer = Toy(a=1, b=inner)
+    c = clone(outer)
+    assert c.b is not inner
+    assert c.b.a == 7
+
+
+def test_strip_runtime_makes_picklable():
+    t = Toy(backend=LocalBackend())
+    strip_runtime(t)
+    assert t.backend is None
+    pickle.dumps(t)
+
+
+def test_backend_refuses_pickle():
+    with pytest.raises(TypeError):
+        pickle.dumps(LocalBackend())
+
+
+def test_parse_partitions():
+    # returns tasks-per-round: 'auto'/None -> single full round;
+    # int p -> ceil(n/p) tasks per round (p rounds)
+    assert parse_partitions("auto", 10) == 10
+    assert parse_partitions(None, 10) == 10
+    assert parse_partitions(4, 10) == 3
+    assert parse_partitions(1, 10) == 10
+
+
+def test_get_value_roundtrip():
+    b = LocalBackend()
+    h = b.broadcast({"x": np.ones(3)})
+    assert np.allclose(get_value(h)["x"], 1.0)
+    assert get_value(42) == 42
+
+
+def test_tpu_backend_broadcast_and_batched_map(tpu_backend):
+    import jax.numpy as jnp
+
+    def kernel(shared, task):
+        return {"s": jnp.sum(shared["X"]) * task["m"]}
+
+    X = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = tpu_backend.batched_map(
+        kernel, {"m": np.arange(11, dtype=np.float32)}, {"X": X}
+    )
+    assert np.allclose(out["s"], 15.0 * np.arange(11))
+
+
+def test_local_backend_batched_map_matches(tpu_backend):
+    import jax.numpy as jnp
+
+    def kernel(shared, task):
+        return {"v": shared["X"] @ task["w"]}
+
+    X = np.random.RandomState(0).normal(size=(4, 3)).astype(np.float32)
+    W = np.random.RandomState(1).normal(size=(5, 3)).astype(np.float32)
+    local = LocalBackend().batched_map(kernel, {"w": W}, {"X": X})
+    dist = tpu_backend.batched_map(kernel, {"w": W}, {"X": X})
+    assert np.allclose(local["v"], dist["v"], atol=1e-6)
+
+
+def test_tpu_backend_rounds(tpu_backend):
+    """Chunked rounds (round_size) must give identical results."""
+    import jax.numpy as jnp
+
+    def kernel(shared, task):
+        return {"v": task["w"] * 2.0}
+
+    W = np.arange(13, dtype=np.float32)
+    tpu_backend.round_size = 8
+    try:
+        out = tpu_backend.batched_map(kernel, {"w": W}, {})
+    finally:
+        tpu_backend.round_size = None
+    assert np.allclose(out["v"], W * 2.0)

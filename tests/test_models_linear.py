@@ -1,0 +1,148 @@
+"""
+Linear kernel parity tests vs sklearn (the compute the reference
+delegated to liblinear/lbfgs — SURVEY §2.2).
+"""
+
+import numpy as np
+import pytest
+
+from skdist_tpu.models import (
+    LinearRegression,
+    LinearSVC,
+    LogisticRegression,
+    Ridge,
+    RidgeClassifier,
+    SGDClassifier,
+)
+
+
+def test_logreg_binary_parity(binary_data):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = binary_data
+    ours = LogisticRegression(C=1.0, max_iter=500, tol=1e-6).fit(X, y)
+    sk = SkLR(C=1.0, max_iter=1000, tol=1e-8).fit(X, y)
+    assert np.abs(ours.coef_ - sk.coef_).max() < 1e-3
+    assert np.abs(ours.predict_proba(X) - sk.predict_proba(X)).max() < 1e-3
+    assert (ours.predict(X) == sk.predict(X)).mean() == 1.0
+
+
+def test_logreg_multiclass_parity(clf_data):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    ours = LogisticRegression(C=0.5, max_iter=300, tol=1e-6).fit(X, y)
+    sk = SkLR(C=0.5, max_iter=1000, tol=1e-8).fit(X, y)
+    assert ours.coef_.shape == sk.coef_.shape
+    assert np.abs(ours.predict_proba(X) - sk.predict_proba(X)).max() < 5e-3
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.99
+
+
+def test_logreg_sample_weight(binary_data):
+    X, y = binary_data
+    w = np.ones(len(y))
+    w[:10] = 0.0
+    ours = LogisticRegression(max_iter=200).fit(X, y, sample_weight=w)
+    sub = LogisticRegression(max_iter=200).fit(X[10:], y[10:])
+    # zero-weight == excluded
+    assert np.abs(ours.coef_ - sub.coef_).max() < 1e-3
+
+
+def test_logreg_class_weight_balanced(clf_data):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    # make imbalanced
+    keep = np.concatenate([np.where(y == 0)[0][:20], np.where(y != 0)[0]])
+    X, y = X[keep], y[keep]
+    ours = LogisticRegression(class_weight="balanced", max_iter=300).fit(X, y)
+    sk = SkLR(class_weight="balanced", max_iter=1000).fit(X, y)
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.98
+
+
+def test_linearsvc(clf_data):
+    from sklearn.svm import LinearSVC as SkSVC
+
+    X, y = clf_data
+    ours = LinearSVC(C=1.0, max_iter=500).fit(X, y)
+    sk = SkSVC(C=1.0, max_iter=5000).fit(X, y)
+    agree = (ours.predict(X) == sk.predict(X)).mean()
+    assert agree >= 0.97
+    assert ours.decision_function(X).shape == (len(y), 3)
+
+
+def test_ridge_parity(reg_data):
+    from sklearn.linear_model import Ridge as SkRidge
+
+    X, y = reg_data
+    ours = Ridge(alpha=2.0).fit(X, y)
+    sk = SkRidge(alpha=2.0).fit(X, y)
+    assert np.abs(ours.coef_ - sk.coef_).max() < 1e-3
+    assert abs(ours.intercept_[0] - sk.intercept_) < 1e-3
+    assert np.abs(ours.predict(X) - sk.predict(X)).max() < 1e-3
+
+
+def test_linear_regression_parity(reg_data):
+    from sklearn.linear_model import LinearRegression as SkOLS
+
+    X, y = reg_data
+    ours = LinearRegression().fit(X, y)
+    sk = SkOLS().fit(X, y)
+    assert np.abs(ours.coef_ - sk.coef_).max() < 1e-3
+    assert ours.score(X, y) > 0.95
+
+
+def test_ridge_classifier(clf_data):
+    from sklearn.linear_model import RidgeClassifier as SkRC
+
+    X, y = clf_data
+    ours = RidgeClassifier(alpha=1.0).fit(X, y)
+    sk = SkRC(alpha=1.0).fit(X, y)
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.98
+
+
+def test_sgd_classifier(clf_data):
+    X, y = clf_data
+    ours = SGDClassifier(
+        loss="log_loss", alpha=1e-3, max_iter=200, batch_size=32
+    ).fit(X, y)
+    assert ours.score(X, y) >= 0.95
+    proba = ours.predict_proba(X)
+    assert proba.shape == (len(y), 3)
+    assert np.allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    hinge = SGDClassifier(
+        loss="hinge", alpha=1e-3, max_iter=200, batch_size=32
+    ).fit(X, y)
+    assert hinge.score(X, y) >= 0.95
+    with pytest.raises(AttributeError):
+        hinge.predict_proba(X)
+
+
+def test_estimators_pickle(clf_data):
+    import pickle
+
+    X, y = clf_data
+    for est in (
+        LogisticRegression(max_iter=50),
+        LinearSVC(max_iter=50),
+        RidgeClassifier(),
+    ):
+        est.fit(X, y)
+        loaded = pickle.loads(pickle.dumps(est))
+        assert (loaded.predict(X) == est.predict(X)).all()
+
+
+def test_class_weight_partial_dict(binary_data):
+    """Partial class_weight dicts: unlisted classes default to 1
+    (regression: numpy-label lookup previously raised KeyError)."""
+    X, y = binary_data
+    est = LogisticRegression(class_weight={0: 2.0}, max_iter=100).fit(X, y)
+    assert est.score(X, y) > 0.9
+
+
+def test_sklearn_clone_compat(clf_data):
+    from sklearn.base import clone as sk_clone
+
+    est = LogisticRegression(C=3.0)
+    c = sk_clone(est)
+    assert c.C == 3.0

@@ -1,0 +1,232 @@
+"""
+DistGridSearchCV / DistRandomizedSearchCV tests.
+
+Mirrors the reference test strategy (skdist/distribute/tests/
+test_search.py: tiny deterministic arrays, exact predictions) plus the
+new parity tiers: sklearn cv_results_ schema equality on the generic
+path and batched-vs-generic agreement (the BASELINE.json 1e-5 target).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from skdist_tpu.distribute.search import DistGridSearchCV, DistRandomizedSearchCV
+from skdist_tpu.models import LogisticRegression, Ridge
+
+# the reference's canonical toy problem (test_search.py:38-45)
+X_TOY = np.array([[1, 1, 1], [0, 0, 0], [-1, -1, -1]] * 100, dtype=np.float32)
+Y_TOY = np.array([0, 0, 1] * 100)
+
+
+def test_fit_predict_toy():
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [0.1, 1.0]}, cv=5,
+        scoring="f1_weighted",
+    ).fit(X_TOY, Y_TOY)
+    preds = gs.predict(np.array([[1.0, 1.0, 1.0], [0, 0, 0], [-1, -1, -1]]))
+    assert list(preds) == [0, 0, 1]
+
+
+def test_cv_results_schema_vs_sklearn(clf_data):
+    from sklearn.linear_model import LogisticRegression as SkLR
+    from sklearn.model_selection import GridSearchCV
+
+    X, y = clf_data
+    grid = {"C": [0.01, 1.0, 100.0]}
+    ours = DistGridSearchCV(SkLR(max_iter=200), grid, cv=3).fit(X, y)
+    sk = GridSearchCV(SkLR(max_iter=200), grid, cv=3).fit(X, y)
+    for key in sk.cv_results_:
+        assert key in ours.cv_results_, key
+    np.testing.assert_allclose(
+        ours.cv_results_["mean_test_score"],
+        sk.cv_results_["mean_test_score"],
+        atol=1e-12,
+    )
+    assert (
+        ours.cv_results_["rank_test_score"] == sk.cv_results_["rank_test_score"]
+    ).all()
+    assert ours.best_params_ == sk.best_params_
+    assert ours.best_index_ == sk.best_index_
+
+
+def test_batched_matches_generic(clf_data):
+    """The 1e-5 north star: device-batched fan-out vs per-task path."""
+    from sklearn.metrics import accuracy_score, make_scorer
+
+    X, y = clf_data
+    grid = {"C": [0.1, 1.0, 10.0]}
+    batched = DistGridSearchCV(
+        LogisticRegression(max_iter=100), grid, cv=3, scoring="accuracy"
+    ).fit(X, y)
+    generic = DistGridSearchCV(
+        LogisticRegression(max_iter=100), grid, cv=3,
+        scoring=make_scorer(accuracy_score),
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        batched.cv_results_["mean_test_score"],
+        generic.cv_results_["mean_test_score"],
+        atol=1e-5,
+    )
+
+
+def test_batched_on_device_mesh(clf_data, tpu_backend):
+    X, y = clf_data
+    grid = {"C": [0.1, 1.0, 10.0], "tol": [1e-4, 1e-3]}
+    local = DistGridSearchCV(
+        LogisticRegression(max_iter=100), grid, cv=3, scoring="accuracy"
+    ).fit(X, y)
+    dist = DistGridSearchCV(
+        LogisticRegression(max_iter=100), grid, backend=tpu_backend, cv=3,
+        scoring="accuracy",
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        local.cv_results_["mean_test_score"],
+        dist.cv_results_["mean_test_score"],
+        atol=1e-6,
+    )
+    # backend must be stripped from the fitted artifact
+    assert dist.backend is None
+    pickle.dumps(dist)
+
+
+def test_multimetric(clf_data):
+    X, y = clf_data
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=100), {"C": [0.1, 1.0]}, cv=3,
+        scoring=["accuracy", "f1_weighted"], refit="accuracy",
+    ).fit(X, y)
+    assert "mean_test_accuracy" in gs.cv_results_
+    assert "mean_test_f1_weighted" in gs.cv_results_
+    assert hasattr(gs, "best_estimator_")
+
+
+def test_return_train_score(clf_data):
+    X, y = clf_data
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=100), {"C": [1.0]}, cv=3,
+        scoring="accuracy", return_train_score=True,
+    ).fit(X, y)
+    assert "mean_train_score" in gs.cv_results_
+    assert gs.cv_results_["mean_train_score"][0] >= gs.cv_results_["mean_test_score"][0] - 0.05
+
+
+def test_randomized_search(clf_data):
+    from scipy.stats import uniform
+
+    X, y = clf_data
+    rs = DistRandomizedSearchCV(
+        LogisticRegression(max_iter=100),
+        {"C": uniform(0.01, 10.0)},
+        n_iter=5, random_state=0, cv=3, scoring="accuracy",
+    ).fit(X, y)
+    assert len(rs.cv_results_["params"]) == 5
+    assert rs.score(X, y) > 0.9
+
+
+def test_randomized_n_iter_capped(clf_data):
+    X, y = clf_data
+    rs = DistRandomizedSearchCV(
+        LogisticRegression(max_iter=50), {"C": [0.1, 1.0]},
+        n_iter=10, cv=3, scoring="accuracy",
+    ).fit(X, y)
+    # reference _check_n_iter caps at grid size (validation.py:99-110)
+    assert len(rs.cv_results_["params"]) == 2
+
+
+def test_regressor_search(reg_data):
+    X, y = reg_data
+    gs = DistGridSearchCV(
+        Ridge(), {"alpha": [0.01, 1.0, 100.0]}, cv=3, scoring="r2"
+    ).fit(X, y)
+    assert gs.best_score_ > 0.9
+    assert gs.best_params_["alpha"] in (0.01, 1.0)
+
+
+def test_preds_attribute(clf_data):
+    X, y = clf_data
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=100), {"C": [1.0]}, cv=3,
+        scoring="accuracy", preds=True,
+    ).fit(X, y)
+    # out-of-fold probabilities, one row per sample (reference search.py:551-560)
+    assert gs.preds_.shape == (len(y), 3)
+
+
+def test_error_score(clf_data):
+    from sklearn.metrics import accuracy_score, make_scorer
+
+    X, y = clf_data
+
+    class Exploding(LogisticRegression):
+        def fit(self, X, y=None, sample_weight=None):
+            raise RuntimeError("boom")
+
+    gs = DistGridSearchCV(
+        Exploding(), {"C": [1.0]}, cv=3, refit=False,
+        scoring=make_scorer(accuracy_score), error_score=0.0,
+    )
+    with pytest.warns(Warning):
+        gs.fit(X, y)
+    assert (gs.cv_results_["mean_test_score"] == 0.0).all()
+
+    gs2 = DistGridSearchCV(
+        Exploding(), {"C": [1.0]}, cv=3, refit=False,
+        scoring=make_scorer(accuracy_score), error_score="raise",
+    )
+    with pytest.raises(RuntimeError):
+        gs2.fit(X, y)
+
+
+def test_nested_search(clf_data):
+    """Meta-inside-meta nesting (reference examples/search/nested.py)."""
+    X, y = clf_data
+    inner = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [0.1, 1.0]}, cv=2,
+        scoring="accuracy",
+    )
+    from skdist_tpu.base import clone
+
+    outer = clone(inner)
+    outer.fit(X, y)
+    assert hasattr(outer, "best_estimator_")
+
+
+def test_backend_and_template_not_mutated(clf_data, tpu_backend):
+    """fit() must not leak state into the user's backend or template
+    estimator (regression: round_size mutation + template stripping)."""
+    X, y = clf_data
+    template = LogisticRegression(max_iter=50)
+    gs = DistGridSearchCV(
+        template, {"C": [0.1, 1.0]}, backend=tpu_backend, cv=3,
+        scoring="accuracy", partitions=2,
+    ).fit(X, y)
+    assert tpu_backend.round_size is None
+    assert gs.estimator is not template
+    # a different-sized mesh on the same kernels must not reuse stale
+    # shardings (regression: jit cache keyed without the mesh)
+    from skdist_tpu.parallel import TPUBackend
+    import jax
+
+    half = TPUBackend(devices=jax.devices()[:4])
+    gs2 = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [0.1, 1.0]}, backend=half,
+        cv=3, scoring="accuracy",
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        gs.cv_results_["mean_test_score"],
+        gs2.cv_results_["mean_test_score"],
+        atol=1e-6,
+    )
+
+
+def test_verbose_prints(clf_data, capsys):
+    X, y = clf_data
+    DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [1.0]}, cv=2,
+        scoring="accuracy", verbose=1,
+    ).fit(X, y)
+    out = capsys.readouterr().out
+    assert "local backend" in out
+    assert "Fitting 2 folds" in out
