@@ -22,12 +22,6 @@ import numpy as np
 _RUNTIME_ATTRS = ("backend", "sc", "mesh")
 
 
-def _jax_leaves(obj):
-    import jax
-
-    return [x for x in jax.tree_util.tree_leaves(obj) if hasattr(x, "dtype")]
-
-
 class BaseEstimator:
     """sklearn-protocol base: introspective ``get_params``/``set_params``.
 

@@ -39,10 +39,12 @@ from scipy.stats import rankdata
 
 from ..base import BaseEstimator, clone, strip_runtime
 from ..metrics import (
+    BINARY_ONLY_SCORERS,
     DEVICE_SCORERS,
     aggregate_score_dicts,
     check_multimetric_scoring,
     default_device_scorer,
+    device_scorer_compatible,
 )
 from ..parallel import parse_partitions, resolve_backend
 from ..utils.validation import (
@@ -155,7 +157,7 @@ def _resolve_device_scoring(estimator, scoring):
         if metric not in DEVICE_SCORERS:
             return None
         kernel, kind = DEVICE_SCORERS[metric]
-        specs.append((out_name, kernel, kind))
+        specs.append((out_name, metric, kernel, kind))
     return specs
 
 
@@ -171,7 +173,7 @@ def _cached_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
     sig = (
         est_cls,
         static,
-        tuple((name, fn, kind) for name, fn, kind in scorer_specs),
+        tuple(scorer_specs),
         return_train_score,
         _meta_signature(meta),
     )
@@ -187,7 +189,7 @@ def _build_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
     """One (fold-masked fit + scores) program; vmapped by the backend."""
     fit_kernel = est_cls._build_fit_kernel(meta, static)
     decision_kernel = est_cls._build_decision_kernel(meta, static)
-    needs_proba = any(kind == "proba" for _, _, kind in scorer_specs)
+    needs_proba = any(kind == "proba" for *_, kind in scorer_specs)
     proba_kernel = (
         est_cls._build_proba_kernel(meta, static) if needs_proba else None
     )
@@ -202,7 +204,7 @@ def _build_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
         if proba_kernel is not None:
             outputs["proba"] = proba_kernel(params, X)
         scores = {}
-        for out_name, score_kernel, kind in scorer_specs:
+        for out_name, _metric, score_kernel, kind in scorer_specs:
             scores[f"test_{out_name}"] = score_kernel(y, outputs[kind], test_w, meta)
             if return_train_score:
                 scores[f"train_{out_name}"] = score_kernel(
@@ -274,10 +276,13 @@ class DistBaseSearchCV(BaseEstimator):
         self.scorer_ = scorers if multimetric else scorers["score"]
         self.n_splits_ = n_splits
 
-        if self.refit:
+        # best_* are exposed for refit=True or any single-metric run
+        # (sklearn semantics; reference search.py:538-541)
+        if self.refit or not multimetric:
             self.best_index_ = int(results[f"rank_test_{refit_metric}"].argmin())
             self.best_params_ = candidate_params[self.best_index_]
             self.best_score_ = results[f"mean_test_{refit_metric}"][self.best_index_]
+        if self.refit:
             best = clone(estimator).set_params(**self.best_params_)
             refit_start = time.perf_counter()
             if y is not None:
@@ -347,10 +352,19 @@ class DistBaseSearchCV(BaseEstimator):
         scorer_specs = _resolve_device_scoring(estimator, self.scoring)
         if scorer_specs is None:
             return None
+        # binary-only metrics must match sklearn's label semantics, else
+        # the host path (which raises/handles like sklearn) takes over
+        if any(m in BINARY_ONLY_SCORERS for _, m, *_ in scorer_specs):
+            classes = np.unique(y) if y is not None else None
+            if not all(
+                device_scorer_compatible(m, classes)
+                for _, m, *_ in scorer_specs
+            ):
+                return None
         buckets = _candidate_buckets(estimator, candidate_params)
         if buckets is None:
             return None
-        needs_proba = any(kind == "proba" for _, _, kind in scorer_specs)
+        needs_proba = any(kind == "proba" for *_, kind in scorer_specs)
         if needs_proba and not hasattr(type(estimator), "_build_proba_kernel"):
             return None
 

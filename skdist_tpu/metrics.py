@@ -193,8 +193,28 @@ DEVICE_SCORERS = {
 }
 
 
+#: metrics whose device kernels are only valid for binary problems with
+#: a positive class encoded as label 1 (sklearn's default pos_label) —
+#: anything else must take the host path so sklearn can apply its own
+#: semantics (including raising on multiclass)
+BINARY_ONLY_SCORERS = {"f1", "roc_auc"}
+
+
 def device_scorer_supported(name):
     return name in DEVICE_SCORERS
+
+
+def device_scorer_compatible(metric, classes):
+    """Whether the device kernel for ``metric`` agrees with sklearn's
+    semantics for this label set."""
+    if metric in BINARY_ONLY_SCORERS:
+        if classes is None or len(classes) != 2:
+            return False
+        try:
+            return classes[-1] == 1  # {0,1} or {-1,1}
+        except Exception:
+            return False
+    return True
 
 
 def default_device_scorer(estimator):

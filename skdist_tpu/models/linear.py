@@ -598,11 +598,8 @@ class SGDClassifier(_LinearClassifierBase):
             if penalty in ("l1", "elasticnet"):
                 l1_mul = 1.0 if penalty == "l1" else l1_ratio
 
-                def prox_grad(Wf, idx):
-                    return grad_fn(Wf, idx)
-
-                # proximal handled by wrapping the step inside sgd via
-                # penalised gradient: subgradient of l1 (cheap, adequate)
+                # l1 handled via subgradient added to the step (see class
+                # docstring for the divergence from sklearn's truncation)
                 def grad_with_l1(Wf, idx):
                     g = grad_fn(Wf, idx)
                     W = Wf.reshape(p, n_out)

@@ -127,12 +127,13 @@ class LocalBackend(TaskBackend):
 
         Same compiled program as the TPU path minus the mesh sharding, so
         local and distributed results agree bit-for-bit per device type.
+        ``round_size`` bounds tasks per compiled round (memory knob),
+        exactly as on the device backend.
         """
-        import jax
-
         fn = _jit_vmapped(kernel, static_args)
-        out = fn(shared_args, task_args)
-        return jax.device_get(out)
+        n_tasks = _leading_dim(task_args)
+        chunk = min(n_tasks, round_size or n_tasks)
+        return _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk)
 
 
 class TPUBackend(TaskBackend):
@@ -200,28 +201,37 @@ class TPUBackend(TaskBackend):
         rep_sharding = NamedSharding(self.mesh, P())
         shared_args = jax.device_put(shared_args, rep_sharding)
         fn = _jit_vmapped(kernel, static_args, task_sharding, rep_sharding)
-
-        outs = []
-        for start in range(0, n_tasks, chunk):
-            stop = min(start + chunk, n_tasks)
-            sl = jax.tree_util.tree_map(lambda a: a[start:stop], task_args)
-            pad = chunk - (stop - start)
-            if pad:
-                sl = jax.tree_util.tree_map(
-                    lambda a: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)]),
-                    sl,
-                )
-            sl = jax.device_put(sl, task_sharding)
-            out = fn(shared_args, sl)
-            out = jax.device_get(out)
-            if pad:
-                out = jax.tree_util.tree_map(lambda a: a[: stop - start], out)
-            outs.append(out)
-        if len(outs) == 1:
-            return outs[0]
-        return jax.tree_util.tree_map(
-            lambda *xs: np.concatenate(xs, axis=0), *outs
+        return _run_in_rounds(
+            fn, task_args, shared_args, n_tasks, chunk,
+            put=lambda t: jax.device_put(t, task_sharding),
         )
+
+
+def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None):
+    """Shared round loop: slice task axis, pad the tail round to the
+    fixed chunk shape (padding duplicates the last task; its outputs are
+    sliced off), run, gather to host numpy, concatenate."""
+    import jax
+
+    outs = []
+    for start in range(0, n_tasks, chunk):
+        stop = min(start + chunk, n_tasks)
+        sl = jax.tree_util.tree_map(lambda a: a[start:stop], task_args)
+        pad = chunk - (stop - start)
+        if pad:
+            sl = jax.tree_util.tree_map(
+                lambda a: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)]),
+                sl,
+            )
+        if put is not None:
+            sl = put(sl)
+        out = jax.device_get(fn(shared_args, sl))
+        if pad:
+            out = jax.tree_util.tree_map(lambda a: a[: stop - start], out)
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    return jax.tree_util.tree_map(lambda *xs: np.concatenate(xs, axis=0), *outs)
 
 
 def _leading_dim(task_args):

@@ -1,0 +1,184 @@
+"""
+DistOneVsRestClassifier / DistOneVsOneClassifier tests (reference:
+skdist/distribute/tests/test_multiclass.py + examples/multiclass).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from skdist_tpu.distribute.multiclass import (
+    DistOneVsOneClassifier,
+    DistOneVsRestClassifier,
+    _ConstantPredictor,
+    _negatives_mask,
+)
+from skdist_tpu.models import LinearSVC, LogisticRegression
+
+
+def test_ovr_batched(clf_data):
+    X, y = clf_data
+    ovr = DistOneVsRestClassifier(LogisticRegression(max_iter=100)).fit(X, y)
+    assert len(ovr.estimators_) == 3
+    assert ovr.score(X, y) >= 0.95
+    proba = ovr.predict_proba(X)
+    assert proba.shape == (len(y), 3)
+    assert (proba >= 0).all() and (proba <= 1).all()
+
+
+def test_ovr_matches_sklearn(clf_data):
+    from sklearn.multiclass import OneVsRestClassifier
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    ours = DistOneVsRestClassifier(LogisticRegression(max_iter=200)).fit(X, y)
+    sk = OneVsRestClassifier(SkLR(max_iter=500)).fit(X, y)
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.98
+
+
+def test_ovr_generic_path(clf_data):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    ovr = DistOneVsRestClassifier(SkLR(max_iter=200)).fit(X, y)
+    assert ovr.score(X, y) >= 0.95
+
+
+def test_ovr_norm(clf_data):
+    X, y = clf_data
+    ovr = DistOneVsRestClassifier(
+        LogisticRegression(max_iter=100), norm="l1"
+    ).fit(X, y)
+    proba = ovr.predict_proba(X)
+    assert np.allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_ovr_on_mesh(clf_data, tpu_backend):
+    X, y = clf_data
+    local = DistOneVsRestClassifier(LogisticRegression(max_iter=100)).fit(X, y)
+    dist = DistOneVsRestClassifier(
+        LogisticRegression(max_iter=100), backend=tpu_backend
+    ).fit(X, y)
+    # single-device vs sharded compilations may differ in fusion order;
+    # allow small float32 drift amplified through LBFGS iterations
+    np.testing.assert_allclose(
+        local.predict_proba(X), dist.predict_proba(X), atol=1e-3
+    )
+    assert (local.predict(X) == dist.predict(X)).mean() >= 0.99
+    assert dist.backend is None
+    pickle.dumps(dist)
+
+
+def test_ovr_multilabel():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(120, 6)).astype(np.float32)
+    y = [
+        tuple(c for c in (0, 1, 2) if rng.rand() < 0.4) or (0,)
+        for _ in range(120)
+    ]
+    ovr = DistOneVsRestClassifier(LogisticRegression(max_iter=50)).fit(X, y)
+    assert ovr.multilabel_
+    pred = ovr.predict(X)
+    assert pred.shape == (120, 3)
+    assert set(np.unique(pred)) <= {0, 1}
+
+
+def test_ovr_degenerate_column():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(50, 4)).astype(np.float32)
+    Y = np.zeros((50, 2), dtype=int)
+    Y[:, 0] = 1  # class 0 present everywhere; class 1 never
+    with pytest.warns(UserWarning):
+        ovr = DistOneVsRestClassifier(LogisticRegression(max_iter=50)).fit(X, Y)
+    proba = ovr.predict_proba(X)
+    assert np.allclose(proba[:, 0], 1.0)
+    assert np.allclose(proba[:, 1], 0.0)
+
+
+def test_ovr_max_negatives(clf_data):
+    X, y = clf_data
+    ovr = DistOneVsRestClassifier(
+        LogisticRegression(max_iter=100), max_negatives=0.5,
+        random_state=0,
+    ).fit(X, y)
+    assert ovr.score(X, y) >= 0.9
+    # generic path, exact subsample
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    ovr2 = DistOneVsRestClassifier(
+        SkLR(max_iter=200), max_negatives=0.5, random_state=0
+    ).fit(X, y)
+    assert ovr2.score(X, y) >= 0.9
+
+
+def test_negatives_mask_semantics():
+    X = np.arange(40).reshape(20, 2)
+    y = np.array([1] * 5 + [0] * 15)
+    Xs, ys = _negatives_mask(X, y, max_negatives=0.2, random_state=0)
+    assert (ys == 1).sum() == 5
+    assert (ys == 0).sum() == 3  # 20% of 15
+    Xs, ys = _negatives_mask(X, y, max_negatives=2, method="multiplier",
+                             random_state=0)
+    assert (ys == 0).sum() == 10  # 2 * n_pos
+    # target >= n_neg: unchanged
+    Xs, ys = _negatives_mask(X, y, max_negatives=100, random_state=0)
+    assert len(ys) == 20
+
+
+def test_ovr_nested_search(clf_data):
+    """OvR over a nested DistGridSearchCV (reference examples/search/nested.py)."""
+    from skdist_tpu.distribute.search import DistGridSearchCV
+
+    X, y = clf_data
+    inner = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [0.1, 1.0]}, cv=2,
+        scoring="accuracy",
+    )
+    ovr = DistOneVsRestClassifier(inner).fit(X, y)
+    assert ovr.score(X, y) >= 0.95
+    # nested searches are unwrapped to their best estimator
+    assert all(hasattr(e, "cv_results_") for e in ovr.estimators_)
+
+
+def test_ovo_batched(clf_data):
+    X, y = clf_data
+    ovo = DistOneVsOneClassifier(LogisticRegression(max_iter=100)).fit(X, y)
+    assert len(ovo.estimators_) == 3  # 3 choose 2
+    assert ovo.score(X, y) >= 0.95
+    dec = ovo.decision_function(X)
+    assert dec.shape == (len(y), 3)
+
+
+def test_ovo_matches_sklearn(clf_data):
+    from sklearn.multiclass import OneVsOneClassifier
+    from sklearn.svm import LinearSVC as SkSVC
+
+    X, y = clf_data
+    ours = DistOneVsOneClassifier(LinearSVC(max_iter=300)).fit(X, y)
+    sk = OneVsOneClassifier(SkSVC(max_iter=3000)).fit(X, y)
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.97
+
+
+def test_ovo_generic(clf_data):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    ovo = DistOneVsOneClassifier(SkLR(max_iter=200)).fit(X, y)
+    assert ovo.score(X, y) >= 0.95
+
+
+def test_ovo_on_mesh(clf_data, tpu_backend):
+    X, y = clf_data
+    local = DistOneVsOneClassifier(LogisticRegression(max_iter=100)).fit(X, y)
+    dist = DistOneVsOneClassifier(
+        LogisticRegression(max_iter=100), backend=tpu_backend
+    ).fit(X, y)
+    assert (local.predict(X) == dist.predict(X)).mean() == 1.0
+    pickle.dumps(dist)
+
+
+def test_constant_predictor():
+    cp = _ConstantPredictor().fit(None, np.array([1, 1]))
+    assert (cp.predict(np.zeros((3, 2))) == 1).all()
+    assert np.allclose(cp.predict_proba(np.zeros((3, 2)))[:, 1], 1.0)

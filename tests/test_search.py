@@ -193,6 +193,52 @@ def test_nested_search(clf_data):
     assert hasattr(outer, "best_estimator_")
 
 
+def test_refit_false_single_metric_exposes_best(clf_data):
+    """sklearn semantics: best_* available for single-metric refit=False
+    (regression; reference search.py:538-541)."""
+    X, y = clf_data
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [0.1, 1.0]}, cv=3,
+        scoring="accuracy", refit=False,
+    ).fit(X, y)
+    assert gs.best_params_["C"] in (0.1, 1.0)
+    assert 0 <= gs.best_score_ <= 1
+    with pytest.raises(AttributeError):
+        gs.predict(X)
+
+
+def test_binary_only_scorer_multiclass_raises(clf_data):
+    """scoring='f1' on 3-class data must NOT silently take the device
+    path (which would score last-class-only); the host path raises like
+    sklearn (regression)."""
+    X, y = clf_data
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [1.0]}, cv=3,
+        scoring="f1", error_score="raise",
+    )
+    with pytest.raises(ValueError):
+        gs.fit(X, y)
+
+
+def test_partitions_rounds_local(clf_data):
+    """partitions chunks the batched program into rounds on the local
+    backend too (regression: round_size was a silent no-op)."""
+    X, y = clf_data
+    full = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [0.1, 1.0, 10.0]}, cv=3,
+        scoring="accuracy",
+    ).fit(X, y)
+    rounds = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [0.1, 1.0, 10.0]}, cv=3,
+        scoring="accuracy", partitions=3,
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        full.cv_results_["mean_test_score"],
+        rounds.cv_results_["mean_test_score"],
+        atol=1e-6,
+    )
+
+
 def test_backend_and_template_not_mutated(clf_data, tpu_backend):
     """fit() must not leak state into the user's backend or template
     estimator (regression: round_size mutation + template stripping)."""
