@@ -3,6 +3,11 @@ Distributed meta-estimators — the core product surface, mirroring the
 reference's ``skdist/distribute/__init__.py``.
 """
 
-# extended as subsystems land (multiclass, ensemble, eliminate,
-# encoder, predict follow the reference inventory)
-__all__ = ["search"]
+__all__ = [
+    "search",
+    "multiclass",
+    "ensemble",
+    "eliminate",
+    "encoder",
+    "predict",
+]

@@ -1,0 +1,288 @@
+"""
+Distributed feature elimination (reference ``/root/reference/skdist/
+distribute/eliminate.py:47-246``).
+
+One-shot parallel approximation of sklearn's RFECV: rank features by an
+initial full fit's ``coef_``/``feature_importances_``
+(eliminate.py:141-157), build nested removal sets by ``step``
+(159-163), score every (feature_set × cv_fold) combination in parallel,
+keep the best-scoring set and refit on it (221-236).
+
+TPU-first: a removal set is a 0/1 *column mask*. For JAX estimators,
+``X·mask`` inside the kernel is exactly equivalent to dropping the
+columns (a zeroed feature's optimal weight is 0 under any L2 penalty; a
+constant feature is never split by a tree), so the whole
+(feature_set × fold) grid runs as ONE vmapped XLA program with the mask
+riding the task axis — no per-task data copies at all, where the
+reference re-broadcasts X and slices columns per executor task
+(eliminate.py:23-38,188-210).
+"""
+
+from itertools import product
+
+import numpy as np
+
+from ..base import BaseEstimator, clone, strip_runtime
+from ..metrics import (
+    BINARY_ONLY_SCORERS,
+    aggregate_score_dicts,
+    check_multimetric_scoring,
+    device_scorer_compatible,
+)
+from ..parallel import parse_partitions, resolve_backend
+from ..utils.validation import check_estimator_backend, check_is_fitted
+from .search import _fit_and_score, _resolve_device_scoring
+
+__all__ = ["DistFeatureEliminator"]
+
+
+def _drop_col(X, cols):
+    """Column-drop across container types (reference eliminate.py:23-27)."""
+    if len(cols) == 0:
+        return X
+    keep = np.setdiff1d(np.arange(X.shape[1]), cols)
+    if hasattr(X, "iloc"):
+        return X.iloc[:, keep]
+    if hasattr(X, "tocsc"):
+        return X.tocsc()[:, keep].tocsr()
+    return X[:, keep]
+
+
+class DistFeatureEliminator(BaseEstimator):
+    """Reference eliminate.py:47-246; ``backend`` replaces ``sc``."""
+
+    def __init__(self, estimator, backend=None, partitions="auto",
+                 min_features_to_select=None, step=1, cv=5, scoring=None,
+                 verbose=False, n_jobs=None, mask=True):
+        self.estimator = estimator
+        self.backend = backend
+        self.partitions = partitions
+        self.min_features_to_select = min_features_to_select
+        self.step = step
+        self.cv = cv
+        self.scoring = scoring
+        self.verbose = verbose
+        self.n_jobs = n_jobs
+        self.mask = mask
+
+    # ------------------------------------------------------------------
+    def fit(self, X, y=None, groups=None, **fit_params):
+        from sklearn.model_selection import check_cv
+        from sklearn.utils import safe_sqr
+
+        check_estimator_backend(self, self.verbose)
+        backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
+        X_arr = np.asarray(X) if not hasattr(X, "iloc") else X
+        n_features = X_arr.shape[1]
+        if n_features < 2:
+            raise ValueError("X must have at least 2 features")
+        is_classifier = (
+            getattr(self.estimator, "_estimator_type", None) == "classifier"
+        )
+        cv = check_cv(self.cv, y, classifier=is_classifier)
+        splits = list(cv.split(X_arr, y, groups))
+
+        min_keep = (
+            n_features // 2
+            if self.min_features_to_select is None
+            else self.min_features_to_select
+        )
+        step = (
+            int(max(1, self.step * n_features))
+            if 0.0 < self.step < 1.0
+            else int(self.step)
+        )
+        if step <= 0:
+            raise ValueError("Step must be >0")
+
+        # initial full fit on the driver ranks the features
+        initial = clone(self.estimator)
+        initial.fit(X_arr, y, **fit_params)
+        coefs = getattr(initial, "coef_", None)
+        if coefs is None:
+            coefs = getattr(initial, "feature_importances_", None)
+        if coefs is None:
+            raise RuntimeError(
+                'The estimator does not expose "coef_" or '
+                '"feature_importances_" attributes'
+            )
+        coefs = np.asarray(coefs)
+        ranks = (
+            np.argsort(safe_sqr(coefs).sum(axis=0))
+            if coefs.ndim > 1
+            else np.argsort(safe_sqr(coefs))
+        )
+        ranks = np.ravel(ranks)[: n_features - min_keep]
+
+        features_to_remove = [np.array([], dtype=int)]
+        removed = 0
+        while removed < n_features - min_keep:
+            removed += step
+            features_to_remove.append(ranks[:removed])
+
+        scores = self._score_feature_sets(
+            backend, X_arr, y, splits, features_to_remove, fit_params
+        )
+        self.scores_ = scores
+        # ties break toward the smaller feature set (sets are ordered by
+        # increasing removal, so take the LAST argmax)
+        best = int(len(scores) - 1 - np.argmax(scores[::-1]))
+        self.best_score_ = float(scores[best])
+        self.best_features_ = np.setdiff1d(
+            np.arange(n_features), features_to_remove[best]
+        )
+        self.n_features_ = len(self.best_features_)
+
+        final = clone(self.estimator)
+        final.fit(self._apply_mask(X_arr), y, **fit_params)
+        self.estimator_ = final
+        self.estimator = clone(self.estimator)
+        strip_runtime(self)
+        return self
+
+    def _score_feature_sets(self, backend, X, y, splits, features_to_remove,
+                            fit_params):
+        """Mean CV score per feature set; batched on device when the
+        estimator + scoring allow, generic otherwise."""
+        n_sets = len(features_to_remove)
+        n_splits = len(splits)
+        out = None
+        if not fit_params:
+            out = self._try_batched(
+                backend, X, y, splits, features_to_remove
+            )
+        if out is None:
+            scorers, multimetric = check_multimetric_scoring(
+                self.estimator, self.scoring
+            )
+            if multimetric:
+                raise ValueError(
+                    "DistFeatureEliminator supports single-metric scoring"
+                )
+            tasks = list(product(range(n_sets), range(n_splits)))
+
+            def run_one(task):
+                set_idx, split_idx = task
+                train, test = splits[split_idx]
+                Xs = _drop_col(X, features_to_remove[set_idx])
+                return _fit_and_score(
+                    self.estimator, Xs, y, scorers, train, test, {},
+                    fit_params=fit_params,
+                )["test_score"]
+
+            flat = backend.run_tasks(run_one, tasks, verbose=self.verbose)
+            out = np.asarray(flat, dtype=np.float64).reshape(
+                n_sets, n_splits
+            )
+        return out.mean(axis=1)
+
+    def _try_batched(self, backend, X, y, splits, features_to_remove):
+        est = self.estimator
+        if not hasattr(type(est), "_build_fit_kernel"):
+            return None
+        scorer_specs = _resolve_device_scoring(est, self.scoring)
+        if scorer_specs is None:
+            return None
+        if any(m in BINARY_ONLY_SCORERS for _, m, *_ in scorer_specs):
+            if not all(
+                device_scorer_compatible(m, np.unique(y))
+                for _, m, *_ in scorer_specs
+            ):
+                return None
+        from ..models.linear import as_dense_f32, _freeze
+        from .search import _cached_cv_kernel
+        import jax.numpy as jnp
+
+        try:
+            X_arr = as_dense_f32(X)
+        except Exception:
+            return None
+        n, d = X_arr.shape
+        n_splits = len(splits)
+        train_masks = np.zeros((n_splits, n), dtype=np.float32)
+        test_masks = np.zeros((n_splits, n), dtype=np.float32)
+        for i, (train, test) in enumerate(splits):
+            train_masks[i, train] = 1.0
+            test_masks[i, test] = 1.0
+
+        n_sets = len(features_to_remove)
+        fmasks = np.ones((n_sets, d), dtype=np.float32)
+        for i, rem in enumerate(features_to_remove):
+            fmasks[i, rem] = 0.0
+
+        data, meta = est._prep_fit_data(X_arr, y, None)
+        static = _freeze(est._static_config(meta))
+        base_kernel = _cached_cv_kernel(
+            type(est), meta, static, scorer_specs, False
+        )
+        hyper = {
+            k: np.float32(getattr(est, k)) for k in type(est)._hyper_names
+        }
+
+        def kernel(shared, task):
+            masked = dict(shared)
+            masked["X"] = shared["X"] * task["fmask"]
+            return base_kernel(
+                masked, {"hyper": shared["hyper"], "split": task["split"]}
+            )
+
+        shared = {
+            "X": data["X"],
+            "y": data["y"],
+            "sw": data["sw"],
+            "aux": {k: v for k, v in data.items() if k not in ("X", "y", "sw")},
+            "hyper": {k: jnp.asarray(v) for k, v in hyper.items()},
+            "train_masks": jnp.asarray(train_masks),
+            "test_masks": jnp.asarray(test_masks),
+        }
+        task_args = {
+            "fmask": np.repeat(fmasks, n_splits, axis=0),
+            "split": np.tile(
+                np.arange(n_splits, dtype=np.int32), n_sets
+            ),
+        }
+        n_tasks = n_sets * n_splits
+        round_size = parse_partitions(self.partitions, n_tasks)
+        scores = backend.batched_map(
+            kernel, task_args, shared, round_size=round_size
+        )
+        return np.asarray(
+            scores["test_score"], dtype=np.float64
+        ).reshape(n_sets, n_splits)
+
+    # ------------------------------------------------------------------
+    def _apply_mask(self, X):
+        """Column-select to the best feature set (reference
+        eliminate.py:241-246)."""
+        if not self.mask:
+            return X
+        if hasattr(X, "iloc"):
+            return X.iloc[:, self.best_features_]
+        if hasattr(X, "tocsc"):
+            return X.tocsc()[:, self.best_features_].tocsr()
+        return np.asarray(X)[:, self.best_features_]
+
+    def predict(self, X):
+        check_is_fitted(self, "estimator_")
+        return self.estimator_.predict(self._apply_mask(X))
+
+    def predict_proba(self, X):
+        check_is_fitted(self, "estimator_")
+        return self.estimator_.predict_proba(self._apply_mask(X))
+
+    def predict_log_proba(self, X):
+        check_is_fitted(self, "estimator_")
+        return self.estimator_.predict_log_proba(self._apply_mask(X))
+
+    def decision_function(self, X):
+        check_is_fitted(self, "estimator_")
+        return self.estimator_.decision_function(self._apply_mask(X))
+
+    def score(self, X, y=None):
+        check_is_fitted(self, "estimator_")
+        return self.estimator_.score(self._apply_mask(X), y)
+
+    @property
+    def classes_(self):
+        check_is_fitted(self, "estimator_")
+        return self.estimator_.classes_

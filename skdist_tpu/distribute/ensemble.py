@@ -1,0 +1,163 @@
+"""
+Distributed forests (reference ``/root/reference/skdist/distribute/
+ensemble.py:154-716``).
+
+The reference's concrete classes are diamond-inheritance shims that add
+``sc``/``partitions`` to sklearn forests and swap the per-tree loop for
+``sc.parallelize(states).map(_build_trees).collect()``
+(ensemble.py:304-322). Here the same shape holds, one level down: the
+Dist* classes add ``backend``/``partitions`` to the skdist_tpu forest
+kernels and route the tree axis through ``backend.batched_map``, so
+trees shard over the TPU mesh in rounds instead of Spark executors.
+Post-fit, the backend handle is stripped so the artifact pickles clean
+(the reference's ``del self.sc``, ensemble.py:335).
+"""
+
+from ..base import strip_runtime
+from ..models.forest import (
+    ExtraTreesClassifier,
+    ExtraTreesRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    RandomTreesEmbedding,
+)
+from ..parallel import parse_partitions, resolve_backend
+from ..utils.validation import check_estimator_backend
+
+__all__ = [
+    "DistRandomForestClassifier",
+    "DistRandomForestRegressor",
+    "DistExtraTreesClassifier",
+    "DistExtraTreesRegressor",
+    "DistRandomTreesEmbedding",
+]
+
+
+class _DistForestMixin:
+    """Adds backend/partitions routing to a forest class: the host
+    class's ``fit`` calls ``_resolve_fit_backend`` for its
+    ``batched_map`` dispatch."""
+
+    def _resolve_fit_backend(self):
+        backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
+        n_more = self.n_estimators - (
+            int(self._trees["feat"].shape[0])
+            if self.warm_start and hasattr(self, "_trees")
+            else 0
+        )
+        round_size = parse_partitions(self.partitions, max(n_more, 1))
+        return backend, round_size
+
+    def fit(self, X, y=None, sample_weight=None):
+        check_estimator_backend(self, self.verbose)
+        super().fit(X, y, sample_weight=sample_weight)
+        strip_runtime(self)
+        return self
+
+
+class DistRandomForestClassifier(_DistForestMixin, RandomForestClassifier):
+    """Reference ensemble.py:365-421."""
+
+    def __init__(self, n_estimators=100, backend=None, partitions="auto",
+                 max_depth=8, n_bins=32, max_features="sqrt",
+                 min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, bootstrap=True, warm_start=False,
+                 random_state=None, n_jobs=None, verbose=0):
+        RandomForestClassifier.__init__(
+            self, n_estimators=n_estimators, max_depth=max_depth,
+            n_bins=n_bins, max_features=max_features,
+            min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+        )
+        self.backend = backend
+        self.partitions = partitions
+        self.verbose = verbose
+
+
+class DistRandomForestRegressor(_DistForestMixin, RandomForestRegressor):
+    """Reference ensemble.py:505-559."""
+
+    def __init__(self, n_estimators=100, backend=None, partitions="auto",
+                 max_depth=8, n_bins=32, max_features=1.0,
+                 min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, bootstrap=True, warm_start=False,
+                 random_state=None, n_jobs=None, verbose=0):
+        RandomForestRegressor.__init__(
+            self, n_estimators=n_estimators, max_depth=max_depth,
+            n_bins=n_bins, max_features=max_features,
+            min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+        )
+        self.backend = backend
+        self.partitions = partitions
+        self.verbose = verbose
+
+
+class DistExtraTreesClassifier(_DistForestMixin, ExtraTreesClassifier):
+    """Reference ensemble.py:424-480."""
+
+    def __init__(self, n_estimators=100, backend=None, partitions="auto",
+                 max_depth=8, n_bins=32, max_features="sqrt",
+                 min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, bootstrap=False, warm_start=False,
+                 random_state=None, n_jobs=None, verbose=0):
+        ExtraTreesClassifier.__init__(
+            self, n_estimators=n_estimators, max_depth=max_depth,
+            n_bins=n_bins, max_features=max_features,
+            min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+        )
+        self.backend = backend
+        self.partitions = partitions
+        self.verbose = verbose
+
+
+class DistExtraTreesRegressor(_DistForestMixin, ExtraTreesRegressor):
+    """Reference ensemble.py:562-616."""
+
+    def __init__(self, n_estimators=100, backend=None, partitions="auto",
+                 max_depth=8, n_bins=32, max_features=1.0,
+                 min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, bootstrap=False, warm_start=False,
+                 random_state=None, n_jobs=None, verbose=0):
+        ExtraTreesRegressor.__init__(
+            self, n_estimators=n_estimators, max_depth=max_depth,
+            n_bins=n_bins, max_features=max_features,
+            min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+        )
+        self.backend = backend
+        self.partitions = partitions
+        self.verbose = verbose
+
+
+class DistRandomTreesEmbedding(_DistForestMixin, RandomTreesEmbedding):
+    """Reference ensemble.py:619-716."""
+
+    def __init__(self, n_estimators=100, backend=None, partitions="auto",
+                 max_depth=5, n_bins=32, min_samples_split=2,
+                 min_samples_leaf=1, min_impurity_decrease=0.0,
+                 sparse_output=True, warm_start=False, random_state=None,
+                 n_jobs=None, verbose=0):
+        RandomTreesEmbedding.__init__(
+            self, n_estimators=n_estimators, max_depth=max_depth,
+            n_bins=n_bins, min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease,
+            sparse_output=sparse_output, warm_start=warm_start,
+            random_state=random_state, n_jobs=n_jobs,
+        )
+        self.backend = backend
+        self.partitions = partitions
+        self.verbose = verbose
+
+    def fit_transform(self, X, y=None, sample_weight=None):
+        return self.fit(X, y, sample_weight=sample_weight).transform(X)

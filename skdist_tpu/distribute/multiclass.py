@@ -33,7 +33,7 @@ import warnings
 import numpy as np
 
 from ..base import BaseEstimator, ClassifierMixin, clone, strip_runtime
-from ..parallel import resolve_backend
+from ..parallel import parse_partitions, resolve_backend
 from ..utils.validation import check_estimator_backend, check_is_fitted, safe_split
 
 __all__ = ["DistOneVsRestClassifier", "DistOneVsOneClassifier"]
@@ -42,6 +42,10 @@ __all__ = ["DistOneVsRestClassifier", "DistOneVsOneClassifier"]
 # ---------------------------------------------------------------------------
 # shared helpers
 # ---------------------------------------------------------------------------
+
+def _n_rows(X):
+    return X.shape[0] if hasattr(X, "shape") else len(X)
+
 
 class _ConstantPredictor(BaseEstimator):
     """Degenerate single-class column fallback (reference
@@ -52,14 +56,14 @@ class _ConstantPredictor(BaseEstimator):
         return self
 
     def predict(self, X):
-        return np.repeat(self.y_, len(X))
+        return np.repeat(self.y_, _n_rows(X))
 
     def decision_function(self, X):
-        return np.repeat(float(2 * self.y_[0] - 1), len(X))
+        return np.repeat(float(2 * self.y_[0] - 1), _n_rows(X))
 
     def predict_proba(self, X):
         p = float(self.y_[0])
-        return np.repeat([[1.0 - p, p]], len(X), axis=0)
+        return np.repeat([[1.0 - p, p]], _n_rows(X), axis=0)
 
 
 def _use_best_estimator(est):
@@ -125,16 +129,32 @@ def _fit_binary(estimator, X, y, fit_params=None, classes=None,
 
 
 def _label_matrix(y, classes=None):
-    """y (labels / sequences-of-labels / binary matrix) → (Y, classes,
-    multilabel). Y is int32 (n, k)."""
-    y = np.asarray(y, dtype=object) if _is_sequence_of_seqs(y) else np.asarray(y)
-    if y.dtype == object or (y.ndim == 1 and _is_sequence_of_seqs(y)):
+    """y (labels / sequences-of-labels / binary indicator matrix) →
+    (Y, classes, multilabel). Y is int32 (n, k).
+
+    Only *sequences of label collections* are multilabel; 1-D object
+    arrays of scalar labels (e.g. strings) are ordinary multiclass —
+    iterating a string as characters is never intended."""
+    if _is_sequence_of_seqs(y):
         from sklearn.preprocessing import MultiLabelBinarizer
 
         mlb = MultiLabelBinarizer()
         Y = mlb.fit_transform(y)
         return Y.astype(np.int32), mlb.classes_, True
-    if y.ndim == 2:  # already a binary indicator matrix
+    y = np.asarray(y)
+    if y.ndim == 2 and y.shape[1] == 1:
+        # column vector of labels, as sklearn ravels (with a warning)
+        warnings.warn(
+            "A column-vector y was passed; ravelling to 1-D labels.",
+        )
+        y = y.ravel()
+    if y.ndim == 2:
+        # binary indicator matrix — validate it actually is one
+        if not np.isin(np.unique(y), (0, 1)).all():
+            raise ValueError(
+                "2-D y must be a binary indicator matrix (values 0/1); "
+                "got other values. For multiclass labels pass 1-D y."
+            )
         classes = np.arange(y.shape[1]) if classes is None else classes
         return y.astype(np.int32), np.asarray(classes), True
     classes, y_idx = np.unique(y, return_inverse=True)
@@ -145,7 +165,7 @@ def _label_matrix(y, classes=None):
 
 def _is_sequence_of_seqs(y):
     try:
-        first = y[0]
+        first = y[0] if not hasattr(y, "iloc") else y.iloc[0]
     except (TypeError, IndexError, KeyError):
         return False
     return isinstance(first, (list, tuple, set, frozenset))
@@ -190,6 +210,10 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
 
     def fit(self, X, y, **fit_params):
         check_estimator_backend(self, self.verbose)
+        if self.method not in ("ratio", "multiplier"):
+            raise ValueError(
+                "Unknown method. Options are 'ratio' or 'multiplier'."
+            )
         backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
         Y, classes, multilabel = _label_matrix(y)
         self.classes_ = classes
@@ -208,7 +232,15 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
     # -- batched device path -------------------------------------------
     def _try_batched(self, backend, X, Y):
         est = self.estimator
-        if not hasattr(type(est), "_build_fit_kernel"):
+        from ..models.linear import _LinearModelBase
+
+        # batched binary fits currently cover the linear-kernel family;
+        # tree/forest bases take the generic per-task path
+        if not isinstance(est, _LinearModelBase):
+            return None
+        # dict class_weight is keyed by original labels, which do not
+        # map onto the {0,1} binary sub-problems -> generic path
+        if isinstance(getattr(est, "class_weight", None), dict):
             return None
         from ..models.linear import as_dense_f32, _freeze, get_kernel
         import jax
@@ -272,7 +304,10 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         estimators = [None] * n_classes
         if live.size:
             task_args = {"cls": live.astype(np.int32)}
-            stacked = backend.batched_map(kernel, task_args, shared)
+            stacked = backend.batched_map(
+                kernel, task_args, shared,
+                round_size=parse_partitions(self.partitions, int(live.size)),
+            )
             for pos_idx, cls_idx in enumerate(live):
                 params = jax.tree_util.tree_map(lambda a: a[pos_idx], stacked)
                 estimators[cls_idx] = _make_fitted_binary(est, params, meta)
@@ -388,7 +423,15 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
 
     def _try_batched(self, backend, X, y):
         est = self.estimator
-        if not hasattr(type(est), "_build_fit_kernel"):
+        from ..models.linear import _LinearModelBase
+
+        # batched binary fits currently cover the linear-kernel family;
+        # tree/forest bases take the generic per-task path
+        if not isinstance(est, _LinearModelBase):
+            return None
+        # dict class_weight is keyed by original labels, which do not
+        # map onto the {0,1} binary sub-problems -> generic path
+        if isinstance(getattr(est, "class_weight", None), dict):
             return None
         from ..models.linear import as_dense_f32, _freeze
         import jax
@@ -427,7 +470,10 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
             "i": np.asarray([p[0] for p in self.pairs_], dtype=np.int32),
             "j": np.asarray([p[1] for p in self.pairs_], dtype=np.int32),
         }
-        stacked = backend.batched_map(kernel, task_args, shared)
+        stacked = backend.batched_map(
+            kernel, task_args, shared,
+            round_size=parse_partitions(self.partitions, len(self.pairs_)),
+        )
         self.estimators_ = [
             _make_fitted_binary(
                 est, jax.tree_util.tree_map(lambda a: a[t], stacked), meta
@@ -456,7 +502,7 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
         """sklearn-style OvO aggregation: votes plus a bounded
         sum-of-confidences tie-break."""
         check_is_fitted(self, "estimators_")
-        n = len(X) if hasattr(X, "__len__") else X.shape[0]
+        n = _n_rows(X)
         k = len(self.classes_)
         votes = np.zeros((n, k))
         sum_conf = np.zeros((n, k))

@@ -1,0 +1,148 @@
+"""
+Large-scale batch prediction (reference ``/root/reference/skdist/
+distribute/predict.py:59-179``).
+
+The reference wraps a fitted model's ``predict``/``predict_proba`` in a
+pyarrow-vectorised pandas UDF so Spark streams DataFrame partitions
+through it. The TPU-native analogue has two layers:
+
+- :func:`get_prediction_udf` — API-compatible factory: returns a
+  callable over pandas Series columns (the reference's three feature
+  layouts: 'numpy' column-stack, 'pandas' named frame, 'text' single
+  column — predict.py:59-71) producing a pandas Series of predictions
+  (or list-valued Series of probabilities).
+- :func:`batch_predict` — the throughput path: rows are cut into
+  fixed-size blocks that ride the mapped task axis across the TPU mesh
+  (``backend.batched_map``), so inference over millions of rows is a
+  handful of sharded XLA dispatches with no per-row Python. Host
+  (sklearn) models fall back to thread-chunked predict.
+"""
+
+import numpy as np
+import pandas as pd
+
+from ..parallel import resolve_backend
+
+__all__ = ["get_prediction_udf", "batch_predict"]
+
+
+def _get_vals(cols, feature_type, names):
+    """Assemble feature matrix from column Series (reference
+    predict.py:59-71)."""
+    if feature_type == "numpy":
+        return np.column_stack([np.asarray(c) for c in cols])
+    if feature_type == "pandas":
+        if names is None:
+            raise ValueError("feature_type='pandas' requires names")
+        return pd.DataFrame(
+            {name: np.asarray(c) for name, c in zip(names, cols)}
+        )[list(names)]
+    if feature_type == "text":
+        if len(cols) != 1:
+            raise ValueError("feature_type='text' expects exactly one column")
+        return np.asarray(cols[0])
+    raise ValueError(f"Unknown feature_type: {feature_type!r}")
+
+
+def get_prediction_udf(model, method="predict", feature_type="numpy",
+                       names=None, backend=None, batch_size=None):
+    """Build a columnar prediction function (reference predict.py:74-179).
+
+    Returns ``predict_func(*cols) -> pd.Series``; probabilities come
+    back as a Series of lists (the reference's Array(Double) UDF
+    return type).
+    """
+    if method not in ("predict", "predict_proba"):
+        raise ValueError("method must be 'predict' or 'predict_proba'")
+    if not hasattr(model, method):
+        raise ValueError(f"model has no {method} method")
+
+    def predict_func(*cols):
+        X = _get_vals(cols, feature_type, names)
+        out = batch_predict(
+            model, X, method=method, backend=backend, batch_size=batch_size
+        )
+        if method == "predict_proba":
+            return pd.Series(list(np.asarray(out)))
+        return pd.Series(np.asarray(out))
+
+    return predict_func
+
+
+def batch_predict(model, X, method="predict", backend=None,
+                  batch_size=None):
+    """Predict over X in device-sharded row blocks.
+
+    JAX estimators (anything exposing the batched-kernel contract) run
+    their decision/proba kernel with row blocks on the mapped axis of
+    the mesh; other models run thread-chunked on host.
+    """
+    backend = resolve_backend(backend)
+    fn = getattr(model, method)
+    n = X.shape[0] if hasattr(X, "shape") else len(X)
+    if batch_size is None:
+        batch_size = max(1, min(n, 1 << 18))
+
+    device_out = _try_device_predict(model, X, method, backend, batch_size)
+    if device_out is not None:
+        return device_out
+
+    if n <= batch_size:
+        return np.asarray(fn(X))
+    chunks = [
+        (X.iloc[i:i + batch_size] if hasattr(X, "iloc")
+         else X[i:i + batch_size])
+        for i in range(0, n, batch_size)
+    ]
+    outs = backend.run_tasks(lambda c: np.asarray(fn(c)), chunks)
+    return np.concatenate(outs, axis=0)
+
+
+def _try_device_predict(model, X, method, backend, batch_size):
+    """Mesh-sharded inference for JAX estimators; None → host path."""
+    if not hasattr(model, "_params") or not hasattr(model, "_meta"):
+        return None
+    from ..models.linear import _freeze, as_dense_f32, get_kernel
+    import jax
+    import jax.numpy as jnp
+
+    which = "proba" if method == "predict_proba" else "decision"
+    try:
+        kernel = get_kernel(
+            type(model), which, model._meta,
+            _freeze(model._static_config(model._meta)),
+        )
+    except AttributeError:
+        return None
+
+    try:
+        X_arr = as_dense_f32(X)
+    except Exception:
+        return None
+    n, d = X_arr.shape
+    block = min(batch_size, max(1, n))
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    if pad:
+        X_arr = np.concatenate([X_arr, np.repeat(X_arr[-1:], pad, axis=0)])
+    blocks = X_arr.reshape(n_blocks, block, d)
+
+    params = jax.tree_util.tree_map(jnp.asarray, model._params)
+
+    def block_kernel(shared, task):
+        return {"out": kernel(shared["params"], task["X"])}
+
+    out = backend.batched_map(
+        block_kernel, {"X": blocks}, {"params": params}
+    )["out"]
+    out = out.reshape(-1, *out.shape[2:])[:n]
+
+    if method == "predict":
+        if getattr(model, "_estimator_type", None) == "classifier":
+            if out.ndim == 1:
+                idx = (out > 0).astype(np.int64)
+            else:
+                idx = np.argmax(out, axis=1)
+            return model.classes_[idx]
+        return out
+    return out

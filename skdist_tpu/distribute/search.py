@@ -1,7 +1,6 @@
 """
 Distributed hyperparameter search: ``DistGridSearchCV``,
-``DistRandomizedSearchCV`` (and, in a later milestone,
-``DistMultiModelSearch``).
+``DistRandomizedSearchCV``, ``DistMultiModelSearch``.
 
 Re-design of the reference flagship (``/root/reference/skdist/distribute/
 search.py:291-714``). The reference enumerates ``fit_sets =
@@ -54,7 +53,12 @@ from ..utils.validation import (
     safe_split,
 )
 
-__all__ = ["DistBaseSearchCV", "DistGridSearchCV", "DistRandomizedSearchCV"]
+__all__ = [
+    "DistBaseSearchCV",
+    "DistGridSearchCV",
+    "DistRandomizedSearchCV",
+    "DistMultiModelSearch",
+]
 
 
 # ---------------------------------------------------------------------------
@@ -198,7 +202,7 @@ def _build_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
         X, y, sw = shared["X"], shared["y"], shared["sw"]
         train_w = sw * shared["train_masks"][task["split"]]
         test_w = sw * shared["test_masks"][task["split"]]
-        params = fit_kernel(X, y, train_w, task["hyper"])
+        params = fit_kernel(X, y, train_w, task["hyper"], shared["aux"])
         outputs = {"decision": decision_kernel(params, X)}
         outputs["predict"] = outputs["decision"]
         if proba_kernel is not None:
@@ -404,6 +408,9 @@ class DistBaseSearchCV(BaseEstimator):
                 "X": data["X"],
                 "y": data["y"],
                 "sw": data["sw"],
+                "aux": {
+                    k: v for k, v in data.items() if k not in ("X", "y", "sw")
+                },
                 "train_masks": jnp.asarray(train_masks),
                 "test_masks": jnp.asarray(test_masks),
             }
@@ -605,3 +612,230 @@ class DistRandomizedSearchCV(DistBaseSearchCV):
         return ParameterSampler(
             self.param_distributions, n_iter, random_state=self.random_state
         )
+
+
+# ---------------------------------------------------------------------------
+# DistMultiModelSearch (reference search.py:717-908)
+# ---------------------------------------------------------------------------
+
+def _sample_one(n_iter, param_distributions, random_state=None):
+    """Sample param sets for one model (reference search.py:60-68)."""
+    from sklearn.model_selection import ParameterSampler
+
+    return list(
+        ParameterSampler(
+            param_distributions,
+            n_iter=check_n_iter(n_iter, param_distributions),
+            random_state=random_state,
+        )
+    )
+
+
+def _raw_sampler(models, n_params=None, n=None, random_state=None):
+    """Sample param sets for every model (reference search.py:71-90).
+    Returns dicts {model_index, params_index, param_set}."""
+    if n_params is None:
+        if n is None:
+            raise ValueError("Must supply either 'n_params' or 'n'")
+        n_params = [n] * len(models)
+    param_sets = []
+    for index in range(len(models)):
+        sampler = _sample_one(
+            n_params[index], models[index][2], random_state=random_state
+        )
+        for sample_index, sample in enumerate(sampler):
+            param_sets.append({
+                "model_index": index,
+                "params_index": sample_index,
+                "param_set": sample,
+            })
+    return param_sets
+
+
+def _validate_models(models):
+    """Input validation (reference validation.py:32-96)."""
+    if not models:
+        raise ValueError("models must be a non-empty list of tuples")
+    names = [m[0] for m in models]
+    if len(set(names)) != len(names):
+        raise ValueError(f"Duplicate model names: {names}")
+    for m in models:
+        if len(m) != 3:
+            raise ValueError(
+                "each model must be ('name', estimator, param_dict)"
+            )
+        name, est, params = m
+        if not isinstance(name, str):
+            raise ValueError(f"model name must be str, got {name!r}")
+        if not hasattr(est, "fit"):
+            raise ValueError(f"estimator {est!r} has no fit method")
+        if not isinstance(params, dict):
+            raise ValueError(f"param set must be dict, got {params!r}")
+    return list(models)
+
+
+class DistMultiModelSearch(BaseEstimator):
+    """Randomized search across heterogeneous model families
+    (reference search.py:717-908): ``models`` is a list of
+    ``(name, estimator, param_distributions)`` tuples; ``n`` param sets
+    are sampled per model, each scored by CV, and the winning
+    (model, params) combination refit.
+
+    Per-model execution reuses the grid-search scheduler, so a JAX
+    estimator's candidates run as one batched device program while a
+    host estimator in the same `models` list fans out over threads.
+    """
+
+    def __init__(self, models, backend=None, partitions="auto", n=5, cv=5,
+                 scoring=None, random_state=None, verbose=0, refit=True,
+                 n_jobs=None):
+        self.models = models
+        self.backend = backend
+        self.partitions = partitions
+        self.n = n
+        self.cv = cv
+        self.scoring = scoring
+        self.random_state = random_state
+        self.verbose = verbose
+        self.refit = refit
+        self.n_jobs = n_jobs
+
+    def fit(self, X, y=None, groups=None, **fit_params):
+        import pandas as pd
+        from sklearn.model_selection import check_cv
+
+        check_estimator_backend(self, self.verbose)
+        backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
+        models = _validate_models(self.models)
+        is_classifier = (
+            getattr(models[0][1], "_estimator_type", None) == "classifier"
+        )
+        cv = check_cv(self.cv, y, classifier=is_classifier)
+        splits = list(cv.split(X, y, groups))
+        n_splits = len(splits)
+        param_sets = _raw_sampler(models, n=self.n,
+                                  random_state=self.random_state)
+
+        # evaluate model-by-model through the shared scheduler: each
+        # model's candidates batch on device when possible
+        rows = []
+        for index, (name, estimator, _dists) in enumerate(models):
+            cands = [p["param_set"] for p in param_sets
+                     if p["model_index"] == index]
+            if not cands:
+                continue
+            scorers, multimetric = check_multimetric_scoring(
+                estimator, self.scoring
+            )
+            if multimetric:
+                raise ValueError(
+                    "DistMultiModelSearch supports single-metric scoring"
+                )
+            shim = DistBaseSearchCV(
+                estimator, partitions=self.partitions, cv=self.cv,
+                scoring=self.scoring, error_score=np.nan,
+                n_jobs=self.n_jobs, verbose=self.verbose,
+            )
+            out = shim._run_search_tasks(
+                backend, estimator, X, y, cands, splits, scorers, fit_params
+            )
+            scores = np.asarray(
+                [o["test_score"] for o in out], dtype=np.float64
+            ).reshape(len(cands), n_splits)
+            for pi, cand in enumerate(cands):
+                rows.append({
+                    "model_index": index,
+                    "params_index": pi,
+                    "param_set": cand,
+                    "score": scores[pi].mean(),
+                })
+
+        results = pd.DataFrame(
+            rows, columns=["model_index", "params_index", "param_set", "score"]
+        )
+        model_results = (
+            results.groupby("model_index")["score"].max().reset_index()
+            .sort_values("model_index")
+        )
+        if self.verbose:
+            print(model_results)
+
+        score_vals = results["score"].values.astype(float)
+        if np.all(np.isnan(score_vals)):
+            raise RuntimeError(
+                "All candidate fits failed (every score is NaN)."
+            )
+        best_index = int(np.nanargmax(score_vals))
+        self.best_model_index_ = int(results.iloc[best_index]["model_index"])
+        self.best_model_name_ = models[self.best_model_index_][0]
+        self.best_params_ = results.iloc[best_index]["param_set"]
+        self.best_score_ = float(results.iloc[best_index]["score"])
+        # the reference set worst_score_ = best_score_ (a known bug,
+        # search.py:836-837); we record the actual worst
+        self.worst_score_ = float(np.nanmin(score_vals))
+
+        results = results.copy()
+        results["rank_test_score"] = np.asarray(
+            rankdata(-results["score"].values), dtype=np.int32
+        )
+        results["mean_test_score"] = results["score"]
+        results["params"] = results["param_set"]
+        results["model_name"] = results["model_index"].map(
+            lambda i: models[i][0]
+        )
+        self.cv_results_ = results[[
+            "model_index", "model_name", "params", "rank_test_score",
+            "mean_test_score",
+        ]].to_dict(orient="list")
+
+        if self.refit:
+            best = clone(models[self.best_model_index_][1])
+            best.set_params(**self.best_params_)
+            if y is not None:
+                best.fit(X, y, **fit_params)
+            else:
+                best.fit(X, **fit_params)
+            self.best_estimator_ = best
+        self.models = [
+            (name, clone(est), dists) for name, est, dists in self.models
+        ]
+        strip_runtime(self)
+        return self
+
+    # -- post-fit delegation -------------------------------------------
+    def _check_is_fitted(self):
+        if not self.refit:
+            raise AttributeError(
+                f"This {type(self).__name__} instance was initialized with "
+                "refit=False; predict-side methods need refit=True."
+            )
+        check_is_fitted(self, "best_estimator_")
+
+    def predict(self, X):
+        self._check_is_fitted()
+        return self.best_estimator_.predict(X)
+
+    def predict_proba(self, X):
+        self._check_is_fitted()
+        return self.best_estimator_.predict_proba(X)
+
+    def predict_log_proba(self, X):
+        self._check_is_fitted()
+        return self.best_estimator_.predict_log_proba(X)
+
+    def decision_function(self, X):
+        self._check_is_fitted()
+        return self.best_estimator_.decision_function(X)
+
+    def transform(self, X):
+        self._check_is_fitted()
+        return self.best_estimator_.transform(X)
+
+    def inverse_transform(self, Xt):
+        self._check_is_fitted()
+        return self.best_estimator_.inverse_transform(Xt)
+
+    @property
+    def classes_(self):
+        self._check_is_fitted()
+        return self.best_estimator_.classes_

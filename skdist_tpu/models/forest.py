@@ -1,8 +1,39 @@
 """
-Forest kernels (placeholder — implemented in the ensemble milestone).
+Forest kernels: RandomForest / ExtraTrees (classifier + regressor) and
+RandomTreesEmbedding.
+
+Where the reference ships one Spark task per tree — broadcast the data,
+``sc.parallelize(seeds).map(_build_trees).collect()`` the fitted Cython
+trees back (``/root/reference/skdist/distribute/ensemble.py:278-325``) —
+here the tree axis is the vmapped task axis of ONE histogram-tree
+program (``models/tree.py``): per-tree PRNG seeds ride the task axis,
+bootstrap resampling is a scatter-add count vector times the sample
+weights (the reference's ``_generate_sample_indices`` + bincount,
+ensemble.py:51-55,88-104, done on device), and the fitted forest is a
+stacked pytree of tree arrays living in host memory. The distributed
+wrappers (``distribute/ensemble.py``) shard the same axis over the TPU
+mesh via ``backend.batched_map``.
 """
 
+import numpy as np
+import jax
+import jax.numpy as jnp
+
 from ..base import BaseEstimator, ClassifierMixin, RegressorMixin, TransformerMixin
+from ..ops.binning import apply_bins, quantile_bin_edges
+from ..parallel import LocalBackend
+from .linear import as_dense_f32, encode_labels, prepare_sample_weight
+from .tree import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    build_tree_kernel,
+    classification_channels,
+    feature_importances_from_tree,
+    n_tree_nodes,
+    regression_channels,
+    resolve_max_features,
+    tree_predict_kernel,
+)
 
 __all__ = [
     "RandomForestClassifier",
@@ -12,27 +43,361 @@ __all__ = [
     "RandomTreesEmbedding",
 ]
 
+MAX_RAND_SEED = np.iinfo(np.int32).max
 
-class _ForestStub(BaseEstimator):
+# module-level cache of jitted forest walkers: jax.jit caches on function
+# identity, so per-call closures would recompile on every predict
+_WALKER_CACHE = {}
+
+
+def _forest_walker(max_depth, mode):
+    key = (max_depth, mode)
+    fn = _WALKER_CACHE.get(key)
+    if fn is None:
+        walk = tree_predict_kernel(max_depth, return_nodes=(mode == "apply"))
+
+        if mode == "apply":
+            @jax.jit
+            def fn(trees, Xb):
+                return jax.vmap(lambda t: walk(t, Xb))(trees).T  # (n, T)
+        else:
+            @jax.jit
+            def fn(trees, Xb):
+                per_tree = jax.vmap(lambda t: walk(t, Xb))(trees)  # (T,n,K)
+                return jnp.mean(per_tree, axis=0)
+
+        _WALKER_CACHE[key] = fn
+    return fn
+
+
+def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
+                            min_samples_split, min_samples_leaf,
+                            min_impurity_decrease, extra, classification,
+                            bootstrap):
+    """One-tree task kernel for ``backend.batched_map``: the task is a
+    scalar PRNG seed (mirroring the reference's per-tree random states,
+    ensemble.py:278)."""
+    grow = build_tree_kernel(
+        n_features=d, n_bins=n_bins, channels=channels, max_depth=max_depth,
+        max_features=max_features, min_samples_split=min_samples_split,
+        min_samples_leaf=min_samples_leaf,
+        min_impurity_decrease=min_impurity_decrease, extra=extra,
+        classification=classification,
+    )
+    K = channels - 1 if classification else 1
+
+    def kernel(shared, task):
+        Xb, y, sw = shared["Xb"], shared["y"], shared["sw"]
+        n = Xb.shape[0]
+        key = jax.random.PRNGKey(task["seed"])
+        kboot, kgrow = jax.random.split(key)
+        w = sw
+        if bootstrap:
+            idx = jax.random.randint(kboot, (n,), 0, n)
+            counts = jnp.zeros((n,), sw.dtype).at[idx].add(1.0)
+            w = sw * counts
+        if classification:
+            Ych = classification_channels(y, w, K)
+        else:
+            Ych = regression_channels(y, w)
+        return grow(Xb, Ych, kgrow)
+
+    return kernel
+
+
+class _BaseForest(BaseEstimator):
+    """Shared forest machinery; subclasses set ``_extra`` (random
+    thresholds) and classification/regression via mixins.
+
+    ``warm_start=True`` keeps previously grown trees and appends
+    ``n_estimators - len(grown)`` new ones (reference ensemble.py:250-272).
+    """
+
+    _extra = False
+
+    def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
+                 max_features="sqrt", min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, bootstrap=True, warm_start=False,
+                 random_state=None, n_jobs=None):
+        self.n_estimators = n_estimators
+        self.max_depth = max_depth
+        self.n_bins = n_bins
+        self.max_features = max_features
+        self.min_samples_split = min_samples_split
+        self.min_samples_leaf = min_samples_leaf
+        self.min_impurity_decrease = min_impurity_decrease
+        self.bootstrap = bootstrap
+        self.warm_start = warm_start
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+
+    @property
+    def _classification(self):
+        return isinstance(self, ClassifierMixin)
+
+    # distributed wrappers override to route through their backend
+    def _resolve_fit_backend(self):
+        return LocalBackend(n_jobs=self.n_jobs), None
+
+    def fit(self, X, y, sample_weight=None):
+        X = as_dense_f32(X)
+        n, d = X.shape
+        sw = prepare_sample_weight(sample_weight, n)
+        warm = self.warm_start and getattr(self, "_trees", None) is not None
+        if warm:
+            # existing trees' thresholds are bin ids under the original
+            # edges — a warm refit must keep binning consistent
+            edges = self._edges
+        else:
+            edges = quantile_bin_edges(X, self.n_bins)
+
+        if self._classification:
+            y_enc, classes = encode_labels(y)
+            self.classes_ = classes
+            K = len(classes)
+            channels = K + 1
+        else:
+            y_enc = np.asarray(y, dtype=np.float32)
+            K = 1
+            channels = 4
+
+        prev = getattr(self, "_trees", None) if warm else None
+        n_prev = 0
+        if prev is not None:
+            n_prev = int(prev["feat"].shape[0])
+        n_more = self.n_estimators - n_prev
+        if n_more < 0:
+            raise ValueError(
+                f"warm_start: n_estimators={self.n_estimators} is smaller "
+                f"than the {n_prev} trees already grown"
+            )
+
+        if n_more > 0:
+            rng = np.random.RandomState(self.random_state)
+            if n_prev:  # advance the stream past already-drawn seeds
+                rng.randint(MAX_RAND_SEED, size=n_prev)
+            seeds = rng.randint(MAX_RAND_SEED, size=n_more).astype(np.int32)
+            kernel = make_forest_tree_kernel(
+                d=d, n_bins=self.n_bins, channels=channels,
+                max_depth=self.max_depth,
+                max_features=resolve_max_features(self.max_features, d),
+                min_samples_split=self.min_samples_split,
+                min_samples_leaf=self.min_samples_leaf,
+                min_impurity_decrease=self.min_impurity_decrease,
+                extra=self._extra, classification=self._classification,
+                bootstrap=self.bootstrap,
+            )
+            backend, round_size = self._resolve_fit_backend()
+            Xb = np.asarray(apply_bins(jnp.asarray(X), jnp.asarray(edges)))
+            shared = {
+                "Xb": jnp.asarray(Xb),
+                "y": jnp.asarray(y_enc),
+                "sw": jnp.asarray(sw),
+            }
+            new_trees = backend.batched_map(
+                kernel, {"seed": seeds}, shared, round_size=round_size
+            )
+            if prev is not None:
+                self._trees = jax.tree_util.tree_map(
+                    lambda a, b: np.concatenate([a, b], axis=0), prev, new_trees
+                )
+            else:
+                self._trees = new_trees
+        self._edges = edges
+        self.n_features_in_ = d
+        return self
+
+    # ------------------------------------------------------------------
+    def _check_fitted(self):
+        if not hasattr(self, "_trees"):
+            raise AttributeError(
+                f"This {type(self).__name__} instance is not fitted yet."
+            )
+
+    def _forest_values(self, X):
+        """Mean over trees of per-tree leaf outputs → (n, K_out)."""
+        self._check_fitted()
+        X = as_dense_f32(X)
+        fn = _forest_walker(self.max_depth, "predict")
+        trees = jax.tree_util.tree_map(jnp.asarray, self._trees)
+        Xb = apply_bins(jnp.asarray(X), jnp.asarray(self._edges))
+        return np.asarray(fn(trees, Xb))
+
+    def apply(self, X):
+        """(n, n_estimators) leaf ids — sklearn ``forest.apply``."""
+        self._check_fitted()
+        X = as_dense_f32(X)
+        fn = _forest_walker(self.max_depth, "apply")
+        trees = jax.tree_util.tree_map(jnp.asarray, self._trees)
+        Xb = apply_bins(jnp.asarray(X), jnp.asarray(self._edges))
+        return np.asarray(fn(trees, Xb))
+
+    @property
+    def feature_importances_(self):
+        self._check_fitted()
+        T = self._trees["feat"].shape[0]
+        imps = np.stack([
+            feature_importances_from_tree(
+                self._trees["feat"][t], self._trees["gain"][t],
+                self.n_features_in_,
+            )
+            for t in range(T)
+        ])
+        return imps.mean(axis=0)
+
+    @property
+    def estimators_(self):
+        """Per-tree estimator views (reference parity: fitted trees are
+        collected into ``estimators_``, ensemble.py:325)."""
+        self._check_fitted()
+        cls = (
+            DecisionTreeClassifier if self._classification
+            else DecisionTreeRegressor
+        )
+        out = []
+        T = self._trees["feat"].shape[0]
+        for t in range(T):
+            est = cls(
+                max_depth=self.max_depth, n_bins=self.n_bins,
+                max_features=self.max_features,
+                min_samples_split=self.min_samples_split,
+                min_samples_leaf=self.min_samples_leaf,
+                min_impurity_decrease=self.min_impurity_decrease,
+                splitter="random" if self._extra else "best",
+            )
+            est._params = jax.tree_util.tree_map(
+                lambda a: np.asarray(a[t]), self._trees
+            )
+            est._params["edges"] = np.asarray(self._edges)
+            est._meta = {"n_features": self.n_features_in_}
+            est.n_features_in_ = self.n_features_in_
+            if self._classification:
+                est.classes_ = self.classes_
+                est._meta.update(
+                    classes=self.classes_, n_classes=len(self.classes_)
+                )
+            out.append(est)
+        return out
+
+
+class _ForestClassifierMixin(ClassifierMixin):
+    def predict_proba(self, X):
+        return self._forest_values(X)
+
+    def predict_log_proba(self, X):
+        return np.log(np.clip(self.predict_proba(X), 1e-15, None))
+
+    def predict(self, X):
+        return self.classes_[np.argmax(self.predict_proba(X), axis=1)]
+
+
+class _ForestRegressorMixin(RegressorMixin):
+    def predict(self, X):
+        out = self._forest_values(X)
+        return out[:, 0] if out.ndim == 2 and out.shape[1] == 1 else out
+
+
+class RandomForestClassifier(_BaseForest, _ForestClassifierMixin):
+    """Histogram random forest (bagged best-split trees)."""
+
+
+class RandomForestRegressor(_BaseForest, _ForestRegressorMixin):
+    def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
+                 max_features=1.0, min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, bootstrap=True, warm_start=False,
+                 random_state=None, n_jobs=None):
+        super().__init__(
+            n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
+            max_features=max_features, min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+        )
+
+
+class ExtraTreesClassifier(_BaseForest, _ForestClassifierMixin):
+    """Extremely randomised trees: random per-(node, feature) thresholds,
+    no bootstrap by default (sklearn semantics)."""
+
+    _extra = True
+
+    def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
+                 max_features="sqrt", min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, bootstrap=False, warm_start=False,
+                 random_state=None, n_jobs=None):
+        super().__init__(
+            n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
+            max_features=max_features, min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+        )
+
+
+class ExtraTreesRegressor(_BaseForest, _ForestRegressorMixin):
+    _extra = True
+
+    def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
+                 max_features=1.0, min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, bootstrap=False, warm_start=False,
+                 random_state=None, n_jobs=None):
+        super().__init__(
+            n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
+            max_features=max_features, min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+        )
+
+
+class RandomTreesEmbedding(_BaseForest, TransformerMixin):
+    """Unsupervised leaf-index embedding (reference ensemble.py:619-716):
+    extra-random regression trees fit on uniform random targets; transform
+    one-hot-encodes each sample's leaf per tree."""
+
+    _extra = True
+    _estimator_type = None
+
+    def __init__(self, n_estimators=100, max_depth=5, n_bins=32,
+                 min_samples_split=2, min_samples_leaf=1,
+                 min_impurity_decrease=0.0, sparse_output=True,
+                 warm_start=False, random_state=None, n_jobs=None):
+        super().__init__(
+            n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
+            max_features=1.0, min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_impurity_decrease=min_impurity_decrease, bootstrap=False,
+            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+        )
+        self.sparse_output = sparse_output
+
+    @property
+    def _classification(self):
+        return False
+
     def fit(self, X, y=None, sample_weight=None):
-        raise NotImplementedError("forest kernels land in the ensemble milestone")
+        # uniform random targets (reference ensemble.py:704-706)
+        rng = np.random.RandomState(self.random_state)
+        y_rand = rng.uniform(size=np.asarray(X).shape[0]).astype(np.float32)
+        super().fit(X, y_rand, sample_weight=sample_weight)
+        # fit-time one-hot layout: one block of 2^(D+1)-1 slots per tree
+        self._n_nodes = n_tree_nodes(self.max_depth)
+        return self
 
+    def fit_transform(self, X, y=None, sample_weight=None):
+        return self.fit(X, y, sample_weight).transform(X)
 
-class RandomForestClassifier(_ForestStub, ClassifierMixin):
-    pass
+    def transform(self, X):
+        self._check_fitted()
+        leaves = self.apply(X)  # (n, T)
+        n, T = leaves.shape
+        N = self._n_nodes
+        cols = (leaves + np.arange(T)[None, :] * N).ravel()
+        rows = np.repeat(np.arange(n), T)
+        from scipy import sparse
 
-
-class RandomForestRegressor(_ForestStub, RegressorMixin):
-    pass
-
-
-class ExtraTreesClassifier(_ForestStub, ClassifierMixin):
-    pass
-
-
-class ExtraTreesRegressor(_ForestStub, RegressorMixin):
-    pass
-
-
-class RandomTreesEmbedding(_ForestStub, TransformerMixin):
-    pass
+        out = sparse.csr_matrix(
+            (np.ones(n * T, dtype=np.float32), (rows, cols)),
+            shape=(n, T * N),
+        )
+        return out if self.sparse_output else np.asarray(out.todense())

@@ -325,7 +325,7 @@ class LogisticRegression(_LinearClassifierBase):
         class_weight, cw_arr = st["class_weight"], meta.get("cw_arr")
         binary = k <= 2
 
-        def kernel(X, y_idx, sw, hyper):
+        def kernel(X, y_idx, sw, hyper, aux=None):
             C = hyper["C"]
             tol = hyper["tol"]
             Xa = _augment(X, fit_intercept)
@@ -444,7 +444,7 @@ class LinearSVC(_LinearClassifierBase):
         class_weight, cw_arr = st["class_weight"], meta.get("cw_arr")
         binary = k <= 2
 
-        def kernel(X, y_idx, sw, hyper):
+        def kernel(X, y_idx, sw, hyper, aux=None):
             C = hyper["C"]
             tol = hyper["tol"]
             Xa = _augment(X, fit_intercept)
@@ -549,7 +549,7 @@ class SGDClassifier(_LinearClassifierBase):
                 raise ValueError(f"unsupported loss {loss_name!r}")
             return dloss
 
-        def kernel(X, y_idx, sw, hyper):
+        def kernel(X, y_idx, sw, hyper, aux=None):
             alpha = hyper["alpha"]
             eta0 = hyper["eta0"]
             l1_ratio = hyper["l1_ratio"]
@@ -677,7 +677,7 @@ class Ridge(_LinearModelBase, RegressorMixin, _RidgeKernelMixin):
         fit_intercept = st["fit_intercept"]
         d = meta["n_features"]
 
-        def kernel(X, y, sw, hyper):
+        def kernel(X, y, sw, hyper, aux=None):
             alpha = hyper["alpha"]
             Xa = _augment(X, fit_intercept)
             T = y.reshape(y.shape[0], -1)
@@ -727,7 +727,7 @@ class LinearRegression(Ridge):
     def _build_fit_kernel(cls, meta, static):
         inner = Ridge._build_fit_kernel.__func__(cls, meta, static)
 
-        def kernel(X, y, sw, hyper):
+        def kernel(X, y, sw, hyper, aux=None):
             hyper = dict(hyper)
             hyper.setdefault("alpha", jnp.float32(0.0))
             return inner(X, y, sw, hyper)
@@ -754,7 +754,7 @@ class RidgeClassifier(_LinearClassifierBase, _RidgeKernelMixin):
         d = meta["n_features"]
         k = meta["n_classes"]
 
-        def kernel(X, y_idx, sw, hyper):
+        def kernel(X, y_idx, sw, hyper, aux=None):
             alpha = hyper["alpha"]
             Xa = _augment(X, fit_intercept)
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)

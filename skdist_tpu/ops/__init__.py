@@ -1,0 +1,7 @@
+"""
+Low-level XLA/pallas ops supporting the estimator kernels.
+"""
+
+from .binning import apply_bins, quantile_bin_edges
+
+__all__ = ["quantile_bin_edges", "apply_bins"]

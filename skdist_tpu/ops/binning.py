@@ -1,0 +1,61 @@
+"""
+Quantile binning for histogram-based tree growing.
+
+The reference's trees (sklearn Cython builders, reached via
+``/root/reference/skdist/distribute/ensemble.py:106-108``) do exact
+split search over sorted feature values — a data-dependent-shape
+algorithm XLA cannot express efficiently. The TPU-native design follows
+the LightGBM/XGBoost-hist approach instead: features are discretised
+once into ``n_bins`` quantile bins, after which split search is a
+fixed-shape histogram reduction (see ``models/tree.py``).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+MAX_BINS = 256
+
+
+def quantile_bin_edges(X, n_bins=32):
+    """Per-feature quantile bin edges, host-side, once per fit.
+
+    Returns ``edges`` of shape (n_features, n_bins - 1); feature j maps
+    value v to bin ``searchsorted(edges[j], v, side='right')`` ∈
+    [0, n_bins). Degenerate (constant) features get +inf edges → all
+    values land in bin 0.
+    """
+    X = np.asarray(X, dtype=np.float32)
+    n, d = X.shape
+    if not 2 <= n_bins <= MAX_BINS:
+        raise ValueError(f"n_bins must be in [2, {MAX_BINS}], got {n_bins}")
+    qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    edges = np.quantile(X, qs, axis=0).T.astype(np.float32)  # (d, n_bins-1)
+    # collapse duplicate edges (low-cardinality features) so empty bins
+    # sit at the top; +inf keeps searchsorted stable
+    for j in range(d):
+        e = edges[j]
+        dup = np.concatenate([[False], e[1:] <= e[:-1]])
+        e[dup] = np.inf
+        edges[j] = np.sort(e)
+    return edges
+
+
+def apply_bins(X, edges):
+    """Discretise X (n, d) with edges (d, B-1) → int32 bins (n, d).
+
+    jit-safe; used at both fit and predict time so split thresholds can
+    be stored as bin ids.
+    """
+    from jax import lax
+
+    X = jnp.asarray(X)
+    edges = jnp.asarray(edges)
+
+    # scan over features: bounds the (n, B-1) comparison temp to one
+    # feature at a time instead of an (n, d, B-1) cube
+    def one_feature(_, xe):
+        x, e = xe
+        return None, jnp.sum(x[:, None] >= e[None, :], axis=1)
+
+    _, bins = lax.scan(one_feature, None, (X.T, edges))
+    return bins.T.astype(jnp.int32)

@@ -1,0 +1,95 @@
+"""
+Postprocessing: ``SimpleVoter`` (reference ``skdist/postprocessing.py:
+17-121``) — a VotingClassifier over *already-fitted* estimators.
+
+Where sklearn's VotingClassifier refits its children, SimpleVoter takes
+fitted estimators (typically the output of distributed searches fit
+elsewhere) and only implements the predict side: hard voting via
+weighted bincount-argmax, soft voting via averaged predict_proba, with
+labels round-tripped through a classes-seeded LabelEncoder.
+"""
+
+import numpy as np
+from sklearn.preprocessing import LabelEncoder
+from sklearn.utils import Bunch
+
+from .base import BaseEstimator, ClassifierMixin
+from .utils.validation import check_is_fitted
+
+__all__ = ["SimpleVoter"]
+
+
+class SimpleVoter(BaseEstimator, ClassifierMixin):
+    """Voting over pre-fitted (name, estimator) tuples.
+
+    ``fit`` is a trivial attribute re-assembly (reference
+    postprocessing.py:67-70) — the whole point is that fitting lived
+    elsewhere (e.g. a DistGridSearchCV per member).
+    """
+
+    def __init__(self, estimators, classes, voting="hard", weights=None):
+        self.estimators = estimators
+        self.classes = classes
+        self.voting = voting
+        self.weights = weights
+        self._assemble_attributes()
+
+    @property
+    def named_estimators(self):
+        return Bunch(**dict(self.estimators))
+
+    @property
+    def _weights_not_none(self):
+        if self.weights is None:
+            return None
+        return [
+            w for (name, est), w in zip(self.estimators, self.weights)
+            if est not in (None, "drop")
+        ]
+
+    def fit(self, X, y=None):
+        self._assemble_attributes()
+        return self
+
+    def predict(self, X):
+        check_is_fitted(self, "estimators_")
+        if self.voting == "soft":
+            maj = np.argmax(self.predict_proba(X), axis=1)
+        else:
+            predictions = self._predict(X)
+            maj = np.apply_along_axis(
+                lambda row: np.argmax(
+                    np.bincount(
+                        row, weights=self._weights_not_none,
+                        minlength=len(self.classes_),
+                    )
+                ),
+                axis=1,
+                arr=predictions,
+            )
+        return self.le_.inverse_transform(maj)
+
+    def predict_proba(self, X):
+        if self.voting == "hard":
+            raise AttributeError(
+                f"predict_proba is not available when voting={self.voting!r}"
+            )
+        check_is_fitted(self, "estimators_")
+        return np.average(
+            self._collect_probas(X), axis=0, weights=self._weights_not_none
+        )
+
+    def _predict(self, X):
+        return np.asarray(
+            [self.le_.transform(clf.predict(X)) for clf in self.estimators_]
+        ).T
+
+    def _collect_probas(self, X):
+        return np.asarray([clf.predict_proba(X) for clf in self.estimators_])
+
+    def _assemble_attributes(self):
+        names, clfs = zip(*self.estimators)
+        self.estimators_ = clfs
+        self.classes_ = np.asarray(self.classes)
+        self.le_ = LabelEncoder()
+        self.le_.classes_ = self.classes_

@@ -1,0 +1,219 @@
+"""
+Pipeline-compatible preprocessing transformers.
+
+Counterparts of the reference's ``skdist/preprocessing.py:21-339``:
+column selection, dtype casting, null imputation, dense/sparse
+conversion, pipeline-safe label encoding, memory-efficient univariate
+selection, chunked hashing vectorisation, and multi-hot encoding. These
+are host-side (featurisation feeds the device-resident matrices the
+JAX kernels consume); they exist so Encoderizer default pipelines and
+user pipelines from sk-dist port over unchanged.
+"""
+
+import warnings
+
+import numpy as np
+import pandas as pd
+from scipy import sparse
+from sklearn import feature_selection
+from sklearn.feature_extraction.text import HashingVectorizer
+from sklearn.preprocessing import LabelEncoder, MultiLabelBinarizer, normalize
+
+from .base import BaseEstimator, TransformerMixin
+
+__all__ = [
+    "SelectField",
+    "FeatureCast",
+    "ImputeNull",
+    "DenseTransformer",
+    "SparseTransformer",
+    "LabelEncoderPipe",
+    "SelectorMem",
+    "HashingVectorizerChunked",
+    "MultihotEncoder",
+]
+
+_SELECTOR_LOOKUP = {
+    "fpr": feature_selection.SelectFpr,
+    "fdr": feature_selection.SelectFdr,
+    "kbest": feature_selection.SelectKBest,
+    "percentile": feature_selection.SelectPercentile,
+    "fwe": feature_selection.SelectFwe,
+}
+
+
+class SelectField(BaseEstimator, TransformerMixin):
+    """Select columns from a pandas DataFrame → numpy values
+    (reference preprocessing.py:77-94)."""
+
+    def __init__(self, cols=None, single_dimension=False):
+        self.cols = cols
+        self.single_dimension = single_dimension
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X, y=None):
+        if self.cols is None:
+            return X.values
+        if len(self.cols) == 1 and self.single_dimension:
+            return X[self.cols[0]].values
+        return X[list(self.cols)].values
+
+
+class FeatureCast(BaseEstimator, TransformerMixin):
+    """Cast array dtype (reference preprocessing.py:143-154)."""
+
+    def __init__(self, cast_type=None):
+        self.cast_type = cast_type
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X, y=None):
+        if self.cast_type is None:
+            return X
+        return X.astype(self.cast_type)
+
+
+class ImputeNull(BaseEstimator, TransformerMixin):
+    """Replace nulls (per ``pd.isnull``) with a constant (reference
+    preprocessing.py:175-186)."""
+
+    def __init__(self, impute_val=None):
+        self.impute_val = impute_val
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X, y=None):
+        if self.impute_val is None:
+            return X
+        X = np.asarray(X, dtype=object) if not isinstance(X, np.ndarray) else X.copy()
+        X[pd.isnull(X)] = self.impute_val
+        return X
+
+
+class DenseTransformer(BaseEstimator, TransformerMixin):
+    """Densify sparse input (reference preprocessing.py:105-112)."""
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X, y=None):
+        return np.asarray(X.todense()) if sparse.issparse(X) else X
+
+
+class SparseTransformer(BaseEstimator, TransformerMixin):
+    """Sparsify dense input (reference preprocessing.py:114-124)."""
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X, y=None):
+        return X if sparse.issparse(X) else sparse.csr_matrix(X)
+
+
+class LabelEncoderPipe(BaseEstimator, TransformerMixin):
+    """Pipeline-safe LabelEncoder producing a column vector (reference
+    preprocessing.py:189-203)."""
+
+    def fit(self, X, y=None):
+        self.le_ = LabelEncoder().fit(X)
+        return self
+
+    def transform(self, X, y=None):
+        return self.le_.transform(X).reshape(-1, 1)
+
+
+class SelectorMem(BaseEstimator, TransformerMixin):
+    """Univariate feature selection storing only the cheaper of
+    bool-mask vs int-indices (reference preprocessing.py:206-261)."""
+
+    def __init__(self, selector="fpr",
+                 score_func=feature_selection.f_classif, threshold=0.05):
+        self.selector = selector
+        self.score_func = score_func
+        self.threshold = threshold
+
+    def fit(self, X, y=None):
+        sel = _SELECTOR_LOOKUP[self.selector.lower()](
+            score_func=self.score_func, **self._threshold_kw()
+        )
+        sel.fit(X, y)
+        mask_idx = sel.get_support(indices=True)
+        mask_bool = sel.get_support(indices=False)
+        self.mask = (
+            mask_idx
+            if np.asarray(mask_bool).nbytes > np.asarray(mask_idx).nbytes
+            else mask_bool
+        )
+        return self
+
+    def _threshold_kw(self):
+        name = self.selector.lower()
+        if name == "kbest":
+            return {"k": self.threshold}
+        if name == "percentile":
+            return {"percentile": self.threshold}
+        return {"alpha": self.threshold}
+
+    def transform(self, X, y=None):
+        return X[:, self.mask]
+
+
+class HashingVectorizerChunked(HashingVectorizer):
+    """HashingVectorizer with chunked transform to bound peak memory
+    (reference preprocessing.py:264-310)."""
+
+    def __init__(self, chunksize=100000, n_features=2**20, norm="l2",
+                 binary=False, alternate_sign=True, analyzer="word",
+                 ngram_range=(1, 1), lowercase=True, stop_words=None,
+                 token_pattern=r"(?u)\b\w\w+\b", strip_accents=None,
+                 decode_error="strict", input="content", encoding="utf-8",
+                 preprocessor=None, tokenizer=None, dtype=np.float64):
+        self.chunksize = chunksize
+        HashingVectorizer.__init__(
+            self, n_features=n_features, norm=norm, binary=binary,
+            alternate_sign=alternate_sign, analyzer=analyzer,
+            ngram_range=ngram_range, lowercase=lowercase,
+            stop_words=stop_words, token_pattern=token_pattern,
+            strip_accents=strip_accents, decode_error=decode_error,
+            input=input, encoding=encoding, preprocessor=preprocessor,
+            tokenizer=tokenizer, dtype=dtype,
+        )
+
+    def transform(self, X):
+        if isinstance(X, str):
+            raise ValueError(
+                "Iterable over raw text documents expected, "
+                "string object received."
+            )
+        if self.chunksize is None or len(X) < self.chunksize:
+            return HashingVectorizer.transform(self, X)
+        return sparse.vstack([
+            HashingVectorizer.transform(self, X[i:i + self.chunksize])
+            for i in range(0, len(X), self.chunksize)
+        ])
+
+
+class MultihotEncoder(BaseEstimator, TransformerMixin):
+    """Pipeline-safe MultiLabelBinarizer ignoring unseen labels
+    (reference preprocessing.py:313-339)."""
+
+    def __init__(self, sparse_output=False):
+        self.sparse_output = sparse_output
+
+    def fit(self, X, y=None):
+        self.transformer_ = MultiLabelBinarizer().fit(X)
+        return self
+
+    def transform(self, X, y=None):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            X_t = self.transformer_.transform(X)
+        return sparse.csr_matrix(X_t) if self.sparse_output else X_t
+
+    @property
+    def classes_(self):
+        return self.transformer_.classes_

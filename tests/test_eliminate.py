@@ -1,0 +1,101 @@
+"""
+DistFeatureEliminator tests (reference: skdist/distribute/tests/
+test_eliminate.py — planted junk feature gets eliminated).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from skdist_tpu.distribute.eliminate import DistFeatureEliminator
+from skdist_tpu.models import LogisticRegression, RandomForestClassifier
+
+
+def _planted_data():
+    """5 features: col 0 is pure noise, cols 1-4 are informative
+    (the reference's test plants a junk feature and asserts
+    best_features_ == [1, 2, 3, 4])."""
+    rng = np.random.RandomState(0)
+    n = 300
+    y = rng.randint(0, 2, size=n)
+    X = np.zeros((n, 5), dtype=np.float32)
+    X[:, 0] = rng.normal(size=n)  # junk
+    for j in range(1, 5):
+        X[:, j] = y * 2.0 + rng.normal(scale=0.8, size=n)
+    return X, y
+
+
+def test_fit_eliminates_junk_feature():
+    X, y = _planted_data()
+    fe = DistFeatureEliminator(
+        LogisticRegression(max_iter=100), min_features_to_select=4, cv=3,
+        scoring="accuracy",
+    ).fit(X, y)
+    assert list(fe.best_features_) == [1, 2, 3, 4]
+    assert fe.n_features_ == 4
+    assert fe.best_score_ > 0.9
+    assert fe.score(X, y) > 0.9
+
+
+def test_generic_path_matches_batched():
+    from sklearn.metrics import accuracy_score, make_scorer
+
+    X, y = _planted_data()
+    batched = DistFeatureEliminator(
+        LogisticRegression(max_iter=100), min_features_to_select=2, cv=3,
+        scoring="accuracy",
+    ).fit(X, y)
+    generic = DistFeatureEliminator(
+        LogisticRegression(max_iter=100), min_features_to_select=2, cv=3,
+        scoring=make_scorer(accuracy_score),
+    ).fit(X, y)
+    np.testing.assert_allclose(batched.scores_, generic.scores_, atol=1e-5)
+    assert list(batched.best_features_) == list(generic.best_features_)
+
+
+def test_sklearn_estimator_path():
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = _planted_data()
+    fe = DistFeatureEliminator(
+        SkLR(max_iter=200), min_features_to_select=4, cv=3
+    ).fit(X, y)
+    assert list(fe.best_features_) == [1, 2, 3, 4]
+
+
+def test_forest_importances_ranking():
+    X, y = _planted_data()
+    fe = DistFeatureEliminator(
+        RandomForestClassifier(n_estimators=10, max_depth=4, random_state=0),
+        min_features_to_select=3, cv=2, scoring="accuracy",
+    ).fit(X, y)
+    # junk feature should not survive to the best set
+    assert 0 not in set(fe.best_features_) or fe.n_features_ == 5
+
+
+def test_step_and_scores_shape():
+    X, y = _planted_data()
+    fe = DistFeatureEliminator(
+        LogisticRegression(max_iter=50), min_features_to_select=1, step=2,
+        cv=2, scoring="accuracy",
+    ).fit(X, y)
+    # sets: remove 0, 2, 4 features → 3 sets
+    assert len(fe.scores_) == 3
+
+
+def test_mesh_and_pickle(tpu_backend):
+    X, y = _planted_data()
+    fe = DistFeatureEliminator(
+        LogisticRegression(max_iter=100), backend=tpu_backend,
+        min_features_to_select=4, cv=3, scoring="accuracy",
+    ).fit(X, y)
+    assert fe.backend is None
+    loaded = pickle.loads(pickle.dumps(fe))
+    assert (loaded.predict(X) == fe.predict(X)).all()
+
+
+def test_rejects_single_feature():
+    X, y = _planted_data()
+    with pytest.raises(ValueError):
+        DistFeatureEliminator(LogisticRegression()).fit(X[:, :1], y)

@@ -1,0 +1,217 @@
+"""
+Tree / forest kernel and Dist* ensemble tests (reference:
+skdist/distribute/tests/test_ensemble.py — test_rfc..test_rte with
+exact prediction/shape asserts on tiny data).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from skdist_tpu.distribute.ensemble import (
+    DistExtraTreesClassifier,
+    DistExtraTreesRegressor,
+    DistRandomForestClassifier,
+    DistRandomForestRegressor,
+    DistRandomTreesEmbedding,
+)
+from skdist_tpu.models import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+)
+
+# the reference's canonical toy problem
+X_TOY = np.array([[1, 1, 1], [0, 0, 0], [-1, -1, -1]] * 100, dtype=np.float32)
+Y_TOY = np.array([0, 0, 1] * 100)
+X_PRED = np.array([[1.0, 1.0, 1.0], [0, 0, 0], [-1, -1, -1]], dtype=np.float32)
+
+
+def test_decision_tree_classifier(clf_data):
+    from sklearn.tree import DecisionTreeClassifier as SkDT
+
+    from sklearn.datasets import make_classification
+
+    X, y = clf_data
+    ours = DecisionTreeClassifier(max_depth=5).fit(X, y)
+    sk = SkDT(max_depth=5, random_state=0).fit(X, y)
+    assert ours.score(X, y) >= sk.score(X, y) - 0.05
+    assert ours.predict_proba(X).shape == (len(y), 3)
+    # importances identify the same informative features (needs a
+    # problem where features genuinely differ in information)
+    Xi, yi = make_classification(
+        n_samples=600, n_features=20, n_informative=5, n_redundant=0,
+        n_classes=3, random_state=0,
+    )
+    Xi = Xi.astype(np.float32)
+    oi = DecisionTreeClassifier(max_depth=5).fit(Xi, yi)
+    si = SkDT(max_depth=5, random_state=0).fit(Xi, yi)
+    assert np.corrcoef(
+        oi.feature_importances_, si.feature_importances_
+    )[0, 1] > 0.7
+
+
+def test_decision_tree_regressor(reg_data):
+    X, y = reg_data
+    ours = DecisionTreeRegressor(max_depth=6).fit(X, y)
+    assert ours.score(X, y) > 0.5
+
+
+def test_tree_sample_weight_masking(clf_data):
+    """Zero-weight rows must not influence the tree (the fold-mask
+    contract every distributed meta-estimator relies on)."""
+    X, y = clf_data
+    w = np.ones(len(y), dtype=np.float32)
+    w[y == 2] = 0.0
+    t = DecisionTreeClassifier(max_depth=5).fit(X, y, sample_weight=w)
+    preds = t.predict(X[y != 2])
+    assert set(np.unique(preds)) <= {0, 1}
+
+
+def test_rfc_toy():
+    rf = DistRandomForestClassifier(
+        n_estimators=10, max_depth=4, random_state=0
+    ).fit(X_TOY, Y_TOY)
+    assert list(rf.predict(X_PRED)) == [0, 0, 1]
+    proba = rf.predict_proba(X_PRED)
+    assert proba.shape == (3, 2)
+
+
+def test_rfc_vs_sklearn(clf_data):
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+
+    X, y = clf_data
+    ours = DistRandomForestClassifier(
+        n_estimators=40, max_depth=6, random_state=0
+    ).fit(X, y)
+    sk = SkRF(n_estimators=40, max_depth=6, random_state=0).fit(X, y)
+    assert ours.score(X, y) >= sk.score(X, y) - 0.05
+
+
+def test_rfr(reg_data):
+    X, y = reg_data
+    rf = DistRandomForestRegressor(
+        n_estimators=30, max_depth=7, random_state=0
+    ).fit(X, y)
+    assert rf.score(X, y) > 0.6
+    assert rf.predict(X).shape == (len(y),)
+
+
+def test_etc_etr(clf_data, reg_data):
+    X, y = clf_data
+    etc = DistExtraTreesClassifier(
+        n_estimators=30, max_depth=6, random_state=0
+    ).fit(X, y)
+    assert etc.score(X, y) >= 0.9
+    Xr, yr = reg_data
+    etr = DistExtraTreesRegressor(
+        n_estimators=30, max_depth=7, random_state=0
+    ).fit(Xr, yr)
+    assert etr.score(Xr, yr) > 0.5
+
+
+def test_rte(clf_data):
+    X, y = clf_data
+    rte = DistRandomTreesEmbedding(
+        n_estimators=8, max_depth=4, random_state=0
+    )
+    emb = rte.fit_transform(X)
+    assert emb.shape == (len(y), 8 * (2**5 - 1))
+    # exactly one active leaf per (sample, tree)
+    assert (np.asarray(emb.sum(axis=1)).ravel() == 8).all()
+    emb2 = rte.transform(X)
+    assert (emb != emb2).nnz == 0
+
+
+def test_forest_on_mesh(clf_data, tpu_backend):
+    X, y = clf_data
+    local = DistRandomForestClassifier(
+        n_estimators=16, max_depth=5, random_state=0
+    ).fit(X, y)
+    dist = DistRandomForestClassifier(
+        n_estimators=16, max_depth=5, random_state=0, backend=tpu_backend
+    ).fit(X, y)
+    # same seeds -> identical forests regardless of backend
+    np.testing.assert_allclose(
+        local.predict_proba(X), dist.predict_proba(X), atol=1e-6
+    )
+    assert dist.backend is None
+    pickle.dumps(dist)
+
+
+def test_forest_partitions_rounds(clf_data):
+    X, y = clf_data
+    full = DistRandomForestClassifier(
+        n_estimators=12, max_depth=5, random_state=0
+    ).fit(X, y)
+    rounds = DistRandomForestClassifier(
+        n_estimators=12, max_depth=5, random_state=0, partitions=4
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        full.predict_proba(X), rounds.predict_proba(X), atol=1e-6
+    )
+
+
+def test_warm_start(clf_data):
+    X, y = clf_data
+    rf = DistRandomForestClassifier(
+        n_estimators=10, max_depth=5, random_state=0, warm_start=True
+    ).fit(X, y)
+    rf.n_estimators = 20
+    rf.fit(X, y)
+    assert rf._trees["feat"].shape[0] == 20
+    with pytest.raises(ValueError):
+        rf.n_estimators = 5
+        rf.fit(X, y)
+
+
+def test_warm_start_keeps_edges(clf_data):
+    """Warm refit must not rebin old trees' thresholds (regression:
+    edges were recomputed from the new X)."""
+    X, y = clf_data
+    rf = DistRandomForestClassifier(
+        n_estimators=8, max_depth=5, random_state=0, warm_start=True
+    ).fit(X, y)
+    edges_before = rf._edges.copy()
+    rf.n_estimators = 12
+    rf.fit(X * 3.0 + 1.0, y)  # shifted distribution
+    np.testing.assert_array_equal(rf._edges, edges_before)
+
+
+def test_estimators_views(clf_data):
+    X, y = clf_data
+    rf = DistRandomForestClassifier(
+        n_estimators=5, max_depth=5, random_state=0
+    ).fit(X, y)
+    assert len(rf.estimators_) == 5
+    tree0 = rf.estimators_[0]
+    p = tree0.predict_proba(X)
+    assert p.shape == (len(y), 3)
+    # forest proba is the mean of tree probas
+    mean = np.mean([t.predict_proba(X) for t in rf.estimators_], axis=0)
+    np.testing.assert_allclose(mean, rf.predict_proba(X), atol=1e-5)
+
+
+def test_forest_apply_and_importances(clf_data):
+    X, y = clf_data
+    rf = DistRandomForestClassifier(
+        n_estimators=6, max_depth=4, random_state=0
+    ).fit(X, y)
+    leaves = rf.apply(X)
+    assert leaves.shape == (len(y), 6)
+    imp = rf.feature_importances_
+    assert imp.shape == (X.shape[1],)
+    assert abs(imp.sum() - 1.0) < 1e-6
+
+
+def test_forest_in_grid_search(clf_data):
+    """Forests as search base estimators take the generic path."""
+    from skdist_tpu.distribute.search import DistGridSearchCV
+
+    X, y = clf_data
+    gs = DistGridSearchCV(
+        RandomForestClassifier(n_estimators=8, random_state=0),
+        {"max_depth": [3, 5]}, cv=2, scoring="accuracy",
+    ).fit(X, y)
+    assert gs.best_params_["max_depth"] in (3, 5)

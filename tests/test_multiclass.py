@@ -178,6 +178,65 @@ def test_ovo_on_mesh(clf_data, tpu_backend):
     pickle.dumps(dist)
 
 
+def test_ovr_dict_class_weight_falls_back(clf_data):
+    """dict class_weight is keyed by original labels and must not ride
+    the batched binary path (regression)."""
+    X, y = clf_data
+    ovr = DistOneVsRestClassifier(
+        LogisticRegression(max_iter=100, class_weight={0: 2.0})
+    ).fit(X, y)
+    assert ovr.score(X, y) >= 0.9
+
+
+def test_ovo_sparse_predict(clf_data):
+    """scipy sparse X through fit and predict (regression: len(X) raised
+    on sparse)."""
+    from scipy import sparse
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    Xs = sparse.csr_matrix(X)
+    ovo = DistOneVsOneClassifier(SkLR(max_iter=200)).fit(Xs, y)
+    assert ovo.predict(Xs).shape == (len(y),)
+
+
+def test_ovr_string_labels(clf_data):
+    """String labels are multiclass, NOT per-character multilabel
+    (regression)."""
+    X, y = clf_data
+    names = np.array(["cat", "dog", "bird"])
+    ys = names[y]
+    ovr = DistOneVsRestClassifier(LogisticRegression(max_iter=100)).fit(X, ys)
+    assert not ovr.multilabel_
+    assert set(ovr.classes_) == {"cat", "dog", "bird"}
+    assert ovr.predict(X).dtype.kind == "U"
+
+
+def test_ovr_column_vector_y(clf_data):
+    """(n,1) label column is ravelled like sklearn (regression: was
+    treated as a 1-class indicator matrix)."""
+    X, y = clf_data
+    with pytest.warns(UserWarning):
+        ovr = DistOneVsRestClassifier(
+            LogisticRegression(max_iter=50)
+        ).fit(X, y.reshape(-1, 1))
+    assert not ovr.multilabel_
+    assert len(ovr.classes_) == 3
+    # and a non-binary 2-D y is rejected outright
+    with pytest.raises(ValueError):
+        DistOneVsRestClassifier(LogisticRegression()).fit(
+            X, np.stack([y, y], axis=1)
+        )
+
+
+def test_ovr_bad_method_rejected(clf_data):
+    X, y = clf_data
+    with pytest.raises(ValueError):
+        DistOneVsRestClassifier(
+            LogisticRegression(), max_negatives=0.5, method="multipler"
+        ).fit(X, y)
+
+
 def test_constant_predictor():
     cp = _ConstantPredictor().fit(None, np.array([1, 1]))
     assert (cp.predict(np.zeros((3, 2))) == 1).all()

@@ -1,0 +1,126 @@
+"""
+DistMultiModelSearch tests (reference DistMultiModelSearch,
+search.py:717-908).
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from skdist_tpu.distribute.search import DistMultiModelSearch, _raw_sampler
+from skdist_tpu.models import (
+    LogisticRegression,
+    RandomForestClassifier,
+    RidgeClassifier,
+)
+
+
+def _models():
+    return [
+        ("lr", LogisticRegression(max_iter=50), {"C": [0.1, 1.0, 10.0]}),
+        ("ridge", RidgeClassifier(), {"alpha": [0.5, 2.0]}),
+        ("rf", RandomForestClassifier(n_estimators=8, random_state=0),
+         {"max_depth": [3, 5]}),
+    ]
+
+
+def test_fit_selects_best(clf_data):
+    X, y = clf_data
+    mm = DistMultiModelSearch(
+        _models(), n=2, cv=3, scoring="accuracy", random_state=0
+    ).fit(X, y)
+    assert mm.best_model_name_ in ("lr", "ridge", "rf")
+    assert 0.8 <= mm.best_score_ <= 1.0
+    assert mm.worst_score_ <= mm.best_score_
+    preds = mm.predict(X)
+    assert preds.shape == (len(y),)
+    # cv_results_ carries all sampled candidates
+    assert len(mm.cv_results_["model_name"]) == 6  # 2 per model (capped)
+    assert set(mm.cv_results_["model_name"]) == {"lr", "ridge", "rf"}
+
+
+def test_rank_and_results_schema(clf_data):
+    X, y = clf_data
+    mm = DistMultiModelSearch(
+        _models()[:2], n=2, cv=2, scoring="accuracy", random_state=0
+    ).fit(X, y)
+    for col in ("model_index", "model_name", "params", "rank_test_score",
+                "mean_test_score"):
+        assert col in mm.cv_results_
+    ranks = mm.cv_results_["rank_test_score"]
+    assert min(ranks) == 1
+
+
+def test_raw_sampler_caps_at_grid():
+    sets = _raw_sampler(
+        [("lr", LogisticRegression(), {"C": [0.1, 1.0]})], n=10,
+        random_state=0,
+    )
+    assert len(sets) == 2  # capped at grid size
+
+
+def test_refit_false(clf_data):
+    X, y = clf_data
+    mm = DistMultiModelSearch(
+        _models()[:1], n=2, cv=2, scoring="accuracy", refit=False
+    ).fit(X, y)
+    assert not hasattr(mm, "best_estimator_")
+    with pytest.raises(AttributeError):
+        mm.predict(X)
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        DistMultiModelSearch([]).fit(np.zeros((4, 2)), [0, 1, 0, 1])
+    bad = [("a", LogisticRegression(), {}), ("a", RidgeClassifier(), {})]
+    with pytest.raises(ValueError):
+        DistMultiModelSearch(bad).fit(np.zeros((4, 2)), [0, 1, 0, 1])
+
+
+def test_failed_model_not_selected(clf_data):
+    """A model whose fits all fail (NaN scores) must not win
+    (regression: np.argmax returned the NaN index)."""
+
+    class Exploding(LogisticRegression):
+        def fit(self, X, y=None, sample_weight=None):
+            raise RuntimeError("boom")
+
+    X, y = clf_data
+    mm = DistMultiModelSearch(
+        [("good", LogisticRegression(max_iter=50), {"C": [1.0]}),
+         ("bad", Exploding(), {"C": [1.0]})],
+        n=1, cv=2, scoring="accuracy",
+    )
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mm.fit(X, y)
+    assert mm.best_model_name_ == "good"
+
+
+def test_mesh_and_pickle(clf_data, tpu_backend):
+    X, y = clf_data
+    mm = DistMultiModelSearch(
+        _models()[:2], backend=tpu_backend, n=2, cv=2, scoring="accuracy",
+        random_state=0,
+    ).fit(X, y)
+    assert mm.backend is None
+    loaded = pickle.loads(pickle.dumps(mm))
+    assert (loaded.predict(X) == mm.predict(X)).all()
+
+
+def test_mixed_jax_and_sklearn_models(clf_data):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    models = [
+        ("jax_lr", LogisticRegression(max_iter=50), {"C": [0.1, 1.0]}),
+        ("sk_lr", SkLR(max_iter=200), {"C": [0.1, 1.0]}),
+    ]
+    mm = DistMultiModelSearch(
+        models, n=2, cv=2, scoring="accuracy", random_state=0
+    ).fit(X, y)
+    # both families evaluated; scores comparable
+    assert len(mm.cv_results_["model_name"]) == 4

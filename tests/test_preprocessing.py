@@ -1,0 +1,142 @@
+"""
+Preprocessing / postprocessing transformer tests (reference:
+skdist/tests/test_preprocessing.py, test_postprocessing.py).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+from scipy import sparse
+
+from skdist_tpu.preprocessing import (
+    DenseTransformer,
+    FeatureCast,
+    HashingVectorizerChunked,
+    ImputeNull,
+    LabelEncoderPipe,
+    MultihotEncoder,
+    SelectField,
+    SelectorMem,
+    SparseTransformer,
+)
+from skdist_tpu.postprocessing import SimpleVoter
+
+
+@pytest.fixture
+def frame():
+    return pd.DataFrame({
+        "a": [1.0, 2.0, 3.0],
+        "b": ["x", "y", "z"],
+        "c": [10, 20, 30],
+    })
+
+
+def test_select_field(frame):
+    out = SelectField(cols=["a", "c"]).fit_transform(frame)
+    assert out.shape == (3, 2)
+    one = SelectField(cols=["b"], single_dimension=True).fit_transform(frame)
+    assert one.shape == (3,)
+    two = SelectField(cols=["b"]).fit_transform(frame)
+    assert two.shape == (3, 1)
+    assert SelectField().fit_transform(frame).shape == (3, 3)
+
+
+def test_feature_cast():
+    X = np.array([["1", "2"], ["3", "4"]])
+    out = FeatureCast(cast_type=float).fit_transform(X)
+    assert out.dtype == np.float64
+    assert FeatureCast().fit_transform(X) is X
+
+
+def test_impute_null():
+    X = np.array([1.0, np.nan, 3.0], dtype=object)
+    out = ImputeNull(0.0).fit_transform(X)
+    assert list(out) == [1.0, 0.0, 3.0]
+    assert ImputeNull().fit_transform(X) is X
+
+
+def test_dense_sparse_roundtrip():
+    X = np.eye(3)
+    sp = SparseTransformer().fit_transform(X)
+    assert sparse.issparse(sp)
+    back = DenseTransformer().fit_transform(sp)
+    assert isinstance(back, np.ndarray)
+    np.testing.assert_array_equal(back, X)
+    assert DenseTransformer().fit_transform(X) is X
+    assert SparseTransformer().fit_transform(sp) is sp
+
+
+def test_label_encoder_pipe():
+    out = LabelEncoderPipe().fit_transform(["b", "a", "b"])
+    assert out.shape == (3, 1)
+    assert list(out.ravel()) == [1, 0, 1]
+
+
+def test_selector_mem(clf_data):
+    X, y = clf_data
+    sel = SelectorMem(selector="kbest", threshold=4).fit(X, y)
+    assert sel.transform(X).shape == (len(y), 4)
+    sel2 = SelectorMem(selector="fpr", threshold=0.05).fit(X, y)
+    assert sel2.transform(X).shape[1] >= 1
+
+
+def test_hashing_vectorizer_chunked():
+    docs = ["hello world", "foo bar baz", "hello again"] * 10
+    hv = HashingVectorizerChunked(chunksize=7, n_features=64,
+                                  alternate_sign=False)
+    out = hv.transform(docs)
+    assert out.shape == (30, 64)
+    full = HashingVectorizerChunked(chunksize=None, n_features=64,
+                                    alternate_sign=False).transform(docs)
+    assert (out != full).nnz == 0
+    with pytest.raises(ValueError):
+        hv.transform("a single string")
+
+
+def test_multihot_encoder():
+    X = [["a", "b"], ["b"], ["c"]]
+    enc = MultihotEncoder().fit(X)
+    out = enc.transform(X)
+    assert out.shape == (3, 3)
+    # unseen labels ignored without warnings
+    out2 = enc.transform([["a", "zzz"]])
+    assert out2.sum() == 1
+    sp = MultihotEncoder(sparse_output=True).fit_transform(X)
+    assert sparse.issparse(sp)
+
+
+def test_simple_voter_hard(clf_data):
+    from skdist_tpu.models import LogisticRegression, RidgeClassifier
+
+    X, y = clf_data
+    m1 = LogisticRegression(max_iter=100).fit(X, y)
+    m2 = RidgeClassifier().fit(X, y)
+    voter = SimpleVoter(
+        [("lr", m1), ("rc", m2)], classes=m1.classes_, voting="hard"
+    )
+    voter.fit(X, y)
+    preds = voter.predict(X)
+    assert preds.shape == (len(y),)
+    assert voter.score(X, y) >= 0.9
+    with pytest.raises(AttributeError):
+        voter.predict_proba(X)
+
+
+def test_simple_voter_soft(clf_data):
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = clf_data
+    m1 = LogisticRegression(max_iter=100, C=0.1).fit(X, y)
+    m2 = LogisticRegression(max_iter=100, C=10.0).fit(X, y)
+    voter = SimpleVoter(
+        [("a", m1), ("b", m2)], classes=m1.classes_, voting="soft",
+        weights=[0.3, 0.7],
+    )
+    proba = voter.predict_proba(X)
+    assert proba.shape == (len(y), 3)
+    np.testing.assert_allclose(
+        proba, 0.3 * m1.predict_proba(X) + 0.7 * m2.predict_proba(X),
+        atol=1e-6,
+    )
+    assert voter.score(X, y) >= 0.9
+    assert "a" in voter.named_estimators
