@@ -63,7 +63,8 @@ def n_tree_nodes(max_depth):
 
 def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
                       min_samples_split, min_samples_leaf,
-                      min_impurity_decrease, extra, classification):
+                      min_impurity_decrease, extra, classification,
+                      hist_block=8):
     """Returns ``kernel(Xb, Ych, key) -> tree`` growing one tree.
 
     - ``Xb`` (n, d) int32 binned features
@@ -120,19 +121,42 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
             jnp.sum(Ych[:, :K]) if classification else jnp.sum(Ych[:, 0])
         )
 
+        # level-invariant histogram inputs, hoisted out of the unrolled
+        # level loop: padded feature-major bins and the tiled channel
+        # matrix each scatter consumes
+        fb = min(hist_block, d)
+        n_blocks = -(-d // fb)
+        d_pad = n_blocks * fb
+        XbT = Xb.T
+        if d_pad != d:
+            XbT = jnp.concatenate(
+                [XbT, jnp.zeros((d_pad - d, XbT.shape[1]), XbT.dtype)]
+            )
+        XbT_blocks = XbT.reshape(n_blocks, fb, -1)
+        Ych_tiled = jnp.tile(Ych, (fb, 1))  # (fb*n, C)
+
         for level in range(D):
             start = 2**level - 1
             nl = 2**level
             rel = node_id - start
             at_level = (node_id >= start) & (node_id < start + nl)
 
-            # ---- histogram: scan over features, scatter over samples
-            def hist_one(_, xcol):
-                seg = jnp.where(at_level, rel * B + xcol, nl * B)
-                h = jnp.zeros((nl * B + 1, C), Ych.dtype).at[seg].add(Ych)
-                return None, h[: nl * B].reshape(nl, B, C)
+            # ---- histogram: scan over feature BLOCKS, one scatter per
+            # block (fewer, larger scatters pipeline far better on TPU
+            # than d tiny ones; block size bounds the update buffer)
+            seg_node = jnp.where(at_level, rel * B, nl * B * fb)
+            f_off = (jnp.arange(fb) * (nl * B))[:, None]
 
-            _, hist = lax.scan(hist_one, None, Xb.T)  # (d, nl, B, C)
+            def hist_blk(_, xcols, seg_node=seg_node, f_off=f_off, nl=nl):
+                # xcols (fb, n)
+                seg = jnp.minimum(seg_node[None, :] + f_off + xcols,
+                                  nl * B * fb)
+                h = jnp.zeros((nl * B * fb + 1, C), Ych.dtype)
+                h = h.at[seg.reshape(-1)].add(Ych_tiled)
+                return None, h[: nl * B * fb].reshape(fb, nl, B, C)
+
+            _, hist = lax.scan(hist_blk, None, XbT_blocks)
+            hist = hist.reshape(d_pad, nl, B, C)[:d]  # (d, nl, B, C)
             cum = jnp.cumsum(hist, axis=2)
             gain, cnt_l, cnt_r, tot = node_scores(cum)
 

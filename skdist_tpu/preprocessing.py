@@ -30,8 +30,25 @@ __all__ = [
     "LabelEncoderPipe",
     "SelectorMem",
     "HashingVectorizerChunked",
+    "FastHashingVectorizer",
     "MultihotEncoder",
 ]
+
+def _check_docs_iterable(X):
+    if isinstance(X, str):
+        raise ValueError(
+            "Iterable over raw text documents expected, "
+            "string object received."
+        )
+
+
+def _doc_chunks(X, chunksize):
+    """Split a document list into transform chunks (shared by the
+    chunked vectorizers)."""
+    if chunksize is None or len(X) <= chunksize:
+        return [X]
+    return [X[i:i + chunksize] for i in range(0, len(X), chunksize)]
+
 
 _SELECTOR_LOOKUP = {
     "fpr": feature_selection.SelectFpr,
@@ -184,17 +201,60 @@ class HashingVectorizerChunked(HashingVectorizer):
         )
 
     def transform(self, X):
-        if isinstance(X, str):
-            raise ValueError(
-                "Iterable over raw text documents expected, "
-                "string object received."
-            )
-        if self.chunksize is None or len(X) < self.chunksize:
-            return HashingVectorizer.transform(self, X)
+        _check_docs_iterable(X)
+        chunks = _doc_chunks(X, self.chunksize)
+        if len(chunks) == 1:
+            return HashingVectorizer.transform(self, chunks[0])
         return sparse.vstack([
-            HashingVectorizer.transform(self, X[i:i + self.chunksize])
-            for i in range(0, len(X), self.chunksize)
-        ])
+            HashingVectorizer.transform(self, c) for c in chunks
+        ]).tocsr()
+
+
+class FastHashingVectorizer(BaseEstimator, TransformerMixin):
+    """Text hashing through the native C kernel
+    (``skdist_tpu/native/fasthash.c``), with a byte-identical
+    pure-Python fallback when no compiler is available.
+
+    The framework's own replacement for the Cython featurisation the
+    reference borrowed from sklearn: word or char_wb n-grams, FNV-1a
+    hashed into ``n_features`` buckets, optional binary counts and
+    L1/L2 row normalisation. Stateless (fit is a no-op), chunked
+    transform bounds peak memory like ``HashingVectorizerChunked``.
+    """
+
+    def __init__(self, n_features=2**12, ngram_range=(1, 1),
+                 analyzer="word", lowercase=True, binary=False, norm="l2",
+                 chunksize=100000, force_python=False):
+        self.n_features = n_features
+        self.ngram_range = ngram_range
+        self.analyzer = analyzer
+        self.lowercase = lowercase
+        self.binary = binary
+        self.norm = norm
+        self.chunksize = chunksize
+        self.force_python = force_python
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X, y=None):
+        from .native import hash_documents
+
+        _check_docs_iterable(X)
+        X = list(X)
+        chunks = _doc_chunks(X, self.chunksize)
+        outs = [
+            hash_documents(
+                c, n_features=self.n_features, ngram_range=self.ngram_range,
+                analyzer=self.analyzer, lowercase=self.lowercase,
+                binary=self.binary, force_python=self.force_python,
+            )
+            for c in chunks
+        ]
+        out = outs[0] if len(outs) == 1 else sparse.vstack(outs).tocsr()
+        if self.norm is not None and out.shape[0] > 0:
+            out = normalize(out, norm=self.norm, copy=False)
+        return out
 
 
 class MultihotEncoder(BaseEstimator, TransformerMixin):
