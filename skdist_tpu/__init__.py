@@ -36,3 +36,38 @@ Public surface (mirrors sk-dist's component inventory):
 """
 
 __version__ = "0.1.0"
+
+
+_EXPORTS = {
+        "DistGridSearchCV": "skdist_tpu.distribute.search",
+        "DistRandomizedSearchCV": "skdist_tpu.distribute.search",
+        "DistMultiModelSearch": "skdist_tpu.distribute.search",
+        "DistOneVsRestClassifier": "skdist_tpu.distribute.multiclass",
+        "DistOneVsOneClassifier": "skdist_tpu.distribute.multiclass",
+        "DistRandomForestClassifier": "skdist_tpu.distribute.ensemble",
+        "DistRandomForestRegressor": "skdist_tpu.distribute.ensemble",
+        "DistExtraTreesClassifier": "skdist_tpu.distribute.ensemble",
+        "DistExtraTreesRegressor": "skdist_tpu.distribute.ensemble",
+        "DistRandomTreesEmbedding": "skdist_tpu.distribute.ensemble",
+        "DistFeatureEliminator": "skdist_tpu.distribute.eliminate",
+        "Encoderizer": "skdist_tpu.distribute.encoder",
+        "EncoderizerExtractor": "skdist_tpu.distribute.encoder",
+        "get_prediction_udf": "skdist_tpu.distribute.predict",
+        "batch_predict": "skdist_tpu.distribute.predict",
+        "SimpleVoter": "skdist_tpu.postprocessing",
+        "LocalBackend": "skdist_tpu.parallel",
+        "TPUBackend": "skdist_tpu.parallel",
+}
+
+
+def __getattr__(name):
+    """Lazy top-level conveniences (``skdist_tpu.DistGridSearchCV`` …)
+    without importing jax at package-import time; resolved attributes
+    are cached in the module namespace."""
+    from importlib import import_module
+
+    if name in _EXPORTS:
+        obj = getattr(import_module(_EXPORTS[name]), name)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module 'skdist_tpu' has no attribute {name!r}")

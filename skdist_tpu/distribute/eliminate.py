@@ -243,8 +243,14 @@ class DistFeatureEliminator(BaseEstimator):
         }
         n_tasks = n_sets * n_splits
         round_size = parse_partitions(self.partitions, n_tasks)
+        from ..parallel import row_sharded_specs
+
         scores = backend.batched_map(
-            kernel, task_args, shared, round_size=round_size
+            kernel, task_args, shared, round_size=round_size,
+            shared_specs=row_sharded_specs(backend, shared, {
+                "X": 0, "y": 0, "sw": 0,
+                "train_masks": 1, "test_masks": 1,
+            }),
         )
         return np.asarray(
             scores["test_score"], dtype=np.float64

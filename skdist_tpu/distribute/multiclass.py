@@ -304,9 +304,14 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         estimators = [None] * n_classes
         if live.size:
             task_args = {"cls": live.astype(np.int32)}
+            from ..parallel import row_sharded_specs
+
             stacked = backend.batched_map(
                 kernel, task_args, shared,
                 round_size=parse_partitions(self.partitions, int(live.size)),
+                shared_specs=row_sharded_specs(
+                    backend, shared, {"X": 0, "Y": 0, "sw": 0}
+                ),
             )
             for pos_idx, cls_idx in enumerate(live):
                 params = jax.tree_util.tree_map(lambda a: a[pos_idx], stacked)
@@ -470,9 +475,14 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
             "i": np.asarray([p[0] for p in self.pairs_], dtype=np.int32),
             "j": np.asarray([p[1] for p in self.pairs_], dtype=np.int32),
         }
+        from ..parallel import row_sharded_specs
+
         stacked = backend.batched_map(
             kernel, task_args, shared,
             round_size=parse_partitions(self.partitions, len(self.pairs_)),
+            shared_specs=row_sharded_specs(
+                backend, shared, {"X": 0, "y": 0}
+            ),
         )
         self.estimators_ = [
             _make_fitted_binary(

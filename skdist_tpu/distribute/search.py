@@ -45,7 +45,7 @@ from ..metrics import (
     default_device_scorer,
     device_scorer_compatible,
 )
-from ..parallel import parse_partitions, resolve_backend
+from ..parallel import parse_partitions, resolve_backend, row_sharded_specs
 from ..utils.validation import (
     check_estimator_backend,
     check_is_fitted,
@@ -163,6 +163,14 @@ def _resolve_device_scoring(estimator, scoring):
         kernel, kind = DEVICE_SCORERS[metric]
         specs.append((out_name, metric, kernel, kind))
     return specs
+
+
+#: sample-axis layout of the CV shared dict (consumed by
+#: parallel.row_sharded_specs on 2D meshes)
+_CV_SAMPLE_AXES = {
+    "X": 0, "y": 0, "sw": 0, "Y": 0,
+    "train_masks": 1, "test_masks": 1,
+}
 
 
 _CV_KERNEL_CACHE = {}
@@ -434,7 +442,10 @@ class DistBaseSearchCV(BaseEstimator):
             }
             round_size = parse_partitions(self.partitions, len(split_ids))
             scores = backend.batched_map(
-                kernel, task_args, shared, round_size=round_size
+                kernel, task_args, shared, round_size=round_size,
+                shared_specs=row_sharded_specs(
+                    backend, shared, _CV_SAMPLE_AXES
+                ),
             )
             # unpack into global task order
             t = 0

@@ -12,6 +12,7 @@ from .backend import (
     get_value,
     parse_partitions,
     resolve_backend,
+    row_sharded_specs,
 )
 
 __all__ = [
@@ -21,4 +22,5 @@ __all__ = [
     "resolve_backend",
     "parse_partitions",
     "get_value",
+    "row_sharded_specs",
 ]

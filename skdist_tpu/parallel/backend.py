@@ -81,7 +81,7 @@ class TaskBackend:
         raise NotImplementedError
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None):
+                    round_size=None, shared_specs=None):
         raise NotImplementedError
 
     # fitted estimators must never hold a live backend; give pickle a
@@ -122,7 +122,7 @@ class LocalBackend(TaskBackend):
             return list(pool.map(fn, tasks))
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None):
+                    round_size=None, shared_specs=None):
         """Run the stacked kernel on the host's default JAX device.
 
         Same compiled program as the TPU path minus the mesh sharding, so
@@ -148,21 +148,51 @@ class TPUBackend(TaskBackend):
 
     is_device_backend = True
 
-    def __init__(self, devices=None, axis_name="tasks", round_size=None, n_jobs=None):
+    def __init__(self, devices=None, axis_name="tasks", round_size=None,
+                 n_jobs=None, data_axis_size=1, mesh=None):
+        """``data_axis_size`` > 1 builds a 2D ('tasks', 'data') mesh:
+        that many devices cooperate on each task with row-sharded shared
+        data (GSPMD inserts the psum of gram/gradient partials over
+        ICI), while tasks fan out over the remaining factor. The default
+        1D mesh replicates shared data and gives every task one device.
+        An explicit ``mesh`` (e.g. from ``parallel.mesh`` helpers) is
+        used as-is; its leading axis is the task axis and a 'data' axis,
+        if present, row-shards.
+        """
         import jax
         from jax.sharding import Mesh
 
+        self.round_size = round_size
+        self.n_jobs = n_jobs
+        if mesh is not None:
+            self.mesh = mesh
+            self.devices = list(mesh.devices.flat)
+            self.axis_name = mesh.axis_names[0]
+            self.data_axis_size = dict(
+                zip(mesh.axis_names, mesh.devices.shape)
+            ).get("data", 1)
+            return
         if devices is None:
             devices = jax.devices()
         self.devices = list(devices)
         self.axis_name = axis_name
-        self.round_size = round_size
-        self.n_jobs = n_jobs
-        self.mesh = Mesh(np.array(self.devices), (axis_name,))
+        self.data_axis_size = data_axis_size
+        if data_axis_size > 1:
+            if axis_name != "tasks":
+                raise ValueError(
+                    "data_axis_size > 1 uses the fixed ('tasks', 'data') "
+                    f"mesh; axis_name={axis_name!r} cannot be honoured"
+                )
+            from .mesh import task_data_mesh
+
+            self.mesh = task_data_mesh(self.devices, data_axis_size)
+        else:
+            self.mesh = Mesh(np.array(self.devices), (axis_name,))
 
     @property
     def n_devices(self):
-        return len(self.devices)
+        """Task-axis extent: the number of task slots per round."""
+        return self.mesh.shape[self.axis_name]
 
     # generic host path (non-JAX estimators under a TPU backend still
     # fan out on host threads, like pyspark running a python closure)
@@ -180,13 +210,17 @@ class TPUBackend(TaskBackend):
         return _BroadcastHandle(value)
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None):
+                    round_size=None, shared_specs=None):
         """Stack → shard → compile once → run in rounds → gather.
 
         ``task_args``: pytree whose leaves have a leading axis of length
-        n_tasks. ``shared_args``: pytree replicated to every device.
-        ``round_size`` (per-call, falls back to the backend default)
-        bounds tasks per round. Returns host numpy, leading axis n_tasks.
+        n_tasks. ``shared_args``: pytree placed on the mesh —
+        replicated by default, or per-leaf ``PartitionSpec``s via
+        ``shared_specs`` (a pytree matching ``shared_args`` with specs
+        at row-sharded leaves and None for replicated; only meaningful
+        with a 'data' mesh axis). ``round_size`` (per-call, falls back
+        to the backend default) bounds tasks per round. Returns host
+        numpy, leading axis n_tasks.
         """
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -199,8 +233,21 @@ class TPUBackend(TaskBackend):
 
         task_sharding = NamedSharding(self.mesh, P(self.axis_name))
         rep_sharding = NamedSharding(self.mesh, P())
-        shared_args = jax.device_put(shared_args, rep_sharding)
-        fn = _jit_vmapped(kernel, static_args, task_sharding, rep_sharding)
+        if shared_specs is not None and self.data_axis_size > 1:
+            # spec tree mirrors shared_args; None leaves mean replicated
+            shared_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(
+                    self.mesh, spec if isinstance(spec, P) else P()
+                ),
+                shared_specs,
+                is_leaf=lambda x: x is None or isinstance(x, P),
+            )
+        else:
+            shared_shardings = rep_sharding
+        shared_args = jax.device_put(shared_args, shared_shardings)
+        fn = _jit_vmapped(
+            kernel, static_args, task_sharding, shared_shardings
+        )
         return _run_in_rounds(
             fn, task_args, shared_args, n_tasks, chunk,
             put=lambda t: jax.device_put(t, task_sharding),
@@ -246,17 +293,23 @@ def _leading_dim(task_args):
 _JIT_CACHE = {}
 
 
-def _jit_vmapped(kernel, static_args, task_sharding=None, rep_sharding=None):
+def _jit_vmapped(kernel, static_args, task_sharding=None,
+                 shared_shardings=None):
     """jit(vmap(kernel)) with the task axis mapped; cached per kernel+config.
 
     ``kernel(shared_args, one_task_args, **static)`` → pytree of arrays.
+    ``shared_shardings`` may be a single sharding (replicated) or a
+    pytree mirroring the shared args (row-sharded 'data' leaves).
     """
     import jax
 
     static_args = tuple(sorted((static_args or {}).items()))
     # NamedSharding hashes by (mesh, spec): distinct meshes/device sets
-    # must never share a compiled fn
-    key = (kernel, static_args, task_sharding, rep_sharding)
+    # must never share a compiled fn. Sharding pytrees are flattened to
+    # a hashable key.
+    shared_leaves, shared_def = jax.tree_util.tree_flatten(shared_shardings)
+    key = (kernel, static_args, task_sharding,
+           tuple(shared_leaves), shared_def)
     fn = _JIT_CACHE.get(key)
     if fn is None:
         static = dict(static_args)
@@ -267,13 +320,34 @@ def _jit_vmapped(kernel, static_args, task_sharding=None, rep_sharding=None):
         if task_sharding is not None:
             fn = jax.jit(
                 mapped,
-                in_shardings=(rep_sharding, task_sharding),
+                in_shardings=(shared_shardings, task_sharding),
                 out_shardings=task_sharding,
             )
         else:
             fn = jax.jit(mapped)
         _JIT_CACHE[key] = fn
     return fn
+
+
+def row_sharded_specs(backend, shared, sample_axes):
+    """Build ``shared_specs`` for :meth:`TaskBackend.batched_map`.
+
+    ``sample_axes`` maps shared-dict keys to the axis index holding the
+    per-sample dimension (which rides the mesh 'data' axis); keys not
+    listed replicate. Each batched-path call site declares its own
+    layout explicitly. Returns None on 1D meshes (fully replicated).
+    """
+    if getattr(backend, "data_axis_size", 1) <= 1:
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    specs = {}
+    for key in shared:
+        ax = sample_axes.get(key)
+        specs[key] = (
+            None if ax is None else P(*([None] * ax), "data")
+        )
+    return specs
 
 
 def resolve_backend(backend, n_jobs=None):
@@ -293,7 +367,8 @@ def resolve_backend(backend, n_jobs=None):
         from jax.sharding import Mesh
 
         if isinstance(backend, Mesh):
-            return TPUBackend(devices=list(backend.devices.flat), n_jobs=n_jobs)
+            # the mesh is adopted whole — a 'data' axis keeps row-sharding
+            return TPUBackend(mesh=backend, n_jobs=n_jobs)
     except ImportError:  # pragma: no cover
         pass
     if isinstance(backend, (list, tuple)):

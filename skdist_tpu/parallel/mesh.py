@@ -1,0 +1,83 @@
+"""
+Mesh construction helpers: single-host, multi-host (DCN × ICI), and the
+2D tasks × data layout the estimators use.
+
+The reference's "cluster" was a Spark deployment reached through one
+SparkContext. Here the cluster is a ``jax.sharding.Mesh``:
+
+- single host: all local devices on one 'tasks' axis (optionally split
+  with a 'data' axis for row-sharding big X);
+- multi-host: ``jax.distributed.initialize`` (the driver's analogue of
+  spark-submit) makes every host see the global device set; the same
+  SPMD program then runs on each host with the mesh spanning hosts.
+  Lay the 'data' axis along ICI (fast all-reduce of gram/gradient
+  partials) and the 'tasks' axis across DCN (embarrassingly parallel —
+  no cross-task traffic), which is exactly what
+  ``create_hybrid_device_mesh`` produces.
+"""
+
+import numpy as np
+
+__all__ = [
+    "initialize_cluster",
+    "task_data_mesh",
+    "multihost_task_mesh",
+]
+
+
+def initialize_cluster(coordinator_address=None, num_processes=None,
+                       process_id=None):
+    """Join this host to a multi-host JAX cluster (no-op if already
+    initialised or single-host). Wrapper over jax.distributed."""
+    import jax
+
+    if num_processes in (None, 0, 1):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def task_data_mesh(devices=None, data_axis_size=1):
+    """2D mesh ('tasks', 'data') over the given (default: all) devices.
+
+    ``data_axis_size`` devices cooperate on each fit (row-sharded X,
+    psum'd reductions); the remaining factor fans tasks out.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if data_axis_size < 1 or n % data_axis_size != 0:
+        raise ValueError(
+            f"data_axis_size={data_axis_size} must divide device count {n}"
+        )
+    arr = np.array(devices).reshape(n // data_axis_size, data_axis_size)
+    return Mesh(arr, ("tasks", "data"))
+
+
+def multihost_task_mesh(data_axis_size=None):
+    """Global 2D mesh for multi-host runs: 'data' along each host's
+    local devices (ICI), 'tasks' across hosts (DCN)."""
+    import jax
+
+    local = jax.local_device_count()
+    if data_axis_size is None:
+        data_axis_size = local
+    try:
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh
+
+        n_hosts = jax.device_count() // local
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, data_axis_size),
+            dcn_mesh_shape=(n_hosts * (local // data_axis_size), 1),
+        )
+        return Mesh(arr.reshape(-1, data_axis_size), ("tasks", "data"))
+    except Exception:
+        return task_data_mesh(data_axis_size=data_axis_size)

@@ -98,6 +98,20 @@ def test_local_backend_batched_map_matches(tpu_backend):
     assert np.allclose(local["v"], dist["v"], atol=1e-6)
 
 
+def test_resolve_backend_adopts_2d_mesh():
+    """Passing a tasks x data Mesh as backend= must keep the data axis
+    (regression: it was flattened to a 1D mesh)."""
+    from skdist_tpu.parallel import resolve_backend
+    from skdist_tpu.parallel.mesh import task_data_mesh
+
+    mesh = task_data_mesh(data_axis_size=2)
+    be = resolve_backend(mesh)
+    assert be.data_axis_size == 2
+    assert be.mesh is mesh
+    with pytest.raises(ValueError):
+        TPUBackend(axis_name="work", data_axis_size=2)
+
+
 def test_tpu_backend_rounds(tpu_backend):
     """Chunked rounds (round_size) must give identical results."""
     import jax.numpy as jnp

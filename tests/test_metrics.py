@@ -1,0 +1,151 @@
+"""
+Device scorer kernels vs sklearn metrics: mask-weighted kernels on the
+full array must equal sklearn computed on the masked subset — the
+contract the batched CV path rests on.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from skdist_tpu import metrics as M
+
+
+@pytest.fixture
+def scored_problem():
+    rng = np.random.RandomState(0)
+    n, k = 500, 4
+    y = rng.randint(0, k, size=n)
+    scores = rng.normal(size=(n, k)).astype(np.float32)
+    scores[np.arange(n), y] += 1.0  # make predictions correlated
+    mask = (rng.rand(n) > 0.4).astype(np.float32)
+    meta = {"n_classes": k}
+    return y, scores, mask, meta
+
+
+def _subset(y, scores, mask):
+    idx = mask > 0
+    return y[idx], scores[idx]
+
+
+def test_accuracy(scored_problem):
+    from sklearn.metrics import accuracy_score
+
+    y, s, m, meta = scored_problem
+    ours = float(M.accuracy(jnp.asarray(y), jnp.asarray(s), jnp.asarray(m), meta))
+    ys, ss = _subset(y, s, m)
+    assert abs(ours - accuracy_score(ys, ss.argmax(1))) < 1e-6
+
+
+@pytest.mark.parametrize("avg", ["macro", "micro", "weighted"])
+def test_f1_variants(scored_problem, avg):
+    from sklearn.metrics import f1_score
+
+    y, s, m, meta = scored_problem
+    kernel = {"macro": M.f1_macro, "micro": M.f1_micro,
+              "weighted": M.f1_weighted}[avg]
+    ours = float(kernel(jnp.asarray(y), jnp.asarray(s), jnp.asarray(m), meta))
+    ys, ss = _subset(y, s, m)
+    ref = f1_score(ys, ss.argmax(1), average=avg)
+    assert abs(ours - ref) < 1e-6
+
+
+def test_precision_recall_balanced_acc(scored_problem):
+    from sklearn.metrics import (
+        balanced_accuracy_score,
+        precision_score,
+        recall_score,
+    )
+
+    y, s, m, meta = scored_problem
+    ys, ss = _subset(y, s, m)
+    pred = ss.argmax(1)
+    assert abs(
+        float(M.precision_weighted(jnp.asarray(y), jnp.asarray(s),
+                                   jnp.asarray(m), meta))
+        - precision_score(ys, pred, average="weighted")
+    ) < 1e-6
+    assert abs(
+        float(M.recall_weighted(jnp.asarray(y), jnp.asarray(s),
+                                jnp.asarray(m), meta))
+        - recall_score(ys, pred, average="weighted")
+    ) < 1e-6
+    assert abs(
+        float(M.balanced_accuracy(jnp.asarray(y), jnp.asarray(s),
+                                  jnp.asarray(m), meta))
+        - balanced_accuracy_score(ys, pred)
+    ) < 1e-6
+
+
+def test_neg_log_loss(scored_problem):
+    from sklearn.metrics import log_loss
+
+    y, s, m, meta = scored_problem
+    p = np.exp(s) / np.exp(s).sum(1, keepdims=True)
+    ours = float(M.neg_log_loss(jnp.asarray(y), jnp.asarray(p),
+                                jnp.asarray(m), meta))
+    ys_idx = m > 0
+    ref = -log_loss(y[ys_idx], p[ys_idx], labels=list(range(meta["n_classes"])))
+    assert abs(ours - ref) < 1e-5
+
+
+def test_roc_auc_binary_with_ties():
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.RandomState(1)
+    n = 400
+    y = rng.randint(0, 2, size=n)
+    # quantised scores force ties
+    s = np.round(rng.normal(size=n) + y, 1).astype(np.float32)
+    m = (rng.rand(n) > 0.3).astype(np.float32)
+    meta = {"n_classes": 2}
+    ours = float(M.roc_auc_binary(jnp.asarray(y), jnp.asarray(s),
+                                  jnp.asarray(m), meta))
+    idx = m > 0
+    ref = roc_auc_score(y[idx], s[idx])
+    assert abs(ours - ref) < 1e-5
+
+
+def test_regression_metrics():
+    from sklearn.metrics import (
+        mean_absolute_error,
+        mean_squared_error,
+        r2_score,
+    )
+
+    rng = np.random.RandomState(2)
+    n = 300
+    y = rng.normal(size=n).astype(np.float32)
+    pred = (y + 0.3 * rng.normal(size=n)).astype(np.float32)
+    m = (rng.rand(n) > 0.4).astype(np.float32)
+    idx = m > 0
+    meta = {}
+    assert abs(
+        float(M.r2(jnp.asarray(y), jnp.asarray(pred), jnp.asarray(m), meta))
+        - r2_score(y[idx], pred[idx])
+    ) < 1e-5
+    assert abs(
+        float(M.neg_mean_squared_error(jnp.asarray(y), jnp.asarray(pred),
+                                       jnp.asarray(m), meta))
+        + mean_squared_error(y[idx], pred[idx])
+    ) < 1e-5
+    assert abs(
+        float(M.neg_mean_absolute_error(jnp.asarray(y), jnp.asarray(pred),
+                                        jnp.asarray(m), meta))
+        + mean_absolute_error(y[idx], pred[idx])
+    ) < 1e-5
+
+
+def test_sample_weighted_scoring():
+    """Non-binary weights: device kernels implement the weighted metric."""
+    from sklearn.metrics import accuracy_score
+
+    rng = np.random.RandomState(3)
+    n, k = 200, 3
+    y = rng.randint(0, k, size=n)
+    s = rng.normal(size=(n, k)).astype(np.float32)
+    w = rng.rand(n).astype(np.float32)
+    meta = {"n_classes": k}
+    ours = float(M.accuracy(jnp.asarray(y), jnp.asarray(s), jnp.asarray(w), meta))
+    ref = accuracy_score(y, s.argmax(1), sample_weight=w)
+    assert abs(ours - ref) < 1e-5

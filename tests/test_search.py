@@ -91,6 +91,28 @@ def test_batched_on_device_mesh(clf_data, tpu_backend):
     pickle.dumps(dist)
 
 
+def test_2d_mesh_data_sharding(clf_data):
+    """tasks x data 2D mesh: rows of X shard over the 'data' axis while
+    tasks fan out over 'tasks'; results must match the 1D mesh."""
+    from skdist_tpu.parallel import TPUBackend
+
+    X, y = clf_data
+    grid = {"C": [0.1, 1.0, 10.0]}
+    flat = DistGridSearchCV(
+        LogisticRegression(max_iter=100), grid, backend=TPUBackend(),
+        cv=3, scoring="accuracy",
+    ).fit(X, y)
+    two_d = DistGridSearchCV(
+        LogisticRegression(max_iter=100), grid,
+        backend=TPUBackend(data_axis_size=2), cv=3, scoring="accuracy",
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        flat.cv_results_["mean_test_score"],
+        two_d.cv_results_["mean_test_score"],
+        atol=1e-3,
+    )
+
+
 def test_multimetric(clf_data):
     X, y = clf_data
     gs = DistGridSearchCV(
