@@ -45,14 +45,19 @@ def make_20news_shaped(seed=0, n=11314, d=4096, k=20):
     return X, y
 
 
-def main():
+def main(quick=False):
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression
     from skdist_tpu.parallel import TPUBackend
 
-    X, y = make_20news_shaped()
-    grid = {"C": list(np.logspace(-3, 2, 96))}
-    n_fits = 96 * 5
+    if quick:  # smoke-test mode: same code path, small shapes
+        X, y = make_20news_shaped(n=800, d=256, k=5)
+        grid = {"C": list(np.logspace(-3, 2, 8))}
+        n_fits = 8 * 5
+    else:
+        X, y = make_20news_shaped()
+        grid = {"C": list(np.logspace(-3, 2, 96))}
+        n_fits = 96 * 5
     est = LogisticRegression(max_iter=30, tol=1e-4)
 
     def run_once():
@@ -94,8 +99,13 @@ def main():
     sk_per_fit = (time.perf_counter() - t0) / n_sample_fits
     sk_fits_per_sec = 1.0 / sk_per_fit
 
+    label = (
+        "DistGridSearchCV fits/sec (QUICK smoke, 8x5)"
+        if quick else
+        "DistGridSearchCV fits/sec (20news-shaped LogReg, 96x5)"
+    )
     print(json.dumps({
-        "metric": "DistGridSearchCV fits/sec (20news-shaped LogReg, 96x5)",
+        "metric": label,
         "value": round(fits_per_sec, 2),
         "unit": "fits/sec",
         "vs_baseline": round(fits_per_sec / sk_fits_per_sec, 2),
@@ -111,4 +121,6 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    main(quick="--quick" in sys.argv)

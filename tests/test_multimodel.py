@@ -78,6 +78,53 @@ def test_validation_errors():
         DistMultiModelSearch(bad).fit(np.zeros((4, 2)), [0, 1, 0, 1])
 
 
+def test_empty_param_dict_model(clf_data):
+    """Models with an empty param dict get exactly one candidate
+    (reference test_search.py: GaussianNB with {})."""
+    from sklearn.naive_bayes import GaussianNB
+
+    X, y = clf_data
+    mm = DistMultiModelSearch(
+        [("lr", LogisticRegression(max_iter=50), {"C": [0.1, 1.0]}),
+         ("nb", GaussianNB(), {})],
+        n=2, cv=2, scoring="accuracy", random_state=0,
+    ).fit(X, y)
+    names = mm.cv_results_["model_name"]
+    assert names.count("nb") == 1
+    assert names.count("lr") == 2
+
+
+def test_fit_params_passthrough(clf_data):
+    """**fit_params reach the estimator's fit in both the grid search
+    and the multi-model search (reference xgboost early-stopping test
+    pattern, test_search.py:86-101)."""
+    from sklearn.linear_model import LogisticRegression as SkLR
+    from skdist_tpu.distribute.search import DistGridSearchCV
+
+    X, y = clf_data
+    seen = []
+
+    class NeedsParam(SkLR):
+        def fit(self, X, y, marker=None, sample_weight=None):
+            seen.append(marker)
+            return super().fit(X, y, sample_weight=sample_weight)
+
+    gs = DistGridSearchCV(
+        NeedsParam(max_iter=100), {"C": [1.0]}, cv=2
+    ).fit(X, y, marker="hello")
+    assert "hello" in seen
+    assert gs.score(X, y) > 0.9
+
+    seen.clear()
+    mm = DistMultiModelSearch(
+        [("np", NeedsParam(max_iter=100), {"C": [1.0]})],
+        n=1, cv=2, scoring="accuracy",
+    ).fit(X, y, marker="mm")
+    # per-fold tasks AND the winner refit must both see the param
+    assert seen.count("mm") == 3
+    assert mm.best_model_name_ == "np"
+
+
 def test_failed_model_not_selected(clf_data):
     """A model whose fits all fail (NaN scores) must not win
     (regression: np.argmax returned the NaN index)."""

@@ -289,6 +289,22 @@ def test_backend_and_template_not_mutated(clf_data, tpu_backend):
     )
 
 
+def test_pipeline_base_estimator(clf_data):
+    """sklearn Pipelines as the searched estimator, with step-addressed
+    params (ubiquitous sk-dist usage pattern)."""
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    pipe = Pipeline([("sc", StandardScaler()), ("lr", SkLR(max_iter=200))])
+    gs = DistGridSearchCV(
+        pipe, {"lr__C": [0.1, 1.0], "sc__with_mean": [True, False]}, cv=2
+    ).fit(X, y)
+    assert set(gs.best_params_) == {"lr__C", "sc__with_mean"}
+    assert gs.score(X, y) > 0.9
+
+
 def test_verbose_prints(clf_data, capsys):
     X, y = clf_data
     DistGridSearchCV(
