@@ -61,7 +61,8 @@ class DistRandomForestClassifier(_DistForestMixin, RandomForestClassifier):
     def __init__(self, n_estimators=100, backend=None, partitions="auto",
                  max_depth=8, n_bins=32, max_features="sqrt",
                  min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, bootstrap=True, warm_start=False,
+                 min_impurity_decrease=0.0, bootstrap=True, oob_score=False,
+                 class_weight=None, warm_start=False,
                  random_state=None, n_jobs=None, verbose=0):
         RandomForestClassifier.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
@@ -69,6 +70,7 @@ class DistRandomForestClassifier(_DistForestMixin, RandomForestClassifier):
             min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            oob_score=oob_score, class_weight=class_weight,
             warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
         )
         self.backend = backend
@@ -82,15 +84,16 @@ class DistRandomForestRegressor(_DistForestMixin, RandomForestRegressor):
     def __init__(self, n_estimators=100, backend=None, partitions="auto",
                  max_depth=8, n_bins=32, max_features=1.0,
                  min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, bootstrap=True, warm_start=False,
-                 random_state=None, n_jobs=None, verbose=0):
+                 min_impurity_decrease=0.0, bootstrap=True, oob_score=False,
+                 warm_start=False, random_state=None, n_jobs=None, verbose=0):
         RandomForestRegressor.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
             n_bins=n_bins, max_features=max_features,
             min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
-            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+            oob_score=oob_score, warm_start=warm_start,
+            random_state=random_state, n_jobs=n_jobs,
         )
         self.backend = backend
         self.partitions = partitions
@@ -103,7 +106,8 @@ class DistExtraTreesClassifier(_DistForestMixin, ExtraTreesClassifier):
     def __init__(self, n_estimators=100, backend=None, partitions="auto",
                  max_depth=8, n_bins=32, max_features="sqrt",
                  min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, bootstrap=False, warm_start=False,
+                 min_impurity_decrease=0.0, bootstrap=False, oob_score=False,
+                 class_weight=None, warm_start=False,
                  random_state=None, n_jobs=None, verbose=0):
         ExtraTreesClassifier.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
@@ -111,6 +115,7 @@ class DistExtraTreesClassifier(_DistForestMixin, ExtraTreesClassifier):
             min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            oob_score=oob_score, class_weight=class_weight,
             warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
         )
         self.backend = backend
@@ -124,15 +129,16 @@ class DistExtraTreesRegressor(_DistForestMixin, ExtraTreesRegressor):
     def __init__(self, n_estimators=100, backend=None, partitions="auto",
                  max_depth=8, n_bins=32, max_features=1.0,
                  min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, bootstrap=False, warm_start=False,
-                 random_state=None, n_jobs=None, verbose=0):
+                 min_impurity_decrease=0.0, bootstrap=False, oob_score=False,
+                 warm_start=False, random_state=None, n_jobs=None, verbose=0):
         ExtraTreesRegressor.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
             n_bins=n_bins, max_features=max_features,
             min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
-            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+            oob_score=oob_score, warm_start=warm_start,
+            random_state=random_state, n_jobs=n_jobs,
         )
         self.backend = backend
         self.partitions = partitions

@@ -22,7 +22,12 @@ import jax.numpy as jnp
 from ..base import BaseEstimator, ClassifierMixin, RegressorMixin, TransformerMixin
 from ..ops.binning import apply_bins, quantile_bin_edges
 from ..parallel import LocalBackend
-from .linear import as_dense_f32, encode_labels, prepare_sample_weight
+from .linear import (
+    as_dense_f32,
+    class_weight_vector,
+    encode_labels,
+    prepare_sample_weight,
+)
 from .tree import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
@@ -70,13 +75,45 @@ def _forest_walker(max_depth, mode):
     return fn
 
 
+def _bootstrap_counts(seed, n, dtype=jnp.float32):
+    """Reproduce a tree's bootstrap draw from its seed (the same draw
+    the fit kernel made), so OOB masks never need to be persisted."""
+    kboot, _ = jax.random.split(jax.random.PRNGKey(seed))
+    idx = jax.random.randint(kboot, (n,), 0, n)
+    return jnp.zeros((n,), dtype).at[idx].add(1.0)
+
+
+def _oob_aggregator(max_depth):
+    """Cached jitted OOB aggregation (same function-identity caching
+    rationale as _forest_walker). Masks are regenerated from the stored
+    per-tree seeds, so warm-started trees participate too."""
+    key = (max_depth, "oob")
+    fn = _WALKER_CACHE.get(key)
+    if fn is None:
+        walk = tree_predict_kernel(max_depth)
+
+        @jax.jit
+        def fn(trees, seeds, Xb):
+            n = Xb.shape[0]
+            per_tree = jax.vmap(lambda t: walk(t, Xb))(trees)  # (T, n, K)
+            counts = jax.vmap(lambda s: _bootstrap_counts(s, n))(seeds)
+            m = (counts == 0).astype(per_tree.dtype)  # (T, n)
+            num = jnp.sum(per_tree * m[:, :, None], axis=0)
+            cnt = jnp.sum(m, axis=0)
+            return num / jnp.maximum(cnt, 1.0)[:, None], cnt
+
+        _WALKER_CACHE[key] = fn
+    return fn
+
+
 def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
                             min_samples_split, min_samples_leaf,
                             min_impurity_decrease, extra, classification,
                             bootstrap):
     """One-tree task kernel for ``backend.batched_map``: the task is a
     scalar PRNG seed (mirroring the reference's per-tree random states,
-    ensemble.py:278)."""
+    ensemble.py:278). The seed is stored with the tree so OOB masks
+    (``_oob_aggregator``) regenerate the bootstrap draw on demand."""
     grow = build_tree_kernel(
         n_features=d, n_bins=n_bins, channels=channels, max_depth=max_depth,
         max_features=max_features, min_samples_split=min_samples_split,
@@ -90,17 +127,19 @@ def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
         Xb, y, sw = shared["Xb"], shared["y"], shared["sw"]
         n = Xb.shape[0]
         key = jax.random.PRNGKey(task["seed"])
-        kboot, kgrow = jax.random.split(key)
+        _, kgrow = jax.random.split(key)
         w = sw
         if bootstrap:
-            idx = jax.random.randint(kboot, (n,), 0, n)
-            counts = jnp.zeros((n,), sw.dtype).at[idx].add(1.0)
-            w = sw * counts
+            w = sw * _bootstrap_counts(task["seed"], n, sw.dtype)
         if classification:
             Ych = classification_channels(y, w, K)
         else:
             Ych = regression_channels(y, w)
-        return grow(Xb, Ych, kgrow)
+        tree = grow(Xb, Ych, kgrow)
+        # the seed travels with the tree: OOB masks and bootstrap draws
+        # are reproducible from it (nothing O(n) is persisted)
+        tree["seed"] = task["seed"]
+        return tree
 
     return kernel
 
@@ -117,8 +156,9 @@ class _BaseForest(BaseEstimator):
 
     def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
                  max_features="sqrt", min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, bootstrap=True, warm_start=False,
-                 random_state=None, n_jobs=None):
+                 min_impurity_decrease=0.0, bootstrap=True, oob_score=False,
+                 class_weight=None, warm_start=False, random_state=None,
+                 n_jobs=None):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.n_bins = n_bins
@@ -127,6 +167,8 @@ class _BaseForest(BaseEstimator):
         self.min_samples_leaf = min_samples_leaf
         self.min_impurity_decrease = min_impurity_decrease
         self.bootstrap = bootstrap
+        self.oob_score = oob_score
+        self.class_weight = class_weight
         self.warm_start = warm_start
         self.random_state = random_state
         self.n_jobs = n_jobs
@@ -156,10 +198,25 @@ class _BaseForest(BaseEstimator):
             self.classes_ = classes
             K = len(classes)
             channels = K + 1
+            cw = getattr(self, "class_weight", None)
+            if cw is not None:
+                if cw == "balanced":
+                    counts = np.bincount(y_enc, minlength=K).astype(np.float64)
+                    per_class = len(y_enc) / (K * np.maximum(counts, 1))
+                elif isinstance(cw, dict):
+                    per_class = class_weight_vector(cw, classes)
+                else:
+                    raise ValueError(
+                        f"Unsupported class_weight {cw!r}: use 'balanced' "
+                        "or a {label: weight} dict"
+                    )
+                sw = sw * per_class[y_enc].astype(np.float32)
         else:
             y_enc = np.asarray(y, dtype=np.float32)
             K = 1
             channels = 4
+        if self.oob_score and not self.bootstrap:
+            raise ValueError("oob_score requires bootstrap=True")
 
         prev = getattr(self, "_trees", None) if warm else None
         n_prev = 0
@@ -205,7 +262,45 @@ class _BaseForest(BaseEstimator):
                 self._trees = new_trees
         self._edges = edges
         self.n_features_in_ = d
+        if self.oob_score:
+            self._compute_oob(X, y_enc)
         return self
+
+    def _compute_oob(self, X, y_enc):
+        """Real out-of-bag scoring (the reference stubbed this,
+        ensemble.py:338-340): each sample is scored by the trees whose
+        bootstrap missed it. The per-tree masks are consumed here and
+        stripped from the fitted trees — they index the training rows
+        and must not survive into predict/pickle/warm-start."""
+        trees = jax.tree_util.tree_map(jnp.asarray, self._trees)
+        Xb = apply_bins(jnp.asarray(X), jnp.asarray(self._edges))
+        oob_agg = _oob_aggregator(self.max_depth)
+        agg, cnt = jax.device_get(
+            oob_agg(trees, trees["seed"], Xb)
+        )
+        covered = np.asarray(cnt) > 0
+        if not covered.all():
+            import warnings
+
+            warnings.warn(
+                "Some samples were in-bag for every tree; OOB estimates "
+                "for them are undefined and excluded from oob_score_."
+            )
+        if self._classification:
+            self.oob_decision_function_ = agg
+            pred = np.argmax(agg, axis=1)
+            self.oob_score_ = float(
+                np.mean(pred[covered] == np.asarray(y_enc)[covered])
+            ) if covered.any() else float("nan")
+        else:
+            self.oob_prediction_ = agg[:, 0]
+            yv = np.asarray(y_enc)[covered]
+            pv = agg[covered, 0]
+            ss_res = float(np.sum((yv - pv) ** 2))
+            ss_tot = float(np.sum((yv - yv.mean()) ** 2))
+            self.oob_score_ = (
+                1.0 - ss_res / ss_tot if ss_tot > 0 else float("nan")
+            )
 
     # ------------------------------------------------------------------
     def _check_fitted(self):
@@ -304,14 +399,15 @@ class RandomForestClassifier(_BaseForest, _ForestClassifierMixin):
 class RandomForestRegressor(_BaseForest, _ForestRegressorMixin):
     def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
                  max_features=1.0, min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, bootstrap=True, warm_start=False,
-                 random_state=None, n_jobs=None):
+                 min_impurity_decrease=0.0, bootstrap=True, oob_score=False,
+                 warm_start=False, random_state=None, n_jobs=None):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
             max_features=max_features, min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
-            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+            oob_score=oob_score, warm_start=warm_start,
+            random_state=random_state, n_jobs=n_jobs,
         )
 
 
@@ -323,13 +419,15 @@ class ExtraTreesClassifier(_BaseForest, _ForestClassifierMixin):
 
     def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
                  max_features="sqrt", min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, bootstrap=False, warm_start=False,
-                 random_state=None, n_jobs=None):
+                 min_impurity_decrease=0.0, bootstrap=False, oob_score=False,
+                 class_weight=None, warm_start=False, random_state=None,
+                 n_jobs=None):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
             max_features=max_features, min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
+            oob_score=oob_score, class_weight=class_weight,
             warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
         )
 
@@ -339,14 +437,15 @@ class ExtraTreesRegressor(_BaseForest, _ForestRegressorMixin):
 
     def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
                  max_features=1.0, min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, bootstrap=False, warm_start=False,
-                 random_state=None, n_jobs=None):
+                 min_impurity_decrease=0.0, bootstrap=False, oob_score=False,
+                 warm_start=False, random_state=None, n_jobs=None):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
             max_features=max_features, min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
-            warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+            oob_score=oob_score, warm_start=warm_start,
+            random_state=random_state, n_jobs=n_jobs,
         )
 
 

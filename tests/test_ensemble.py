@@ -166,6 +166,71 @@ def test_warm_start(clf_data):
         rf.fit(X, y)
 
 
+def test_oob_score(clf_data, reg_data):
+    """Real OOB scoring (the reference stubbed it, ensemble.py:338-340)."""
+    X, y = clf_data
+    rf = DistRandomForestClassifier(
+        n_estimators=30, max_depth=5, random_state=0, oob_score=True
+    ).fit(X, y)
+    assert 0.7 <= rf.oob_score_ <= 1.0
+    assert rf.oob_decision_function_.shape == (len(y), 3)
+    # OOB is honest: no higher than train accuracy
+    assert rf.oob_score_ <= rf.score(X, y) + 1e-9
+    Xr, yr = reg_data
+    rfr = DistRandomForestRegressor(
+        n_estimators=30, max_depth=6, random_state=0, oob_score=True
+    ).fit(Xr, yr)
+    assert rfr.oob_prediction_.shape == (len(yr),)
+    assert rfr.oob_score_ <= rfr.score(Xr, yr) + 1e-9
+    with pytest.raises(ValueError):
+        DistRandomForestClassifier(
+            oob_score=True, bootstrap=False
+        ).fit(X, y)
+
+
+def test_oob_with_warm_start(clf_data):
+    """OOB masks regenerate from stored seeds, so warm-started trees
+    participate and nothing O(n) is persisted (regression)."""
+    X, y = clf_data
+    rf = DistRandomForestClassifier(
+        n_estimators=10, max_depth=5, random_state=0, oob_score=True,
+        warm_start=True,
+    ).fit(X, y)
+    first = rf.oob_score_
+    rf.n_estimators = 20
+    rf.fit(X, y)
+    assert rf._trees["feat"].shape[0] == 20
+    assert "oob_mask" not in rf._trees
+    # more trees -> more OOB coverage; score stays sane
+    assert 0.5 <= rf.oob_score_ <= 1.0
+    assert abs(rf.oob_score_ - first) < 0.3
+
+
+def test_forest_rejects_bad_class_weight(clf_data):
+    X, y = clf_data
+    with pytest.raises(ValueError):
+        DistRandomForestClassifier(
+            class_weight="balanced_subsample"
+        ).fit(X, y)
+
+
+def test_forest_class_weight(clf_data):
+    X, y = clf_data
+    keep = np.concatenate([np.where(y == 0)[0][:15], np.where(y != 0)[0]])
+    Xi, yi = X[keep], y[keep]
+    plain = DistRandomForestClassifier(
+        n_estimators=20, max_depth=5, random_state=0
+    ).fit(Xi, yi)
+    bal = DistRandomForestClassifier(
+        n_estimators=20, max_depth=5, random_state=0,
+        class_weight="balanced",
+    ).fit(Xi, yi)
+    # balanced weighting should help the starved class's recall
+    rec_plain = (plain.predict(Xi)[yi == 0] == 0).mean()
+    rec_bal = (bal.predict(Xi)[yi == 0] == 0).mean()
+    assert rec_bal >= rec_plain - 0.05
+
+
 def test_warm_start_keeps_edges(clf_data):
     """Warm refit must not rebin old trees' thresholds (regression:
     edges were recomputed from the new X)."""
