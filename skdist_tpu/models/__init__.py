@@ -32,6 +32,7 @@ from .forest import (
     RandomForestRegressor,
     RandomTreesEmbedding,
 )
+from .naive_bayes import GaussianNB, MultinomialNB
 
 __all__ = [
     "LogisticRegression",
@@ -49,4 +50,6 @@ __all__ = [
     "ExtraTreesClassifier",
     "ExtraTreesRegressor",
     "RandomTreesEmbedding",
+    "GaussianNB",
+    "MultinomialNB",
 ]

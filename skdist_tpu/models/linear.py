@@ -200,6 +200,10 @@ class _LinearModelBase(BaseEstimator):
     @property
     def coef_(self):
         self._check_fitted()
+        if "W" not in self._params:
+            raise AttributeError(
+                f"{type(self).__name__} has no linear coefficients"
+            )
         W = np.asarray(self._params["W"])  # (d[+1], k) or (d[+1],)
         d = self.n_features_in_
         w = W[:d]
@@ -210,6 +214,10 @@ class _LinearModelBase(BaseEstimator):
     @property
     def intercept_(self):
         self._check_fitted()
+        if "W" not in self._params:
+            raise AttributeError(
+                f"{type(self).__name__} has no linear coefficients"
+            )
         W = np.asarray(self._params["W"])
         d = self.n_features_in_
         if not self._fit_intercept_flag():

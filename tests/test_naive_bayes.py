@@ -1,0 +1,120 @@
+"""
+Naive Bayes kernel parity tests vs sklearn.
+"""
+
+import numpy as np
+import pytest
+
+from skdist_tpu.models import GaussianNB, MultinomialNB
+
+
+def test_gaussian_nb_parity(clf_data):
+    from sklearn.naive_bayes import GaussianNB as SkGNB
+
+    X, y = clf_data
+    ours = GaussianNB().fit(X, y)
+    sk = SkGNB().fit(X, y)
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.99
+    np.testing.assert_allclose(
+        ours.predict_proba(X), sk.predict_proba(X), atol=1e-3
+    )
+
+
+def test_gaussian_nb_sample_weight(clf_data):
+    from sklearn.naive_bayes import GaussianNB as SkGNB
+
+    X, y = clf_data
+    w = np.random.RandomState(0).rand(len(y)).astype(np.float32)
+    ours = GaussianNB().fit(X, y, sample_weight=w)
+    sk = SkGNB().fit(X, y, sample_weight=w)
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.99
+
+
+def test_multinomial_nb_parity():
+    from sklearn.naive_bayes import MultinomialNB as SkMNB
+
+    rng = np.random.RandomState(0)
+    X = rng.poisson(2.0, size=(300, 40)).astype(np.float32)
+    y = (X[:, :5].sum(1) > X[:, 5:10].sum(1)).astype(int)
+    ours = MultinomialNB(alpha=1.0).fit(X, y)
+    sk = SkMNB(alpha=1.0).fit(X, y)
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.99
+    np.testing.assert_allclose(
+        ours.predict_proba(X), sk.predict_proba(X), atol=1e-3
+    )
+    # coef_ is the per-class feature log-probability (linear form)
+    assert ours.coef_.shape == (2, 40)
+
+
+def test_nb_in_batched_search(clf_data):
+    """var_smoothing / alpha ride the task axis of one program."""
+    from skdist_tpu.distribute.search import DistGridSearchCV
+
+    X, y = clf_data
+    gs = DistGridSearchCV(
+        GaussianNB(), {"var_smoothing": [1e-9, 1e-3, 1e-1]}, cv=3,
+        scoring="accuracy",
+    ).fit(X, y)
+    assert gs.best_score_ >= 0.9
+
+    Xc = np.abs(X) * 10
+    gs2 = DistGridSearchCV(
+        MultinomialNB(), {"alpha": [0.1, 1.0, 10.0]}, cv=3,
+        scoring="accuracy",
+    ).fit(Xc, y)
+    assert len(gs2.cv_results_["params"]) == 3
+
+
+def test_nb_in_multimodel(clf_data):
+    """The reference's multimodel test shape: GaussianNB with an empty
+    param dict alongside tuned models."""
+    from skdist_tpu.distribute.search import DistMultiModelSearch
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = clf_data
+    mm = DistMultiModelSearch(
+        [("lr", LogisticRegression(max_iter=50), {"C": [0.1, 1.0]}),
+         ("nb", GaussianNB(), {})],
+        n=2, cv=2, scoring="accuracy", random_state=0,
+    ).fit(X, y)
+    assert "nb" in mm.cv_results_["model_name"]
+
+
+def test_gnb_has_no_coef(clf_data):
+    X, y = clf_data
+    gnb = GaussianNB().fit(X, y)
+    with pytest.raises(AttributeError):
+        _ = gnb.coef_
+    # the AttributeError makes getattr-with-default fall through cleanly
+    assert getattr(gnb, "coef_", None) is None
+
+
+def test_gnb_large_mean_stability():
+    """Variance must not cancel catastrophically when |mean| >> std
+    (regression: E[x^2]-mean^2 in f32 on uncentred data)."""
+    from sklearn.naive_bayes import GaussianNB as SkGNB
+
+    rng = np.random.RandomState(0)
+    n = 400
+    y = rng.randint(0, 2, n)
+    X = (1e4 + y[:, None] * 2.0 + rng.normal(size=(n, 4))).astype(np.float32)
+    ours = GaussianNB().fit(X, y)
+    sk = SkGNB().fit(X.astype(np.float64), y)
+    assert (ours.predict(X) == sk.predict(X)).mean() >= 0.98
+
+
+def test_mnb_alpha_zero_no_nan():
+    """alpha=0 is clamped (sklearn semantics); no NaN scores
+    (regression)."""
+    rng = np.random.RandomState(0)
+    X = rng.poisson(1.0, size=(100, 20)).astype(np.float32)
+    X[:, 5] = 0.0  # zero-count feature
+    y = rng.randint(0, 2, 100)
+    m = MultinomialNB(alpha=0.0).fit(X, y)
+    assert not np.isnan(m.predict_proba(X)).any()
+
+
+def test_mnb_negative_input_rejected():
+    X = np.array([[1.0, -1.0], [2.0, 3.0]], dtype=np.float32)
+    with pytest.raises(ValueError):
+        MultinomialNB().fit(X, [0, 1])
