@@ -90,7 +90,7 @@ def _fit_and_score(estimator, X, y, scorers, train, test, parameters,
         if return_train_score:
             for name, scorer in scorers.items():
                 result[f"train_{name}"] = scorer(est, X_train, y_train)
-    except Exception:
+    except Exception as exc:
         # reference error_score policy (search.py:232-259): 'raise' or a
         # numeric substitute recorded with a warning
         fit_time = time.perf_counter() - start
@@ -102,7 +102,8 @@ def _fit_and_score(estimator, X, y, scorers, train, test, parameters,
                 "error_score must be 'raise' or numeric"
             ) from None
         warnings.warn(
-            f"Estimator fit failed; score set to {error_score}.",
+            f"Estimator fit failed ({type(exc).__name__}: {exc}); "
+            f"score set to {error_score}.",
             FitFailedWarning,
         )
         for name in scorers:
@@ -407,7 +408,13 @@ class DistBaseSearchCV(BaseEstimator):
             bucket_est = clone(estimator)
             if static_overrides:
                 bucket_est.set_params(**static_overrides)
-            data, meta = bucket_est._prep_fit_data(X_arr, y, None)
+            try:
+                data, meta = bucket_est._prep_fit_data(X_arr, y, None)
+            except Exception:
+                # estimator-level input validation failures must flow
+                # through the host path so the error_score contract
+                # (raise vs numeric substitute) applies per task
+                return None
             static = _freeze(bucket_est._static_config(meta))
             kernel = _cached_cv_kernel(
                 est_cls, meta, static, scorer_specs, self.return_train_score

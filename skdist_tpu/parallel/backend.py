@@ -257,10 +257,16 @@ class TPUBackend(TaskBackend):
 def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None):
     """Shared round loop: slice task axis, pad the tail round to the
     fixed chunk shape (padding duplicates the last task; its outputs are
-    sliced off), run, gather to host numpy, concatenate."""
+    sliced off), run, gather to host numpy, concatenate.
+
+    All rounds are DISPATCHED before any is gathered — JAX dispatch is
+    asynchronous, so round i+1's host-side slicing and transfer overlap
+    round i's device compute (round outputs are small score/param
+    stacks, so holding them on device is cheap).
+    """
     import jax
 
-    outs = []
+    pending = []
     for start in range(0, n_tasks, chunk):
         stop = min(start + chunk, n_tasks)
         sl = jax.tree_util.tree_map(lambda a: a[start:stop], task_args)
@@ -272,9 +278,13 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None):
             )
         if put is not None:
             sl = put(sl)
-        out = jax.device_get(fn(shared_args, sl))
+        pending.append((fn(shared_args, sl), stop - start, pad))
+
+    outs = []
+    for dev_out, keep, pad in pending:
+        out = jax.device_get(dev_out)
         if pad:
-            out = jax.tree_util.tree_map(lambda a: a[: stop - start], out)
+            out = jax.tree_util.tree_map(lambda a: a[:keep], out)
         outs.append(out)
     if len(outs) == 1:
         return outs[0]

@@ -63,6 +63,30 @@ def test_nb_in_batched_search(clf_data):
         scoring="accuracy",
     ).fit(Xc, y)
     assert len(gs2.cv_results_["params"]) == 3
+    assert np.isfinite(gs2.cv_results_["mean_test_score"]).all()
+    # |gaussian| features aren't real counts; just require above-chance
+    assert gs2.best_score_ > 1.0 / 3.0
+
+
+def test_invalid_input_honors_error_score(clf_data):
+    """Estimator input-validation failures flow through the host path's
+    error_score contract instead of aborting the batched search
+    (regression)."""
+    from skdist_tpu.distribute.search import DistGridSearchCV, FitFailedWarning
+
+    X, y = clf_data  # contains negatives -> invalid for MultinomialNB
+    gs = DistGridSearchCV(
+        MultinomialNB(), {"alpha": [0.1, 1.0]}, cv=2, refit=False,
+        scoring="accuracy", error_score=np.nan,
+    )
+    with pytest.warns(FitFailedWarning):
+        gs.fit(X, y)
+    assert np.isnan(gs.cv_results_["mean_test_score"]).all()
+    with pytest.raises(ValueError):
+        DistGridSearchCV(
+            MultinomialNB(), {"alpha": [1.0]}, cv=2, scoring="accuracy",
+            error_score="raise",
+        ).fit(X, y)
 
 
 def test_nb_in_multimodel(clf_data):
