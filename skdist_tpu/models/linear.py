@@ -585,10 +585,12 @@ class SGDClassifier(_LinearClassifierBase):
                 return g.reshape(-1)
 
             if lr_kind == "optimal":
-                # Bottou's heuristic as in sklearn: t0 from a typical-loss
-                # scale; eta = 1/(alpha*(t0+t))
-                typw = jnp.sqrt(1.0 / jnp.sqrt(alpha))
-                eta0_opt = typw / jnp.maximum(1.0, typw)  # dloss(-typw,1)~1
+                # batch-adapted variant of Bottou's 'optimal' schedule:
+                # sklearn's eta0 = typw suits per-SAMPLE updates; with
+                # batch-MEAN gradients that initial step overshoots, so
+                # the step start is capped at 1 (t0 = 1/alpha). The
+                # 1/(alpha·(t0+t)) decay shape is kept.
+                eta0_opt = 1.0
                 t0 = 1.0 / (eta0_opt * alpha)
 
                 def lr_fn(t):
