@@ -171,6 +171,42 @@ def _is_sequence_of_seqs(y):
     return isinstance(first, (list, tuple, set, frozenset))
 
 
+def _binary_prep(est, X_arr):
+    """(X_dev, meta, aux) for the {0,1} binary sub-problems of any
+    estimator implementing the batched-fit contract: calls the
+    estimator's own _prep_fit_data with a synthetic two-class y so
+    data-dependent context (tree bin edges etc.) is built exactly as a
+    real binary fit would build it; the device-resident X is reused so
+    the matrix transfers once. Returns (None,)*3 if prep fails or the
+    estimator is not a classifier (no 'classes' meta) — those take the
+    generic host path."""
+    try:
+        data, meta = est._prep_fit_data(
+            X_arr, np.arange(len(X_arr), dtype=np.int64) % 2, None
+        )
+    except Exception:
+        return None, None, None
+    if "classes" not in meta:  # regressor base: no binary batched form
+        return None, None, None
+    aux = {k: v for k, v in data.items() if k not in ("X", "y", "sw")}
+    return data["X"], meta, aux
+
+
+def _binary_confidence(est, X):
+    """Signed margin for a fitted binary estimator: 1-D decisions pass
+    through; two-column decisions (e.g. naive Bayes per-class
+    log-likelihoods) become their difference; otherwise proba-0.5."""
+    if hasattr(est, "decision_function"):
+        dec = np.asarray(est.decision_function(X))
+        if dec.ndim == 1:
+            return dec
+        if dec.ndim == 2 and dec.shape[1] == 1:
+            return dec[:, 0]
+        if dec.ndim == 2 and dec.shape[1] == 2:
+            return dec[:, 1] - dec[:, 0]
+    return np.asarray(est.predict_proba(X))[:, 1] - 0.5
+
+
 def _make_fitted_binary(base, params_slice, meta, static_names=None):
     """Materialise a fitted JAX binary estimator from a kernel params
     slice (the batched path's per-class artifact)."""
@@ -232,17 +268,13 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
     # -- batched device path -------------------------------------------
     def _try_batched(self, backend, X, Y):
         est = self.estimator
-        from ..models.linear import _LinearModelBase
-
-        # batched binary fits currently cover the linear-kernel family;
-        # tree/forest bases take the generic per-task path
-        if not isinstance(est, _LinearModelBase):
+        if not hasattr(type(est), "_build_fit_kernel"):
             return None
         # dict class_weight is keyed by original labels, which do not
         # map onto the {0,1} binary sub-problems -> generic path
         if isinstance(getattr(est, "class_weight", None), dict):
             return None
-        from ..models.linear import as_dense_f32, _freeze, get_kernel
+        from ..models.linear import as_dense_f32, _freeze
         import jax
         import jax.numpy as jnp
 
@@ -258,12 +290,9 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         degenerate = (col_sums == 0) | (col_sums == n)
         live = np.where(~degenerate)[0]
 
-        meta = {
-            "n_features": d,
-            "classes": np.array([0, 1]),
-            "n_classes": 2,
-            "cw_arr": None,
-        }
+        X_dev, meta, aux = _binary_prep(est, X_arr)
+        if meta is None:
+            return None
         static = _freeze(est._static_config(meta))
         fit_kernel = type(est)._build_fit_kernel(meta, static)
         hyper = {
@@ -293,13 +322,16 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
                 r = jax.random.uniform(key, w.shape)
                 keep = pos | (r < p_keep)
                 w = w * keep
-            return fit_kernel(shared["X"], y_bin, w, shared["hyper"])
+            return fit_kernel(
+                shared["X"], y_bin, w, shared["hyper"], shared["aux"]
+            )
 
         shared = {
-            "X": jnp.asarray(X_arr),
+            "X": X_dev,
             "Y": jnp.asarray(Y),
             "sw": jnp.ones(n, jnp.float32),
             "hyper": {k: jnp.asarray(v) for k, v in hyper.items()},
+            "aux": aux,
         }
         estimators = [None] * n_classes
         if live.size:
@@ -350,11 +382,8 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         for est in self.estimators_:
             if want_proba:
                 cols.append(np.asarray(est.predict_proba(X))[:, 1])
-            elif hasattr(est, "decision_function"):
-                col = np.asarray(est.decision_function(X))
-                cols.append(col[:, 0] if col.ndim == 2 else col)
             else:
-                cols.append(np.asarray(est.predict_proba(X))[:, 1] - 0.5)
+                cols.append(_binary_confidence(est, X))
         return np.column_stack(cols)
 
     def predict_proba(self, X):
@@ -428,11 +457,7 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
 
     def _try_batched(self, backend, X, y):
         est = self.estimator
-        from ..models.linear import _LinearModelBase
-
-        # batched binary fits currently cover the linear-kernel family;
-        # tree/forest bases take the generic per-task path
-        if not isinstance(est, _LinearModelBase):
+        if not hasattr(type(est), "_build_fit_kernel"):
             return None
         # dict class_weight is keyed by original labels, which do not
         # map onto the {0,1} binary sub-problems -> generic path
@@ -447,12 +472,9 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
         except Exception:
             return None
         y_idx = np.searchsorted(self.classes_, y).astype(np.int32)
-        meta = {
-            "n_features": X_arr.shape[1],
-            "classes": np.array([0, 1]),
-            "n_classes": 2,
-            "cw_arr": None,
-        }
+        X_dev, meta, aux = _binary_prep(est, X_arr)
+        if meta is None:
+            return None
         static = _freeze(est._static_config(meta))
         fit_kernel = type(est)._build_fit_kernel(meta, static)
         hyper = {
@@ -464,12 +486,15 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
             in_pair = (yi == task["i"]) | (yi == task["j"])
             y_bin = (yi == task["j"]).astype(jnp.int32)
             w = in_pair.astype(jnp.float32)
-            return fit_kernel(shared["X"], y_bin, w, shared["hyper"])
+            return fit_kernel(
+                shared["X"], y_bin, w, shared["hyper"], shared["aux"]
+            )
 
         shared = {
-            "X": jnp.asarray(X_arr),
+            "X": X_dev,
             "y": jnp.asarray(y_idx),
             "hyper": {k_: jnp.asarray(v) for k_, v in hyper.items()},
+            "aux": aux,
         }
         task_args = {
             "i": np.asarray([p[0] for p in self.pairs_], dtype=np.int32),
@@ -517,10 +542,7 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
         votes = np.zeros((n, k))
         sum_conf = np.zeros((n, k))
         for (i, j), est in zip(self.pairs_, self.estimators_):
-            if hasattr(est, "decision_function"):
-                conf = np.asarray(est.decision_function(X)).reshape(n)
-            else:
-                conf = np.asarray(est.predict_proba(X))[:, 1] - 0.5
+            conf = _binary_confidence(est, X).reshape(n)
             votes[:, i] += conf < 0
             votes[:, j] += conf >= 0
             sum_conf[:, i] -= conf

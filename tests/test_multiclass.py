@@ -237,6 +237,41 @@ def test_ovr_bad_method_rejected(clf_data):
         ).fit(X, y)
 
 
+def test_ovr_tree_and_nb_batched(clf_data, monkeypatch):
+    """Tree and naive-Bayes bases ride the batched class-axis program
+    too (previously linear-only). The generic path is disabled so a
+    silent fallback fails the test."""
+    from skdist_tpu.models import DecisionTreeClassifier, GaussianNB
+
+    X, y = clf_data
+    monkeypatch.setattr(
+        DistOneVsRestClassifier, "_fit_generic",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("fell back to the generic path")
+        ),
+    )
+    ovr_t = DistOneVsRestClassifier(
+        DecisionTreeClassifier(max_depth=4)
+    ).fit(X, y)
+    assert ovr_t.score(X, y) >= 0.9
+    ovr_nb = DistOneVsRestClassifier(GaussianNB()).fit(X, y)
+    assert ovr_nb.score(X, y) >= 0.9
+    # proba stacking works through the per-class views
+    assert ovr_nb.predict_proba(X).shape == (len(y), 3)
+
+
+def test_ovr_regressor_base_generic_path(clf_data):
+    """Regressor bases (no 'classes' meta) take the generic path and
+    still work (regression: batched path crashed with KeyError)."""
+    from skdist_tpu.models import Ridge
+
+    X, y = clf_data
+    ovr = DistOneVsRestClassifier(Ridge(alpha=1.0)).fit(X, y)
+    preds = ovr.predict(X)
+    assert preds.shape == (len(y),)
+    assert (preds == y).mean() >= 0.8
+
+
 def test_constant_predictor():
     cp = _ConstantPredictor().fit(None, np.array([1, 1]))
     assert (cp.predict(np.zeros((3, 2))) == 1).all()
