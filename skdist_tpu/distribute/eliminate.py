@@ -189,7 +189,7 @@ class DistFeatureEliminator(BaseEstimator):
                 for _, m, *_ in scorer_specs
             ):
                 return None
-        from ..models.linear import as_dense_f32, _freeze
+        from ..models.linear import as_dense_f32, _freeze, extract_aux
         from .search import _cached_cv_kernel
         import jax.numpy as jnp
 
@@ -230,7 +230,7 @@ class DistFeatureEliminator(BaseEstimator):
             "X": data["X"],
             "y": data["y"],
             "sw": data["sw"],
-            "aux": {k: v for k, v in data.items() if k not in ("X", "y", "sw")},
+            "aux": extract_aux(data),
             "hyper": {k: jnp.asarray(v) for k, v in hyper.items()},
             "train_masks": jnp.asarray(train_masks),
             "test_masks": jnp.asarray(test_masks),

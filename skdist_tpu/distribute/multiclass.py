@@ -180,16 +180,25 @@ def _binary_prep(est, X_arr):
     the matrix transfers once. Returns (None,)*3 if prep fails or the
     estimator is not a classifier (no 'classes' meta) — those take the
     generic host path."""
+    if not isinstance(est, ClassifierMixin):
+        # regressor base: no binary batched form — bail before paying
+        # any host->device transfer
+        return None, None, None
     try:
         data, meta = est._prep_fit_data(
             X_arr, np.arange(len(X_arr), dtype=np.int64) % 2, None
         )
-    except Exception:
+    except Exception as exc:
+        warnings.warn(
+            f"batched binary prep failed ({type(exc).__name__}: {exc}); "
+            "falling back to the per-task host path"
+        )
         return None, None, None
-    if "classes" not in meta:  # regressor base: no binary batched form
+    if "classes" not in meta:
         return None, None, None
-    aux = {k: v for k, v in data.items() if k not in ("X", "y", "sw")}
-    return data["X"], meta, aux
+    from ..models.linear import extract_aux
+
+    return data["X"], meta, extract_aux(data)
 
 
 def _binary_confidence(est, X):

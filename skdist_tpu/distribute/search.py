@@ -381,7 +381,7 @@ class DistBaseSearchCV(BaseEstimator):
         if needs_proba and not hasattr(type(estimator), "_build_proba_kernel"):
             return None
 
-        from ..models.linear import as_dense_f32, _freeze
+        from ..models.linear import as_dense_f32, _freeze, extract_aux
         import jax.numpy as jnp
 
         try:
@@ -423,9 +423,7 @@ class DistBaseSearchCV(BaseEstimator):
                 "X": data["X"],
                 "y": data["y"],
                 "sw": data["sw"],
-                "aux": {
-                    k: v for k, v in data.items() if k not in ("X", "y", "sw")
-                },
+                "aux": extract_aux(data),
                 "train_masks": jnp.asarray(train_masks),
                 "test_masks": jnp.asarray(test_masks),
             }

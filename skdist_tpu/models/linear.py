@@ -111,6 +111,15 @@ def _apply_class_weight(sw, y_idx, n_classes, class_weight, cw_arr):
 # shared linear-model machinery
 # --------------------------------------------------------------------------
 
+#: reserved keys of the _prep_fit_data data dict; everything else is
+#: per-estimator fit context forwarded to kernels as ``aux``
+RESERVED_DATA_KEYS = ("X", "y", "sw")
+
+
+def extract_aux(data):
+    return {k: v for k, v in data.items() if k not in RESERVED_DATA_KEYS}
+
+
 _KERNEL_CACHE = {}
 
 

@@ -64,6 +64,28 @@ def test_sklearn_estimator_path():
     assert list(fe.best_features_) == [1, 2, 3, 4]
 
 
+def test_single_tree_batched_elimination(monkeypatch):
+    """A decision-tree base estimator rides the batched column-mask
+    program (zeroed features are constant -> never split); the generic
+    path is disabled so a silent fallback fails the test."""
+    from skdist_tpu.models import DecisionTreeClassifier
+    import skdist_tpu.distribute.eliminate as elim_mod
+
+    X, y = _planted_data()
+    monkeypatch.setattr(
+        elim_mod, "_fit_and_score",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("fell back to the generic path")
+        ),
+    )
+    fe = DistFeatureEliminator(
+        DecisionTreeClassifier(max_depth=4), min_features_to_select=3,
+        cv=2, scoring="accuracy",
+    ).fit(X, y)
+    assert fe.best_score_ > 0.8
+    assert fe.n_features_ >= 3
+
+
 def test_forest_importances_ranking():
     X, y = _planted_data()
     fe = DistFeatureEliminator(
