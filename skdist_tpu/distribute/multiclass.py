@@ -180,9 +180,10 @@ def _binary_prep(est, X_arr):
     the matrix transfers once. Returns (None,)*3 if prep fails or the
     estimator is not a classifier (no 'classes' meta) — those take the
     generic host path."""
-    if not isinstance(est, ClassifierMixin):
-        # regressor base: no binary batched form — bail before paying
-        # any host->device transfer
+    if getattr(est, "_estimator_type", None) != "classifier":
+        # non-classifier base: no binary batched form — bail before
+        # paying any host->device transfer (duck-typed so sklearn's
+        # ClassifierMixin qualifies too)
         return None, None, None
     try:
         data, meta = est._prep_fit_data(

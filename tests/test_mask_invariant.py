@@ -1,0 +1,83 @@
+"""
+The framework's load-bearing invariant: fitting with zero sample
+weights on some rows must equal fitting on the subset — this is what
+makes CV folds, OvO pair restriction, down-sampling, and elimination
+masks valid as weights (docs/DESIGN.md "weights, never slicing").
+
+Exact for the convex/closed-form estimators. Excluded by design:
+SGDClassifier (zero-weight rows still occupy mini-batch slots, so the
+stochastic trajectory differs) and trees (bin edges derive from the
+full X; the split *search* is mask-exact but binning is shared —
+standard histogram-GBM behaviour).
+"""
+
+import numpy as np
+import pytest
+
+from skdist_tpu.models import (
+    GaussianNB,
+    LinearSVC,
+    LogisticRegression,
+    MultinomialNB,
+    Ridge,
+    RidgeClassifier,
+)
+
+
+@pytest.mark.parametrize("est_factory", [
+    lambda: LogisticRegression(max_iter=300, tol=1e-6),
+    lambda: LinearSVC(max_iter=300, tol=1e-6),
+    lambda: RidgeClassifier(alpha=1.0),
+    lambda: GaussianNB(),
+])
+def test_mask_equals_subset_classifier(clf_data, est_factory):
+    X, y = clf_data
+    rng = np.random.RandomState(7)
+    keep = rng.rand(len(y)) > 0.35
+    w = keep.astype(np.float32)
+
+    masked = est_factory().fit(X, y, sample_weight=w)
+    subset = est_factory().fit(X[keep], y[keep])
+    np.testing.assert_allclose(
+        masked.decision_function(X),
+        subset.decision_function(X),
+        atol=2e-2, rtol=1e-2,
+    )
+    assert (masked.predict(X) == subset.predict(X)).mean() >= 0.99
+
+
+def test_mask_equals_subset_regressor(reg_data):
+    X, y = reg_data
+    rng = np.random.RandomState(7)
+    keep = rng.rand(len(y)) > 0.35
+    w = keep.astype(np.float32)
+    masked = Ridge(alpha=1.0).fit(X, y, sample_weight=w)
+    subset = Ridge(alpha=1.0).fit(X[keep], y[keep])
+    np.testing.assert_allclose(
+        masked.predict(X), subset.predict(X), atol=1e-3
+    )
+
+
+def test_mask_equals_subset_multinomial():
+    rng = np.random.RandomState(0)
+    X = rng.poisson(2.0, size=(300, 30)).astype(np.float32)
+    y = (X[:, :5].sum(1) > X[:, 5:10].sum(1)).astype(int)
+    keep = rng.rand(len(y)) > 0.35
+    w = keep.astype(np.float32)
+    masked = MultinomialNB().fit(X, y, sample_weight=w)
+    subset = MultinomialNB().fit(X[keep], y[keep])
+    np.testing.assert_allclose(
+        masked.predict_proba(X), subset.predict_proba(X), atol=1e-5
+    )
+
+
+def test_fractional_weights_scale_invariance(clf_data):
+    """Scaling all weights by a constant must not change the fit for
+    weight-normalised objectives (NB family; closed forms)."""
+    X, y = clf_data
+    w = np.random.RandomState(1).rand(len(y)).astype(np.float32)
+    a = GaussianNB().fit(X, y, sample_weight=w)
+    b = GaussianNB().fit(X, y, sample_weight=w * 7.0)
+    np.testing.assert_allclose(
+        a.predict_proba(X), b.predict_proba(X), atol=1e-5
+    )
