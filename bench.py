@@ -46,6 +46,10 @@ def make_20news_shaped(seed=0, n=11314, d=4096, k=20):
 
 
 def main(quick=False):
+    from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
+
+    platform = probe_platform_or_cpu()
+
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression
     from skdist_tpu.parallel import TPUBackend
@@ -110,6 +114,7 @@ def main(quick=False):
         "unit": "fits/sec",
         "vs_baseline": round(fits_per_sec / sk_fits_per_sec, 2),
         "aux": {
+            "platform": platform,
             "warm_wall_s": round(warm_s, 2),
             "cold_wall_s": round(cold_s, 2),
             "n_fits": n_fits,

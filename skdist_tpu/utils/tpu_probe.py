@@ -1,0 +1,73 @@
+"""
+Wedged-accelerator guard shared by the repo-root entry points
+(bench.py, __graft_entry__.py).
+
+The axon TPU tunnel can wedge such that device init blocks forever
+*in-process* (uninterruptible). The probe therefore runs in a child
+process with a hard timeout AND a bounded post-kill wait — if the child
+lands in an unkillable state, the parent still returns instead of
+inheriting the hang. Output is not captured (no pipes to drain).
+"""
+
+import os
+import subprocess
+import sys
+
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "(jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready(); "
+    "import pathlib, sys; pathlib.Path(sys.argv[1]).write_text("
+    "jax.default_backend())"
+)
+
+
+def probe_platform_or_cpu(timeout=90, post_kill_wait=10):
+    """Return the live default JAX platform name, or pin CPU in-process
+    and return 'cpu-fallback' when the device never answers.
+
+    Probes even when JAX_PLATFORMS is unset (jax auto-selects an
+    accelerator there too); short-circuits only an explicit cpu pin.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        return "cpu"
+    import tempfile
+
+    fd, out_path = tempfile.mkstemp(suffix=".probe")
+    os.close(fd)
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CODE, out_path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        proc.wait(timeout=timeout)
+        if proc.returncode == 0:
+            with open(out_path) as f:
+                name = f.read().strip()
+            if name:
+                return name
+    except subprocess.TimeoutExpired:
+        pass
+    except Exception:
+        pass
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=post_kill_wait)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable child; abandon it rather than hang
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+    print(
+        "[skdist_tpu] accelerator device init did not answer within "
+        f"{timeout}s; falling back to CPU for this process",
+        file=sys.stderr,
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu-fallback"
