@@ -20,6 +20,11 @@ _PROBE_CODE = (
     "jax.default_backend())"
 )
 
+# process-wide memo: the probe must run BEFORE the parent initialises
+# any backend (a parent holding the device would starve the child), and
+# a wedged device should cost its timeout once, not per entry point
+_RESULT = None
+
 
 def probe_platform_or_cpu(timeout=90, post_kill_wait=10):
     """Return the live default JAX platform name, or pin CPU in-process
@@ -27,14 +32,20 @@ def probe_platform_or_cpu(timeout=90, post_kill_wait=10):
 
     Probes even when JAX_PLATFORMS is unset (jax auto-selects an
     accelerator there too); short-circuits only an explicit cpu pin.
+    The first call's verdict is memoised for the process.
     """
+    global _RESULT
+    if _RESULT is not None:
+        return _RESULT
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        return "cpu"
+        _RESULT = "cpu"
+        return _RESULT
     import tempfile
 
     fd, out_path = tempfile.mkstemp(suffix=".probe")
     os.close(fd)
     proc = None
+    reason = "probe could not be launched"
     try:
         proc = subprocess.Popen(
             [sys.executable, "-c", _PROBE_CODE, out_path],
@@ -45,11 +56,15 @@ def probe_platform_or_cpu(timeout=90, post_kill_wait=10):
             with open(out_path) as f:
                 name = f.read().strip()
             if name:
-                return name
+                _RESULT = name
+                return _RESULT
+            reason = "probe produced no platform name"
+        else:
+            reason = f"device init failed (probe exit {proc.returncode})"
     except subprocess.TimeoutExpired:
-        pass
-    except Exception:
-        pass
+        reason = f"device init did not answer within {timeout}s"
+    except Exception as exc:
+        reason = f"probe error ({type(exc).__name__})"
     finally:
         if proc is not None and proc.poll() is None:
             proc.kill()
@@ -63,11 +78,11 @@ def probe_platform_or_cpu(timeout=90, post_kill_wait=10):
             pass
 
     print(
-        "[skdist_tpu] accelerator device init did not answer within "
-        f"{timeout}s; falling back to CPU for this process",
+        f"[skdist_tpu] {reason}; falling back to CPU for this process",
         file=sys.stderr,
     )
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    return "cpu-fallback"
+    _RESULT = "cpu-fallback"
+    return _RESULT
