@@ -13,6 +13,8 @@ Post-fit, the backend handle is stripped so the artifact pickles clean
 (the reference's ``del self.sc``, ensemble.py:335).
 """
 
+import numpy as np
+
 from ..base import strip_runtime
 from ..models.forest import (
     ExtraTreesClassifier,
@@ -22,7 +24,7 @@ from ..models.forest import (
     RandomTreesEmbedding,
 )
 from ..parallel import parse_partitions, resolve_backend
-from ..utils.validation import check_estimator_backend
+from ..utils.validation import check_estimator_backend, safe_indexing
 
 __all__ = [
     "DistRandomForestClassifier",
@@ -30,7 +32,36 @@ __all__ = [
     "DistExtraTreesClassifier",
     "DistExtraTreesRegressor",
     "DistRandomTreesEmbedding",
+    "get_oof",
+    "get_single_oof",
 ]
+
+
+def get_single_oof(clf, X, y, train_index, test_index):
+    """Fit on the train index, predict_proba on the test index
+    (reference ensemble.py:112-127)."""
+    X_train = safe_indexing(X, train_index)
+    X_test = safe_indexing(X, test_index)
+    y = np.asarray(y)
+    clf.fit(X_train, y[train_index])
+    return test_index, clf.predict_proba(X_test)
+
+
+def get_oof(clf, X, y, n_splits=5):
+    """Out-of-fold probabilities + final full fit (reference
+    ensemble.py:130-151)."""
+    from sklearn.model_selection import KFold
+
+    y = np.asarray(y)
+    oof_train = np.zeros((y.shape[0], len(np.unique(y))))
+    # KFold.split only needs len(X); pass X as-is so ragged lists work
+    for train_index, test_index in KFold(n_splits=n_splits).split(X):
+        test_index, proba = get_single_oof(
+            clf, X, y, train_index, test_index
+        )
+        oof_train[test_index] = proba
+    clf.fit(X, y)
+    return clf, oof_train
 
 
 class _DistForestMixin:

@@ -270,6 +270,27 @@ def test_forest_apply_and_importances(clf_data):
     assert abs(imp.sum() - 1.0) < 1e-6
 
 
+def test_get_oof_helpers(clf_data):
+    """Module-level OOF helpers (reference ensemble.py:112-151)."""
+    from skdist_tpu.distribute.ensemble import get_oof, get_single_oof
+
+    X, y = clf_data
+    clf = DistRandomForestClassifier(
+        n_estimators=8, max_depth=4, random_state=0
+    )
+    fitted, oof = get_oof(clf, X, y, n_splits=3)
+    assert oof.shape == (len(y), 3)
+    assert np.allclose(oof.sum(axis=1), 1.0, atol=1e-5)
+    # the helper's final fit is on the full data
+    assert fitted.score(X, y) >= 0.9
+    idx_test, proba = get_single_oof(
+        DistRandomForestClassifier(n_estimators=6, max_depth=4,
+                                   random_state=0),
+        X, y, np.arange(0, 120), np.arange(120, 180),
+    )
+    assert proba.shape == (60, 3)
+
+
 def test_forest_in_grid_search(clf_data):
     """Forests as search base estimators take the generic path."""
     from skdist_tpu.distribute.search import DistGridSearchCV
