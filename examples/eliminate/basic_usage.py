@@ -5,6 +5,11 @@ examples/eliminate/basic_usage.py: synthetic data with junk features,
 (feature_set x fold) fits run as one vmapped program with column
 masks riding the task axis).
 
+Sample output (CPU backend):
+    -- 9 feature sets x 5 folds in 8.45s
+    -- best score 0.9954 with 20 features
+    -- informative kept: 12/12, junk kept: 8/28
+
 Run: python examples/eliminate/basic_usage.py
 """
 

@@ -3,6 +3,12 @@ Encoderizer on mixed-type data (counterpart of the reference's
 examples/encoder/basic_usage.py: small/medium/large encoders on
 20newsgroups; zero-egress here, so a synthetic mixed frame).
 
+Sample output:
+    -- size=small: 80 features from 4 steps, best CV f1 1.0000
+    -- size=medium: 499 features from 5 steps, best CV f1 1.0000
+    -- size=large: 600 features from 5 steps, best CV f1 1.0000
+    -- feature 0 comes from step: 'text_word_vec'
+
 Run: python examples/encoder/basic_usage.py
 """
 

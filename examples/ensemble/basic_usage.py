@@ -2,6 +2,12 @@
 Distributed forests on digits (counterpart of the reference's
 examples/ensemble/basic_usage.py).
 
+Sample output (CPU backend):
+    -- RandomForest: 64 trees in 34.52s, holdout f1 0.9583
+    -- ExtraTrees: 64 trees in 54.50s, holdout f1 0.9751
+    -- RandomTreesEmbedding: (1437, 64) -> (1437, 1008)
+    -- pickle round-trip OK
+
 Run: python examples/ensemble/basic_usage.py
 """
 

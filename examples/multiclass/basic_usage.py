@@ -3,6 +3,10 @@ One-vs-rest vs one-vs-one on digits (counterpart of the reference's
 examples/multiclass/basic_usage.py, which reported OvR 0.9589 vs OvO
 0.9805 weighted F1).
 
+Sample output (CPU backend):
+    -- OvR (10 binary fits, one program): f1_weighted 0.9610
+    -- OvO (45 pair fits, one program):   f1_weighted 0.9778
+
 Run: python examples/multiclass/basic_usage.py
 """
 

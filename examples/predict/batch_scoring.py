@@ -4,6 +4,10 @@ examples/predict: building pandas UDFs for Spark DataFrame scoring —
 here row blocks ride the device mesh via batch_predict, and
 get_prediction_udf gives the same columnar interface).
 
+Sample output (CPU backend):
+    -- scored 107,820 rows in 0.28s (389,933 rows/sec), proba (107820, 10)
+    -- UDF interface: 107,820 predictions
+
 Run: python examples/predict/batch_scoring.py
 """
 

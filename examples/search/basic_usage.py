@@ -4,6 +4,13 @@ Distributed grid search on the hand-written digits dataset
 hand_written_digits.py, which ran 750 SVC fits on a 640-core Spark
 cluster — here the whole grid batches into vmapped XLA programs).
 
+Sample output (CPU backend, this repo's test rig):
+    -- 200 fits in 25.54s (7.8 fits/sec)
+    -- best params: {'C': 29.76, 'tol': 0.0001}
+    -- best CV f1_weighted: 0.9730
+    -- holdout f1_weighted: 0.9638
+    -- pickle round-trip OK (10151 bytes)
+
 Run: python examples/search/basic_usage.py
 """
 

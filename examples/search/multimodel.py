@@ -3,6 +3,11 @@ Multi-model search (counterpart of the reference's
 examples/search/multimodel.py): heterogeneous model families, n
 sampled param sets each, winner refit.
 
+Sample output (CPU backend):
+    -- winner: lr {'C': 100.0}
+    -- best CV accuracy 0.9715 (worst candidate 0.9241)
+    -- holdout accuracy 0.9611
+
 Run: python examples/search/multimodel.py
 """
 

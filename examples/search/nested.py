@@ -5,6 +5,9 @@ estimator is itself a distributed grid search — each binary
 sub-problem gets its own hyperparameter tuning, and the nested
 search unwraps to its best estimator post-fit.
 
+Sample output (CPU backend):
+    -- OvR over nested grid search: holdout f1_weighted 0.9582
+
 Run: python examples/search/nested.py
 """
 
