@@ -26,13 +26,15 @@ _PROBE_CODE = (
 _RESULT = None
 
 
-def probe_platform_or_cpu(timeout=90, post_kill_wait=10):
+def probe_platform_or_cpu(timeout=30, post_kill_wait=10):
     """Return the live default JAX platform name, or pin CPU in-process
     and return 'cpu-fallback' when the device never answers.
 
     Probes even when JAX_PLATFORMS is unset (jax auto-selects an
-    accelerator there too); short-circuits only an explicit cpu pin.
-    The first call's verdict is memoised for the process.
+    accelerator there too); short-circuits an explicit cpu pin — both
+    the env-var form and an in-process ``jax.config`` pin (the latter is
+    what conftest.py does, and paying the subprocess timeout there would
+    be pure waste). The first call's verdict is memoised for the process.
     """
     global _RESULT
     if _RESULT is not None:
@@ -40,6 +42,14 @@ def probe_platform_or_cpu(timeout=90, post_kill_wait=10):
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         _RESULT = "cpu"
         return _RESULT
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            if (jax_mod.config.jax_platforms or "").strip() == "cpu":
+                _RESULT = "cpu"
+                return _RESULT
+        except AttributeError:
+            pass
     import tempfile
 
     fd, out_path = tempfile.mkstemp(suffix=".probe")
