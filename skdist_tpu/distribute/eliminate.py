@@ -124,9 +124,19 @@ class DistFeatureEliminator(BaseEstimator):
             backend, X_arr, y, splits, features_to_remove, fit_params
         )
         self.scores_ = scores
+        # NaN (failed folds under error_score=np.nan) must never win:
+        # np.argmax treats NaN as the maximum. Rank NaN sets as -inf;
+        # refuse to pick when every set failed.
+        sel = np.asarray(scores, dtype=np.float64)
+        if np.all(np.isnan(sel)):
+            raise RuntimeError(
+                "All feature-set fits failed (every CV score is NaN); "
+                "cannot select best_features_."
+            )
+        sel = np.where(np.isnan(sel), -np.inf, sel)
         # ties break toward the smaller feature set (sets are ordered by
         # increasing removal, so take the LAST argmax)
-        best = int(len(scores) - 1 - np.argmax(scores[::-1]))
+        best = int(len(sel) - 1 - np.argmax(sel[::-1]))
         self.best_score_ = float(scores[best])
         self.best_features_ = np.setdiff1d(
             np.arange(n_features), features_to_remove[best]
@@ -267,6 +277,14 @@ class DistFeatureEliminator(BaseEstimator):
         if hasattr(X, "tocsc"):
             return X.tocsc()[:, self.best_features_].tocsr()
         return np.asarray(X)[:, self.best_features_]
+
+    @property
+    def best_estimator_(self):
+        """Alias for the refit model — the reference exposes the refit
+        result as ``best_estimator_`` (eliminate.py:236), and ported
+        user code reads that name."""
+        check_is_fitted(self, "estimator_")
+        return self.estimator_
 
     def predict(self, X):
         check_is_fitted(self, "estimator_")

@@ -68,8 +68,14 @@ class _ConstantPredictor(BaseEstimator):
 
 def _use_best_estimator(est):
     """Unwrap a fitted nested SearchCV to its best_estimator_, carrying
-    cv_results_ along as strings (reference multiclass.py:65-73)."""
-    if not hasattr(est, "best_estimator_"):
+    cv_results_ along as strings (reference multiclass.py:65-73).
+
+    Only search-style wrappers are unwrapped. A fitted
+    DistFeatureEliminator also exposes ``best_estimator_``, but its
+    inner model was refit on the masked feature subset — unwrapping it
+    would feed full-width X to a reduced-width model at predict time,
+    so eliminators (marked by ``best_features_``) stay wrapped."""
+    if not hasattr(est, "best_estimator_") or hasattr(est, "best_features_"):
         return est
     inner = est.best_estimator_
     if hasattr(est, "cv_results_"):
@@ -264,6 +270,14 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         Y, classes, multilabel = _label_matrix(y)
         self.classes_ = classes
         self.multilabel_ = multilabel
+        # 2-class non-multilabel: ONE binary estimator on the positive
+        # column, like the reference's LabelBinarizer (which emits a
+        # single column for binary y); the negative class is derived on
+        # the predict side as the complement. Fitting both complementary
+        # columns would double the work and break [1-p, p] semantics.
+        self.binary_ = (not multilabel) and Y.shape[1] == 2
+        if self.binary_:
+            Y = Y[:, 1:]
         n_classes = Y.shape[1]
 
         done = None
@@ -360,7 +374,7 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
                 estimators[cls_idx] = _make_fitted_binary(est, params, meta)
         for cls_idx in np.where(degenerate)[0]:
             warnings.warn(
-                f"Label {self.classes_[cls_idx]} is present in "
+                f"Label {self._col_label(cls_idx)} is present in "
                 f"{'all' if col_sums[cls_idx] == n else 'no'} training examples."
             )
             cp = _ConstantPredictor()
@@ -369,14 +383,22 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         self.estimators_ = estimators
         return True
 
+    def _col_label(self, col_idx):
+        """Original class label for column ``col_idx`` of the (possibly
+        binary-reduced) label matrix."""
+        if getattr(self, "binary_", False):
+            return self.classes_[col_idx + 1]
+        return self.classes_[col_idx]
+
     # -- generic host path ---------------------------------------------
     def _fit_generic(self, backend, X, Y, fit_params):
         est = self.estimator
 
         def run_one(cls_idx):
+            label = self._col_label(cls_idx)
             return _fit_binary(
                 est, X, Y[:, cls_idx], fit_params,
-                classes=[f"not-{self.classes_[cls_idx]}", self.classes_[cls_idx]],
+                classes=[f"not-{label}", label],
                 max_negatives=self.max_negatives,
                 random_state=self.random_state, method=self.method,
             )
@@ -396,10 +418,23 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
                 cols.append(_binary_confidence(est, X))
         return np.column_stack(cols)
 
+    def _expanded_scores(self, X, want_proba):
+        """Per-class score matrix over ``classes_`` — for the binary
+        single-estimator case the negative column is the derived
+        complement ([1-p, p] / [-s, s])."""
+        scores = self._per_class_scores(X, want_proba)
+        if getattr(self, "binary_", False):
+            col = scores[:, 0]
+            scores = (
+                np.column_stack([1.0 - col, col]) if want_proba
+                else np.column_stack([-col, col])
+            )
+        return scores
+
     def predict_proba(self, X):
         """Stacked per-class positive probabilities; optionally
         normalised (reference multiclass.py:337-362)."""
-        scores = self._per_class_scores(X, want_proba=True)
+        scores = self._expanded_scores(X, want_proba=True)
         if self.norm:
             from sklearn.preprocessing import normalize
 
@@ -407,7 +442,12 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         return scores
 
     def decision_function(self, X):
-        return self._per_class_scores(X, want_proba=False)
+        scores = self._per_class_scores(X, want_proba=False)
+        if getattr(self, "binary_", False):
+            # sklearn's binary OvR contract: 1-D confidences for the
+            # positive class
+            return scores[:, 0]
+        return scores
 
     def predict(self, X):
         if self.multilabel_:
@@ -416,7 +456,7 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
             )
             thresh = 0.5 if self._has_proba() else 0.0
             return (proba_like > thresh).astype(np.int32)
-        scores = self._per_class_scores(X, want_proba=self._has_proba())
+        scores = self._expanded_scores(X, want_proba=self._has_proba())
         return self.classes_[np.argmax(scores, axis=1)]
 
     def _has_proba(self):

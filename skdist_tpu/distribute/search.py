@@ -50,6 +50,7 @@ from ..utils.validation import (
     check_estimator_backend,
     check_is_fitted,
     check_n_iter,
+    index_fit_params,
     safe_split,
 )
 
@@ -59,6 +60,23 @@ __all__ = [
     "DistRandomizedSearchCV",
     "DistMultiModelSearch",
 ]
+
+
+def _nan_as_worst(scores):
+    """Replace NaN scores (failed fits under error_score=np.nan) with a
+    value strictly below the finite minimum before ranking.
+
+    scipy>=1.10 rankdata propagates NaN, so a single failed fit would
+    make EVERY rank NaN; the int32 cast then turns them into garbage and
+    best_index_ silently selects the wrong candidate. Modern sklearn
+    ranks failed candidates last; so do we.
+    """
+    scores = np.asarray(scores, dtype=np.float64)
+    nan_mask = np.isnan(scores)
+    if not nan_mask.any():
+        return scores
+    worst = np.nanmin(scores) - 1.0 if not nan_mask.all() else 0.0
+    return np.where(nan_mask, worst, scores)
 
 
 # ---------------------------------------------------------------------------
@@ -74,7 +92,9 @@ def _fit_and_score(estimator, X, y, scorers, train, test, parameters,
         est.set_params(**parameters)
     X_train, y_train = safe_split(est, X, y, train)
     X_test, y_test = safe_split(est, X, y, test, train)
-    fit_params = fit_params or {}
+    # array-valued fit params (full-length sample_weight etc.) are
+    # sliced to the train fold (reference search.py:208-210)
+    fit_params = index_fit_params(X, fit_params or {}, train)
     start = time.perf_counter()
     result = {}
     try:
@@ -292,6 +312,13 @@ class DistBaseSearchCV(BaseEstimator):
         # best_* are exposed for refit=True or any single-metric run
         # (sklearn semantics; reference search.py:538-541)
         if self.refit or not multimetric:
+            if np.all(np.isnan(results[f"mean_test_{refit_metric}"])):
+                # mirror the eliminate / multi-model contract: never
+                # silently return candidate 0 with best_score_=NaN
+                raise RuntimeError(
+                    "All candidate fits failed (every "
+                    f"mean_test_{refit_metric} is NaN)."
+                )
             self.best_index_ = int(results[f"rank_test_{refit_metric}"].argmin())
             self.best_params_ = candidate_params[self.best_index_]
             self.best_score_ = results[f"mean_test_{refit_metric}"][self.best_index_]
@@ -403,7 +430,6 @@ class DistBaseSearchCV(BaseEstimator):
         est_cls = type(estimator)
         hyper_names = list(getattr(est_cls, "_hyper_names", ()))
 
-        wall_start = time.perf_counter()
         for static_overrides, cand_indices in buckets.values():
             bucket_est = clone(estimator)
             if static_overrides:
@@ -446,12 +472,22 @@ class DistBaseSearchCV(BaseEstimator):
                 "split": np.asarray(split_ids, dtype=np.int32),
             }
             round_size = parse_partitions(self.partitions, len(split_ids))
-            scores = backend.batched_map(
+            scores, round_timings = backend.batched_map(
                 kernel, task_args, shared, round_size=round_size,
                 shared_specs=row_sharded_specs(
                     backend, shared, _CV_SAMPLE_AXES
                 ),
+                return_timings=True,
             )
+            # per-task fit_time = its round's measured wall / tasks in
+            # that round (fit+score run fused in one kernel, so the
+            # whole round wall is recorded as fit_time; score_time is
+            # structurally 0 on the batched path). Honest per-round
+            # measurement, not a uniform smear over the whole search.
+            per_task_time = np.concatenate([
+                np.full(keep, wall / max(keep, 1))
+                for wall, keep in round_timings
+            ]) if round_timings else np.zeros(len(split_ids))
             # unpack into global task order
             t = 0
             for cand_idx in cand_indices:
@@ -459,12 +495,11 @@ class DistBaseSearchCV(BaseEstimator):
                     out[cand_idx * n_splits + s] = {
                         k: float(v[t]) for k, v in scores.items()
                     }
+                    out[cand_idx * n_splits + s]["fit_time"] = float(
+                        per_task_time[t]
+                    )
+                    out[cand_idx * n_splits + s]["score_time"] = 0.0
                     t += 1
-        wall = time.perf_counter() - wall_start
-        per_task = wall / max(n_tasks_total, 1)
-        for d in out:
-            d["fit_time"] = per_task
-            d["score_time"] = 0.0
         return out
 
     # ------------------------------------------------------------------
@@ -489,7 +524,8 @@ class DistBaseSearchCV(BaseEstimator):
             results[f"std_{key_name}"] = stds
             if rank:
                 results[f"rank_{key_name}"] = np.asarray(
-                    rankdata(-means, method="min"), dtype=np.int32
+                    rankdata(-_nan_as_worst(means), method="min"),
+                    dtype=np.int32,
                 )
 
         _store("fit_time", agg["fit_time"])
@@ -517,15 +553,23 @@ class DistBaseSearchCV(BaseEstimator):
         return results
 
     def _out_of_fold_preds(self, estimator, X, y, splits, fit_params):
-        """Out-of-fold predict_proba at the best params (reference
-        search.py:551-560)."""
+        """Out-of-fold predict_proba at the best params, falling back to
+        predict for estimators without probabilities (reference
+        search.py:551-560 wraps predict_proba in try/except predict)."""
         preds = []
         for train, test in splits:
             est = clone(estimator).set_params(**self.best_params_)
             X_train, y_train = safe_split(est, X, y, train)
             X_test, _ = safe_split(est, X, y, test, train)
-            est.fit(X_train, y_train, **fit_params)
-            preds.append(est.predict_proba(X_test))
+            est.fit(X_train, y_train, **index_fit_params(X, fit_params, train))
+            try:
+                preds.append(est.predict_proba(X_test))
+            except (AttributeError, NotImplementedError):
+                preds.append(est.predict(X_test))
+        if preds and np.ndim(preds[0]) == 1:
+            # predict fallback yields 1D fold slices; vstack would fail
+            # on unequal fold sizes (latent reference bug — not kept)
+            return np.concatenate(preds)
         return np.vstack(preds)
 
     # ------------------------------------------------------------------
@@ -791,8 +835,14 @@ class DistMultiModelSearch(BaseEstimator):
         self.worst_score_ = float(np.nanmin(score_vals))
 
         results = results.copy()
+        # method="min" for sklearn-style integer ranks on ties (the base
+        # search already did this; reference search.py:481-484)
         results["rank_test_score"] = np.asarray(
-            rankdata(-results["score"].values), dtype=np.int32
+            rankdata(
+                -_nan_as_worst(results["score"].values.astype(float)),
+                method="min",
+            ),
+            dtype=np.int32,
         )
         results["mean_test_score"] = results["score"]
         results["params"] = results["param_set"]

@@ -25,6 +25,7 @@ unit tests need no accelerator.
 
 import math
 import os
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -81,7 +82,7 @@ class TaskBackend:
         raise NotImplementedError
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None, shared_specs=None):
+                    round_size=None, shared_specs=None, return_timings=False):
         raise NotImplementedError
 
     # fitted estimators must never hold a live backend; give pickle a
@@ -122,7 +123,7 @@ class LocalBackend(TaskBackend):
             return list(pool.map(fn, tasks))
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None, shared_specs=None):
+                    round_size=None, shared_specs=None, return_timings=False):
         """Run the stacked kernel on the host's default JAX device.
 
         Same compiled program as the TPU path minus the mesh sharding, so
@@ -133,7 +134,11 @@ class LocalBackend(TaskBackend):
         fn = _jit_vmapped(kernel, static_args)
         n_tasks = _leading_dim(task_args)
         chunk = min(n_tasks, round_size or n_tasks)
-        return _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk)
+        timings = [] if return_timings else None
+        out = _run_in_rounds(
+            fn, task_args, shared_args, n_tasks, chunk, timings=timings
+        )
+        return (out, timings) if return_timings else out
 
 
 class TPUBackend(TaskBackend):
@@ -210,7 +215,7 @@ class TPUBackend(TaskBackend):
         return _BroadcastHandle(value)
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None, shared_specs=None):
+                    round_size=None, shared_specs=None, return_timings=False):
         """Stack → shard → compile once → run in rounds → gather.
 
         ``task_args``: pytree whose leaves have a leading axis of length
@@ -248,13 +253,17 @@ class TPUBackend(TaskBackend):
         fn = _jit_vmapped(
             kernel, static_args, task_sharding, shared_shardings
         )
-        return _run_in_rounds(
+        timings = [] if return_timings else None
+        out = _run_in_rounds(
             fn, task_args, shared_args, n_tasks, chunk,
             put=lambda t: jax.device_put(t, task_sharding),
+            timings=timings,
         )
+        return (out, timings) if return_timings else out
 
 
-def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None):
+def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
+                   timings=None):
     """Shared round loop: slice task axis, pad the tail round to the
     fixed chunk shape (padding duplicates the last task; its outputs are
     sliced off), run, gather to host numpy, concatenate.
@@ -263,9 +272,14 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None):
     asynchronous, so round i+1's host-side slicing and transfer overlap
     round i's device compute (round outputs are small score/param
     stacks, so holding them on device is cheap).
+
+    ``timings``: optional list; appends ``(round_wall_s, n_tasks_kept)``
+    per round — measured gather-to-gather so the walls are
+    non-overlapping and sum to the call's total despite pipelining.
     """
     import jax
 
+    t_prev = time.perf_counter() if timings is not None else None
     pending = []
     for start in range(0, n_tasks, chunk):
         stop = min(start + chunk, n_tasks)
@@ -283,6 +297,10 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None):
     outs = []
     for dev_out, keep, pad in pending:
         out = jax.device_get(dev_out)
+        if timings is not None:
+            now = time.perf_counter()
+            timings.append((now - t_prev, keep))
+            t_prev = now
         if pad:
             out = jax.tree_util.tree_map(lambda a: a[:keep], out)
         outs.append(out)

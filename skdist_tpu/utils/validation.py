@@ -84,6 +84,31 @@ def safe_split(estimator, X, y, indices, train_indices=None):
     return X_subset, y_subset
 
 
+def index_fit_params(X, fit_params, indices):
+    """Slice array-valued fit params down to a fold's rows (reference
+    ``_index_param_value``, search.py:208-210): a value that is
+    array-like with one entry per sample of X (e.g. a full-length
+    ``sample_weight``) is indexed by ``indices``; everything else
+    passes through untouched."""
+    if not fit_params:
+        return {}
+    n = num_samples(X)
+    out = {}
+    for key, value in fit_params.items():
+        is_arraylike = (
+            hasattr(value, "__len__") or hasattr(value, "shape")
+        ) and not isinstance(value, (str, bytes, dict))
+        if is_arraylike:
+            try:
+                matches = num_samples(value) == n
+            except TypeError:
+                matches = False
+            if matches:
+                value = safe_indexing(value, indices)
+        out[key] = value
+    return out
+
+
 def num_samples(x):
     """Number of samples in array-like x (reference utils.py:146-168)."""
     if hasattr(x, "shape") and x.shape is not None:

@@ -54,6 +54,75 @@ def test_generic_path_matches_batched():
     assert list(batched.best_features_) == list(generic.best_features_)
 
 
+def test_best_estimator_alias():
+    X, y = _planted_data()
+    fe = DistFeatureEliminator(
+        LogisticRegression(max_iter=100), min_features_to_select=4, cv=3,
+        scoring="accuracy",
+    ).fit(X, y)
+    assert fe.best_estimator_ is fe.estimator_
+
+
+def test_nan_scores_never_win():
+    """A feature set whose folds all fail (error_score=np.nan) must not
+    be selected via np.argmax's NaN-is-max behaviour (round-1 advisor
+    finding); all-NaN must raise instead of returning garbage."""
+    from sklearn.metrics import accuracy_score, make_scorer
+
+    X, y = _planted_data()
+
+    class ExplodingOnNarrow(LogisticRegression):
+        """Fails whenever the feature set drops below 5 columns, so
+        every reduced set scores NaN and only the full set works."""
+        def fit(self, X, y=None, sample_weight=None):
+            if X.shape[1] < 5:
+                raise RuntimeError("boom")
+            return super().fit(X, y, sample_weight=sample_weight)
+
+    with pytest.warns(Warning):
+        fe = DistFeatureEliminator(
+            ExplodingOnNarrow(max_iter=100), min_features_to_select=2,
+            cv=3, scoring=make_scorer(accuracy_score),
+        ).fit(X, y)
+    assert len(fe.best_features_) == 5  # the only non-NaN set
+    assert not np.isnan(fe.best_score_)
+
+    class ExplodingOnFolds(LogisticRegression):
+        """Succeeds on the initial full-data fit (needed for coef_
+        ranking) but fails on every CV fold's subsample."""
+        def fit(self, X, y=None, sample_weight=None):
+            if X.shape[0] < 300:
+                raise RuntimeError("boom")
+            return super().fit(X, y, sample_weight=sample_weight)
+
+    with pytest.warns(Warning):
+        with pytest.raises(RuntimeError, match="feature-set fits failed"):
+            DistFeatureEliminator(
+                ExplodingOnFolds(max_iter=100), min_features_to_select=2,
+                cv=3, scoring=make_scorer(accuracy_score),
+            ).fit(X, y)
+
+
+def test_nested_in_ovr_stays_wrapped():
+    """A fitted eliminator nested inside OvR must NOT be unwrapped to
+    its mask-trained inner model (review finding: the inner model was
+    refit on the reduced feature set, so it needs the eliminator's
+    column mask at predict time)."""
+    from skdist_tpu.distribute.multiclass import DistOneVsRestClassifier
+
+    X, y = _planted_data()
+    ovr = DistOneVsRestClassifier(
+        DistFeatureEliminator(
+            LogisticRegression(max_iter=100), min_features_to_select=4,
+            cv=3, scoring="accuracy",
+        )
+    ).fit(X, y)
+    assert all(
+        isinstance(e, DistFeatureEliminator) for e in ovr.estimators_
+    )
+    assert ovr.score(X, y) > 0.9  # full-width X works at predict time
+
+
 def test_sklearn_estimator_path():
     from sklearn.linear_model import LogisticRegression as SkLR
 
