@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Suite on a 4-virtual-device CPU mesh — one cell of the device-count
+# matrix (the analogue of the reference's spark_2_4.sh env cell: same
+# tests, different cluster runtime).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+SKDIST_TEST_DEVICES=4 bash build_tools/test_script.sh

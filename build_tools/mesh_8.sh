@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Suite on an 8-virtual-device CPU mesh (default; the analogue of the
+# reference's spark_3_0.sh env cell).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+SKDIST_TEST_DEVICES=8 bash build_tools/test_script.sh

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# CI entry point (analogue of the reference's build_tools/test_script.sh,
+# which ran `pip check; pytest`). Run from the repo root.
+set -euo pipefail
+python -m pip check
+python -m pytest tests/ -q

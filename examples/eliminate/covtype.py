@@ -1,0 +1,80 @@
+"""
+Feature elimination at covtype scale (counterpart of the reference's
+examples/eliminate/covtype.py: 275.2s on a Spark cluster to scan
+feature subsets of covtype's 54 columns, best CV 0.6408 vs 0.6258
+with all features — a job it estimated at 5+ hours serial).
+
+Zero-egress environment: covtype can't be fetched, so the workload is
+shape-faithful synthetic (n × 54, 7 classes) with 14 of the 54 columns
+pure noise — the eliminator should discard most of them and beat the
+all-features score. Every (feature_set × fold) fit runs as one vmapped
+XLA program with column masks riding the task axis.
+
+Sample output (CPU backend, this repo's test rig, --rows 40000):
+    -- workload: (40000, 54), 7 classes, 14 junk columns
+    -- 12 feature sets x 5 folds in 126.91s
+    -- all-features CV score: 0.7723
+    -- best CV score: 0.7729 with 42 features
+    -- junk columns kept: 2/14
+
+Run: python examples/eliminate/covtype.py [--rows 40000]
+"""
+
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# wedged-accelerator guard: use the TPU when it answers, else pin CPU
+from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
+
+probe_platform_or_cpu()
+import time
+
+import numpy as np
+
+from skdist_tpu.distribute.eliminate import DistFeatureEliminator
+from skdist_tpu.models import LogisticRegression
+
+
+def make_covtype_shaped(n=40_000, seed=0, d=54, k=7, n_junk=14):
+    rng = np.random.RandomState(seed)
+    d_inf = d - n_junk
+    W = rng.normal(size=(d_inf, k))
+    X_inf = rng.normal(size=(n, d_inf)).astype(np.float32)
+    y = (X_inf @ W + 2.0 * rng.normal(size=(n, k))).argmax(1)
+    X = np.empty((n, d), dtype=np.float32)
+    junk_cols = rng.choice(d, size=n_junk, replace=False)
+    inf_cols = np.setdiff1d(np.arange(d), junk_cols)
+    X[:, inf_cols] = X_inf
+    X[:, junk_cols] = rng.normal(size=(n, n_junk))
+    return X, y, set(junk_cols.tolist())
+
+
+def main():
+    rows = 40_000
+    if "--rows" in sys.argv:
+        rows = int(sys.argv[sys.argv.index("--rows") + 1])
+
+    X, y, junk = make_covtype_shaped(rows)
+    print(f"-- workload: {X.shape}, {len(np.unique(y))} classes, "
+          f"{len(junk)} junk columns")
+
+    start = time.time()
+    fe = DistFeatureEliminator(
+        LogisticRegression(max_iter=40),
+        min_features_to_select=10, step=4, cv=5, scoring="accuracy",
+    ).fit(X, y)
+    wall = time.time() - start
+
+    kept = set(fe.best_features_.tolist())
+    print(f"-- {len(fe.scores_)} feature sets x 5 folds in {wall:.2f}s")
+    print(f"-- all-features CV score: {fe.scores_[0]:.4f}")
+    print(f"-- best CV score: {fe.best_score_:.4f} "
+          f"with {fe.n_features_} features")
+    print(f"-- junk columns kept: {len(kept & junk)}/{len(junk)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,16 @@ Sample output (CPU backend, this repo's test rig):
 Run: python examples/search/basic_usage.py
 """
 
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# wedged-accelerator guard: use the TPU when it answers, else pin CPU
+from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
+
+probe_platform_or_cpu()
 import pickle
 import time
 

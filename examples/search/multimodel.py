@@ -11,6 +11,16 @@ Sample output (CPU backend):
 Run: python examples/search/multimodel.py
 """
 
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# wedged-accelerator guard: use the TPU when it answers, else pin CPU
+from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
+
+probe_platform_or_cpu()
 import numpy as np
 from sklearn.datasets import load_digits
 from sklearn.model_selection import train_test_split

@@ -15,7 +15,7 @@ Post-fit, the backend handle is stripped so the artifact pickles clean
 
 import numpy as np
 
-from ..base import strip_runtime
+from ..base import BaseEstimator, strip_runtime
 from ..models.forest import (
     ExtraTreesClassifier,
     ExtraTreesRegressor,
@@ -27,6 +27,8 @@ from ..parallel import parse_partitions, resolve_backend
 from ..utils.validation import check_estimator_backend, safe_indexing
 
 __all__ = [
+    "DistForestClassifier",
+    "DistForestRegressor",
     "DistRandomForestClassifier",
     "DistRandomForestRegressor",
     "DistExtraTreesClassifier",
@@ -198,3 +200,147 @@ class DistRandomTreesEmbedding(_DistForestMixin, RandomTreesEmbedding):
 
     def fit_transform(self, X, y=None, sample_weight=None):
         return self.fit(X, y, sample_weight=sample_weight).transform(X)
+
+
+# ---------------------------------------------------------------------------
+# bring-your-own-tree intermediates (reference DistForestClassifier /
+# DistForestRegressor, ensemble.py:343-363 and 483-504): a forest over an
+# ARBITRARY sklearn-style base estimator. The Dist* classes above are the
+# TPU-native fast path over this package's histogram-tree kernels; these
+# two keep the reference's public extension point — any estimator with
+# fit/predict(_proba) fans out one-task-per-tree on the host backend.
+# ---------------------------------------------------------------------------
+
+class _DistBaseEstimatorForest(BaseEstimator):
+    def __init__(self, base_estimator, backend=None, partitions="auto",
+                 n_estimators=100, bootstrap=True, random_state=None,
+                 n_jobs=None, verbose=0):
+        self.base_estimator = base_estimator
+        self.backend = backend
+        self.partitions = partitions
+        self.n_estimators = n_estimators
+        self.bootstrap = bootstrap
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.verbose = verbose
+
+    def fit(self, X, y, **fit_params):
+        from sklearn.base import clone as sk_clone
+
+        check_estimator_backend(self, self.verbose)
+        backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
+        n = len(X) if not hasattr(X, "shape") else X.shape[0]
+        y_arr = np.asarray(y)
+        self._set_fit_targets(y_arr)
+        rng = np.random.RandomState(self.random_state)
+        seeds = rng.randint(np.iinfo(np.int32).max, size=self.n_estimators)
+        supports_weight = True
+        try:
+            import inspect
+
+            supports_weight = (
+                "sample_weight"
+                in inspect.signature(self.base_estimator.fit).parameters
+            )
+        except (TypeError, ValueError):
+            pass
+        bootstrap = self.bootstrap
+        fit_params = dict(fit_params)
+        # a user-supplied full-length sample_weight composes with the
+        # bootstrap weights multiplicatively (sklearn forest semantics)
+        user_weight = fit_params.pop("sample_weight", None)
+        if user_weight is not None:
+            user_weight = np.asarray(user_weight, dtype=np.float64)
+
+        def build_one(seed):
+            est = sk_clone(self.base_estimator)
+            if "random_state" in est.get_params():
+                est.set_params(random_state=int(seed))
+            if not bootstrap:
+                if user_weight is not None and supports_weight:
+                    est.fit(X, y_arr, sample_weight=user_weight,
+                            **fit_params)
+                else:
+                    est.fit(X, y_arr, **fit_params)
+                return est
+            r = np.random.RandomState(seed)
+            idx = r.randint(0, n, n)
+            if supports_weight:
+                # the reference's bootstrap: bincount weights over the
+                # full X (ensemble.py:88-104), not a row resample
+                sw = np.bincount(idx, minlength=n).astype(np.float64)
+                if user_weight is not None:
+                    sw = sw * user_weight
+                est.fit(X, y_arr, sample_weight=sw, **fit_params)
+            else:
+                est.fit(safe_indexing(X, idx), y_arr[idx], **fit_params)
+            return est
+
+        # partitions bounds per-round fan-out exactly as in the batched
+        # classes (the reference's numSlices knob)
+        round_size = parse_partitions(self.partitions, len(seeds))
+        self.estimators_ = []
+        for start in range(0, len(seeds), round_size):
+            self.estimators_.extend(backend.run_tasks(
+                build_one, seeds[start:start + round_size],
+                verbose=self.verbose,
+            ))
+        self.n_features_in_ = X.shape[1] if hasattr(X, "shape") else None
+        strip_runtime(self)
+        return self
+
+    def __len__(self):
+        return len(self.estimators_)
+
+    def __getitem__(self, index):
+        return self.estimators_[index]
+
+
+class DistForestClassifier(_DistBaseEstimatorForest):
+    """Forest of cloned classifier ``base_estimator``s with majority
+    soft-vote aggregation (reference ensemble.py:343-363)."""
+
+    _estimator_type = "classifier"
+
+    def _set_fit_targets(self, y_arr):
+        self.classes_ = np.unique(y_arr)
+
+    def predict_proba(self, X):
+        agg = np.zeros((X.shape[0] if hasattr(X, "shape") else len(X),
+                        len(self.classes_)))
+        for est in self.estimators_:
+            if hasattr(est, "predict_proba"):
+                proba = np.asarray(est.predict_proba(X))
+                cols = np.searchsorted(self.classes_, est.classes_)
+                agg[:, cols] += proba
+            else:  # hard-vote fallback for probability-free bases
+                preds = np.searchsorted(self.classes_, est.predict(X))
+                agg[np.arange(len(preds)), preds] += 1.0
+        return agg / len(self.estimators_)
+
+    def predict(self, X):
+        return self.classes_[np.argmax(self.predict_proba(X), axis=1)]
+
+    def score(self, X, y):
+        return float(np.mean(self.predict(X) == np.asarray(y)))
+
+
+class DistForestRegressor(_DistBaseEstimatorForest):
+    """Forest of cloned regressor ``base_estimator``s with mean
+    aggregation (reference ensemble.py:483-504)."""
+
+    _estimator_type = "regressor"
+
+    def _set_fit_targets(self, y_arr):
+        pass
+
+    def predict(self, X):
+        return np.mean(
+            [np.asarray(est.predict(X)) for est in self.estimators_], axis=0
+        )
+
+    def score(self, X, y):
+        y = np.asarray(y, dtype=np.float64)
+        resid = y - self.predict(X)
+        denom = np.sum((y - y.mean()) ** 2)
+        return float(1.0 - np.sum(resid ** 2) / denom) if denom else 0.0

@@ -63,21 +63,34 @@ def task_data_mesh(devices=None, data_axis_size=1):
 
 def multihost_task_mesh(data_axis_size=None):
     """Global 2D mesh for multi-host runs: 'data' along each host's
-    local devices (ICI), 'tasks' across hosts (DCN)."""
+    local devices (ICI), 'tasks' across hosts × leftover local factor
+    (DCN). On a single-host process this deterministically degenerates
+    to :func:`task_data_mesh`; in a genuine multi-host run any
+    construction failure propagates loudly instead of silently falling
+    back to a single-host mesh (which would wedge the SPMD program the
+    moment other hosts enter the collective)."""
     import jax
 
     local = jax.local_device_count()
     if data_axis_size is None:
         data_axis_size = local
-    try:
-        from jax.experimental import mesh_utils
-        from jax.sharding import Mesh
-
-        n_hosts = jax.device_count() // local
-        arr = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(1, data_axis_size),
-            dcn_mesh_shape=(n_hosts * (local // data_axis_size), 1),
+    if data_axis_size < 1 or local % data_axis_size != 0:
+        raise ValueError(
+            f"data_axis_size={data_axis_size} must divide the local "
+            f"device count {local}"
         )
-        return Mesh(arr.reshape(-1, data_axis_size), ("tasks", "data"))
-    except Exception:
+    n_hosts = jax.process_count()
+    if n_hosts == 1:
         return task_data_mesh(data_axis_size=data_axis_size)
+    from jax.sharding import Mesh
+
+    # Deterministic construction (create_hybrid_device_mesh assumes
+    # slice-granule topologies and rejects common pod slices): order
+    # the global devices by (process, device id) so each contiguous
+    # data_axis_size group lives inside ONE process — 'data'-axis
+    # collectives (gram/gradient psums) ride ICI; the 'tasks' axis
+    # spans processes over DCN, which is fine because tasks never talk
+    # to each other.
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    arr = np.array(devices).reshape(-1, data_axis_size)
+    return Mesh(arr, ("tasks", "data"))

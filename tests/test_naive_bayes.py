@@ -79,9 +79,11 @@ def test_invalid_input_honors_error_score(clf_data):
         MultinomialNB(), {"alpha": [0.1, 1.0]}, cv=2, refit=False,
         scoring="accuracy", error_score=np.nan,
     )
+    # every candidate fails -> loud error (sklearn raises here too),
+    # after FitFailedWarning-marked per-task substitutions
     with pytest.warns(FitFailedWarning):
-        gs.fit(X, y)
-    assert np.isnan(gs.cv_results_["mean_test_score"]).all()
+        with pytest.raises(RuntimeError, match="All candidate fits failed"):
+            gs.fit(X, y)
     with pytest.raises(ValueError):
         DistGridSearchCV(
             MultinomialNB(), {"alpha": [1.0]}, cv=2, scoring="accuracy",
