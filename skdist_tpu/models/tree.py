@@ -64,7 +64,7 @@ def n_tree_nodes(max_depth):
 def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
                       min_samples_split, min_samples_leaf,
                       min_impurity_decrease, extra, classification,
-                      hist_block=8):
+                      hist_block=8, hist_mode="auto"):
     """Returns ``kernel(Xb, Ych, key) -> tree`` growing one tree.
 
     - ``Xb`` (n, d) int32 binned features
@@ -75,9 +75,28 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
 
     ``tree`` = {feat (N,), thr (N,), is_split (N,), leaf (N, K_out)}
     with N = 2^(D+1)-1 heap-indexed nodes (children of i: 2i+1, 2i+2).
+
+    ``hist_mode`` selects the per-level histogram algorithm:
+
+    - ``"scatter"``: blocked scatter-add (one segment-add per feature
+      block). Best on CPU, where scatters are cheap and FLOPs are not.
+    - ``"matmul"``: one-hot matmul — ``hist = Xoh.T @ (nodeoh ⊗ Ych)``
+      where ``Xoh`` (n, d·B) is the LEVEL-INVARIANT one-hot of the
+      binned features (hoisted out of the level loop) and the right
+      factor (n, nl·C) re-weights each sample's channels by its node.
+      This trades redundant FLOPs for MXU throughput: the whole
+      histogram becomes one large dense matmul per level, the shape TPU
+      hardware is built for, displacing the scatter that round-1
+      measured as the forest bottleneck (42s vs sklearn's 7.4s per 100
+      trees on 20k×54). f32 accumulation, exact 0/1 one-hots.
+    - ``"auto"``: matmul on accelerators, scatter on CPU.
     """
     d, B, C, D = n_features, n_bins, channels, max_depth
     K = C - 1 if classification else 1  # leaf output width
+    if hist_mode == "auto":
+        hist_mode = (
+            "scatter" if jax.default_backend() == "cpu" else "matmul"
+        )
 
     def node_scores(hist_cum):
         """hist_cum (d, nl, B, C) cumulative over bins → per-(f, node,
@@ -122,18 +141,24 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
         )
 
         # level-invariant histogram inputs, hoisted out of the unrolled
-        # level loop: padded feature-major bins and the tiled channel
-        # matrix each scatter consumes
-        fb = min(hist_block, d)
-        n_blocks = -(-d // fb)
-        d_pad = n_blocks * fb
-        XbT = Xb.T
-        if d_pad != d:
-            XbT = jnp.concatenate(
-                [XbT, jnp.zeros((d_pad - d, XbT.shape[1]), XbT.dtype)]
-            )
-        XbT_blocks = XbT.reshape(n_blocks, fb, -1)
-        Ych_tiled = jnp.tile(Ych, (fb, 1))  # (fb*n, C)
+        # level loop
+        if hist_mode == "matmul":
+            # (n, d·B) one-hot of the binned features — the left matmul
+            # factor for every level
+            Xoh = jax.nn.one_hot(Xb, B, dtype=Ych.dtype).reshape(n, d * B)
+        else:
+            # padded feature-major bins and the tiled channel matrix
+            # each scatter consumes
+            fb = min(hist_block, d)
+            n_blocks = -(-d // fb)
+            d_pad = n_blocks * fb
+            XbT = Xb.T
+            if d_pad != d:
+                XbT = jnp.concatenate(
+                    [XbT, jnp.zeros((d_pad - d, XbT.shape[1]), XbT.dtype)]
+                )
+            XbT_blocks = XbT.reshape(n_blocks, fb, -1)
+            Ych_tiled = jnp.tile(Ych, (fb, 1))  # (fb*n, C)
 
         for level in range(D):
             start = 2**level - 1
@@ -141,22 +166,39 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
             rel = node_id - start
             at_level = (node_id >= start) & (node_id < start + nl)
 
-            # ---- histogram: scan over feature BLOCKS, one scatter per
-            # block (fewer, larger scatters pipeline far better on TPU
-            # than d tiny ones; block size bounds the update buffer)
-            seg_node = jnp.where(at_level, rel * B, nl * B * fb)
-            f_off = (jnp.arange(fb) * (nl * B))[:, None]
+            if hist_mode == "matmul":
+                # ---- histogram as one MXU matmul per level:
+                # (d·B, n) @ (n, nl·C) with samples not at this level
+                # zeroed by the node one-hot
+                level_oh = jax.nn.one_hot(
+                    jnp.clip(rel, 0, nl - 1), nl, dtype=Ych.dtype
+                ) * at_level[:, None].astype(Ych.dtype)
+                NW = (level_oh[:, :, None] * Ych[:, None, :]).reshape(
+                    n, nl * C
+                )
+                hist = lax.dot_general(
+                    Xoh, NW, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                hist = hist.reshape(d, B, nl, C).transpose(0, 2, 1, 3)
+            else:
+                # ---- histogram: scan over feature BLOCKS, one scatter
+                # per block (fewer, larger scatters pipeline better
+                # than d tiny ones; block size bounds the buffer)
+                seg_node = jnp.where(at_level, rel * B, nl * B * fb)
+                f_off = (jnp.arange(fb) * (nl * B))[:, None]
 
-            def hist_blk(_, xcols, seg_node=seg_node, f_off=f_off, nl=nl):
-                # xcols (fb, n)
-                seg = jnp.minimum(seg_node[None, :] + f_off + xcols,
-                                  nl * B * fb)
-                h = jnp.zeros((nl * B * fb + 1, C), Ych.dtype)
-                h = h.at[seg.reshape(-1)].add(Ych_tiled)
-                return None, h[: nl * B * fb].reshape(fb, nl, B, C)
+                def hist_blk(_, xcols, seg_node=seg_node, f_off=f_off,
+                             nl=nl):
+                    # xcols (fb, n)
+                    seg = jnp.minimum(seg_node[None, :] + f_off + xcols,
+                                      nl * B * fb)
+                    h = jnp.zeros((nl * B * fb + 1, C), Ych.dtype)
+                    h = h.at[seg.reshape(-1)].add(Ych_tiled)
+                    return None, h[: nl * B * fb].reshape(fb, nl, B, C)
 
-            _, hist = lax.scan(hist_blk, None, XbT_blocks)
-            hist = hist.reshape(d_pad, nl, B, C)[:d]  # (d, nl, B, C)
+                _, hist = lax.scan(hist_blk, None, XbT_blocks)
+                hist = hist.reshape(d_pad, nl, B, C)[:d]  # (d, nl, B, C)
             cum = jnp.cumsum(hist, axis=2)
             gain, cnt_l, cnt_r, tot = node_scores(cum)
 

@@ -392,3 +392,33 @@ def test_dist_forest_partitions_and_set_params(clf_data):
         a.set_params(n_estimatorz=5)
     a.set_params(base_estimator__max_depth=3)
     assert a.base_estimator.max_depth == 3
+
+
+def test_hist_matmul_matches_scatter(clf_data, reg_data):
+    """The MXU one-hot-matmul histogram must grow the same tree as the
+    scatter histogram (same gains up to float-sum ordering)."""
+    import jax
+    import jax.numpy as jnp
+
+    from skdist_tpu.models.tree import build_tree_kernel
+    from skdist_tpu.models.forest import classification_channels
+    from skdist_tpu.ops.binning import apply_bins, quantile_bin_edges
+
+    X, y = clf_data
+    edges = quantile_bin_edges(X, 16)
+    Xb = apply_bins(jnp.asarray(X), edges)
+    Ych = classification_channels(
+        jnp.asarray(y), jnp.ones(len(y), jnp.float32), 3
+    )
+    cfg = dict(
+        n_features=X.shape[1], n_bins=16, channels=4, max_depth=4,
+        max_features=X.shape[1], min_samples_split=2, min_samples_leaf=1,
+        min_impurity_decrease=0.0, extra=False, classification=True,
+    )
+    key = jax.random.PRNGKey(0)
+    t_sc = build_tree_kernel(hist_mode="scatter", **cfg)(Xb, Ych, key)
+    t_mm = build_tree_kernel(hist_mode="matmul", **cfg)(Xb, Ych, key)
+    np.testing.assert_array_equal(t_sc["feat"], t_mm["feat"])
+    np.testing.assert_array_equal(t_sc["thr"], t_mm["thr"])
+    np.testing.assert_array_equal(t_sc["is_split"], t_mm["is_split"])
+    np.testing.assert_allclose(t_sc["leaf"], t_mm["leaf"], atol=1e-5)
