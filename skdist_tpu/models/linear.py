@@ -315,13 +315,23 @@ class LogisticRegression(_LinearClassifierBase):
     score parity with the reference stack holds to solver tolerance.
     ``C`` and ``tol`` are batchable hyperparameters — a CV grid over C
     compiles to a single vmapped XLA program.
+
+    ``matmul_dtype="bfloat16"`` runs the loss/gradient matmuls (the
+    FLOP bulk of L-BFGS) with bf16 inputs and f32 accumulation
+    (``preferred_element_type``) — ~2× MXU throughput on TPU for a
+    small, bounded precision cost; the L-BFGS state, reductions, and
+    regulariser stay f32. Default f32 exactness.
     """
 
     _hyper_names = ("C", "tol")
-    _static_names = ("max_iter", "fit_intercept", "class_weight", "history")
+    _static_names = (
+        "max_iter", "fit_intercept", "class_weight", "history",
+        "matmul_dtype",
+    )
 
     def __init__(self, C=1.0, tol=1e-4, max_iter=100, fit_intercept=True,
-                 class_weight=None, penalty="l2", random_state=None, history=10):
+                 class_weight=None, penalty="l2", random_state=None,
+                 history=10, matmul_dtype=None):
         self.C = C
         self.tol = tol
         self.max_iter = max_iter
@@ -330,8 +340,11 @@ class LogisticRegression(_LinearClassifierBase):
         self.penalty = penalty
         self.random_state = random_state
         self.history = history
+        self.matmul_dtype = matmul_dtype
         if penalty not in ("l2", None, "none"):
             raise ValueError("LogisticRegression supports penalty='l2' (or None)")
+        if matmul_dtype not in (None, "float32", "bfloat16"):
+            raise ValueError("matmul_dtype must be None/'float32'/'bfloat16'")
 
     @classmethod
     def _build_fit_kernel(cls, meta, static):
@@ -342,6 +355,12 @@ class LogisticRegression(_LinearClassifierBase):
         class_weight, cw_arr = st["class_weight"], meta.get("cw_arr")
         binary = k <= 2
 
+        md = st.get("matmul_dtype")
+        if md not in (None, "float32", "bfloat16"):
+            # re-validated here because set_params bypasses __init__
+            raise ValueError("matmul_dtype must be None/'float32'/'bfloat16'")
+        bf16 = md == "bfloat16"
+
         def kernel(X, y_idx, sw, hyper, aux=None):
             C = hyper["C"]
             tol = hyper["tol"]
@@ -349,11 +368,25 @@ class LogisticRegression(_LinearClassifierBase):
             p = Xa.shape[1]
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
             d = meta["n_features"]
+            if bf16:
+                # bf16 operands, f32 accumulation: MXU-rate matmuls
+                # while the solver state stays f32
+                Xmm = Xa.astype(jnp.bfloat16)
+
+                def matvec(w):
+                    return jax.lax.dot_general(
+                        Xmm, w.astype(jnp.bfloat16),
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+            else:
+                def matvec(w):
+                    return Xa @ w
             if binary:
                 ypm = (y_idx == (k - 1)).astype(X.dtype)  # {0,1}
 
                 def loss(w):
-                    z = Xa @ w
+                    z = matvec(w)
                     ce = jnp.sum(sw * (jax.nn.softplus(z) - ypm * z))
                     reg = 0.5 / C * jnp.dot(w[:d], w[:d])
                     return ce + reg
@@ -367,7 +400,7 @@ class LogisticRegression(_LinearClassifierBase):
 
             def loss(wflat):
                 W = wflat.reshape(p, k)
-                logits = Xa @ W
+                logits = matvec(W)
                 lse = jax.nn.logsumexp(logits, axis=1)
                 ce = jnp.sum(sw * (lse - jnp.sum(onehot * logits, axis=1)))
                 reg = 0.5 / C * jnp.sum(W[:d] * W[:d])

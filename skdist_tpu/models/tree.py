@@ -94,8 +94,18 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
     d, B, C, D = n_features, n_bins, channels, max_depth
     K = C - 1 if classification else 1  # leaf output width
     if hist_mode == "auto":
+        # matmul materialises a dense (n, d·B) one-hot; on wide data
+        # (hashed-text widths) that dwarfs HBM and its FLOPs scale with
+        # d·B, so auto only picks it for the tabular widths it wins at
         hist_mode = (
-            "scatter" if jax.default_backend() == "cpu" else "matmul"
+            "matmul"
+            if jax.default_backend() != "cpu" and d * B <= 16384
+            else "scatter"
+        )
+    if hist_mode not in ("scatter", "matmul"):
+        raise ValueError(
+            f"hist_mode must be 'auto', 'scatter' or 'matmul'; "
+            f"got {hist_mode!r}"
         )
 
     def node_scores(hist_cum):
@@ -351,12 +361,13 @@ class _BaseTree(BaseEstimator):
     _static_names = (
         "max_depth", "n_bins", "max_features", "min_samples_split",
         "min_samples_leaf", "min_impurity_decrease", "splitter",
-        "random_state",
+        "random_state", "hist_mode",
     )
 
     def __init__(self, max_depth=8, n_bins=32, max_features=None,
                  min_samples_split=2, min_samples_leaf=1,
-                 min_impurity_decrease=0.0, splitter="best", random_state=0):
+                 min_impurity_decrease=0.0, splitter="best", random_state=0,
+                 hist_mode="auto"):
         self.max_depth = max_depth
         self.n_bins = n_bins
         self.max_features = max_features
@@ -365,6 +376,7 @@ class _BaseTree(BaseEstimator):
         self.min_impurity_decrease = min_impurity_decrease
         self.splitter = splitter
         self.random_state = random_state
+        self.hist_mode = hist_mode
 
     @property
     def _classification(self):
@@ -411,6 +423,7 @@ class _BaseTree(BaseEstimator):
             min_impurity_decrease=st["min_impurity_decrease"],
             extra=(st["splitter"] == "random"),
             classification=classification,
+            hist_mode=st.get("hist_mode", "auto"),
         )
         seed = st["random_state"] or 0
 
