@@ -51,6 +51,7 @@ from ..utils.validation import (
     check_is_fitted,
     check_n_iter,
     index_fit_params,
+    num_samples,
     safe_split,
 )
 
@@ -229,9 +230,13 @@ def _build_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
 
     def kernel(shared, task):
         X, y, sw = shared["X"], shared["y"], shared["sw"]
-        train_w = sw * shared["train_masks"][task["split"]]
-        test_w = sw * shared["test_masks"][task["split"]]
-        params = fit_kernel(X, y, train_w, task["hyper"], shared["aux"])
+        # user sample_weight (carried in sw) weights the FIT only;
+        # train/test scoring is over the raw fold masks, like sklearn
+        # scorers called without sample_weight
+        fit_w = sw * shared["train_masks"][task["split"]]
+        train_w = shared["train_masks"][task["split"]]
+        test_w = shared["test_masks"][task["split"]]
+        params = fit_kernel(X, y, fit_w, task["hyper"], shared["aux"])
         outputs = {"decision": decision_kernel(params, X)}
         outputs["predict"] = outputs["decision"]
         if proba_kernel is not None:
@@ -361,9 +366,20 @@ class DistBaseSearchCV(BaseEstimator):
         score dicts in task order (candidate-major, split fastest)."""
         n_splits = len(splits)
         batched = None
-        if not fit_params:
+        # the batched device path handles the one array-valued fit
+        # param with device semantics — full-length sample_weight
+        # (fold masks compose with it multiplicatively); anything else
+        # routes to the generic host path
+        sw = fit_params.get("sample_weight")
+        sw_ok = sw is None or (
+            hasattr(sw, "__len__") and len(sw) == num_samples(X)
+        )
+        if (not fit_params or set(fit_params) == {"sample_weight"}) and sw_ok:
+            # wrong-length sample_weight stays on the host path, where
+            # the per-task error_score contract handles the failure
             batched = self._try_batched(
-                backend, estimator, X, y, candidate_params, splits
+                backend, estimator, X, y, candidate_params, splits,
+                sample_weight=sw,
             )
         if batched is not None:
             return batched
@@ -385,7 +401,8 @@ class DistBaseSearchCV(BaseEstimator):
 
         return backend.run_tasks(run_one, tasks, verbose=self.verbose)
 
-    def _try_batched(self, backend, estimator, X, y, candidate_params, splits):
+    def _try_batched(self, backend, estimator, X, y, candidate_params, splits,
+                     sample_weight=None):
         """Attempt the batched device path; None → fall back to generic."""
         if not hasattr(type(estimator), "_build_fit_kernel"):
             return None
@@ -435,7 +452,9 @@ class DistBaseSearchCV(BaseEstimator):
             if static_overrides:
                 bucket_est.set_params(**static_overrides)
             try:
-                data, meta = bucket_est._prep_fit_data(X_arr, y, None)
+                data, meta = bucket_est._prep_fit_data(
+                    X_arr, y, sample_weight
+                )
             except Exception:
                 # estimator-level input validation failures must flow
                 # through the host path so the error_score contract

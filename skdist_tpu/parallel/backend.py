@@ -26,6 +26,7 @@ unit tests need no accelerator.
 import math
 import os
 import time
+import warnings
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -135,9 +136,13 @@ class LocalBackend(TaskBackend):
         n_tasks = _leading_dim(task_args)
         chunk = min(n_tasks, round_size or n_tasks)
         timings = [] if return_timings else None
-        out = _run_in_rounds(
-            fn, task_args, shared_args, n_tasks, chunk, timings=timings
-        )
+        try:
+            out = _run_in_rounds(
+                fn, task_args, shared_args, n_tasks, chunk, timings=timings
+            )
+        except _RoundsExhausted as oom:
+            # no adaptive retry on host memory; surface the real error
+            raise oom.cause
         return (out, timings) if return_timings else out
 
 
@@ -253,20 +258,69 @@ class TPUBackend(TaskBackend):
         fn = _jit_vmapped(
             kernel, static_args, task_sharding, shared_shardings
         )
+        put = lambda t: jax.device_put(t, task_sharding)
+        # HBM-adaptive rounds: a round that exhausts device memory is
+        # halved (device-count aligned) and the run RESUMES from the
+        # first unfinished task — completed rounds are kept, not
+        # recomputed. The analogue of tuning the reference's
+        # `partitions` by hand, automated; a new chunk size is a new
+        # shape, so jax recompiles transparently.
         timings = [] if return_timings else None
-        out = _run_in_rounds(
-            fn, task_args, shared_args, n_tasks, chunk,
-            put=lambda t: jax.device_put(t, task_sharding),
-            timings=timings,
-        )
+        rounds_out = []
+        offset = 0
+        while offset < n_tasks:
+            sub = (
+                jax.tree_util.tree_map(lambda a: a[offset:], task_args)
+                if offset else task_args
+            )
+            try:
+                rounds_out.extend(_run_in_rounds(
+                    fn, sub, shared_args, n_tasks - offset, chunk,
+                    put=put, timings=timings, concat=False,
+                ))
+                break
+            except _RoundsExhausted as oom:
+                rounds_out.extend(oom.completed)
+                offset += oom.consumed
+                if chunk <= d:
+                    raise oom.cause
+                chunk = int(math.ceil(chunk / 2 / d) * d)
+                warnings.warn(
+                    "batched_map round exhausted device memory; resuming "
+                    f"at round_size={chunk} (pass partitions="
+                    f"{-(-n_tasks // chunk)} to pick this up front)"
+                )
+        out = _concat_rounds(rounds_out)
         return (out, timings) if return_timings else out
 
 
+class _RoundsExhausted(Exception):
+    """Internal: a round hit RESOURCE_EXHAUSTED. Carries the rounds that
+    DID complete (host numpy) and how many tasks they cover, so the
+    caller can resume from the first unfinished task at a smaller
+    round size."""
+
+    def __init__(self, completed, consumed, cause):
+        super().__init__(str(cause))
+        self.completed = completed
+        self.consumed = consumed
+        self.cause = cause
+
+
+def _concat_rounds(outs):
+    import jax
+
+    if len(outs) == 1:
+        return outs[0]
+    return jax.tree_util.tree_map(lambda *xs: np.concatenate(xs, axis=0), *outs)
+
+
 def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
-                   timings=None):
+                   timings=None, concat=True):
     """Shared round loop: slice task axis, pad the tail round to the
     fixed chunk shape (padding duplicates the last task; its outputs are
-    sliced off), run, gather to host numpy, concatenate.
+    sliced off), run, gather to host numpy, concatenate (or return the
+    per-round list with ``concat=False``).
 
     All rounds are DISPATCHED before any is gathered — JAX dispatch is
     asynchronous, so round i+1's host-side slicing and transfer overlap
@@ -276,27 +330,62 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
     ``timings``: optional list; appends ``(round_wall_s, n_tasks_kept)``
     per round — measured gather-to-gather so the walls are
     non-overlapping and sum to the call's total despite pipelining.
+
+    A RESOURCE_EXHAUSTED failure raises :class:`_RoundsExhausted`
+    carrying the successfully gathered rounds.
     """
     import jax
 
     t_prev = time.perf_counter() if timings is not None else None
-    pending = []
-    for start in range(0, n_tasks, chunk):
-        stop = min(start + chunk, n_tasks)
-        sl = jax.tree_util.tree_map(lambda a: a[start:stop], task_args)
-        pad = chunk - (stop - start)
-        if pad:
-            sl = jax.tree_util.tree_map(
-                lambda a: np.concatenate([a, np.repeat(a[-1:], pad, axis=0)]),
-                sl,
-            )
-        if put is not None:
-            sl = put(sl)
-        pending.append((fn(shared_args, sl), stop - start, pad))
-
     outs = []
+    consumed = 0
+
+    def _oom(exc):
+        return _RoundsExhausted(outs, consumed, exc)
+
+    pending = []
+    try:
+        for start in range(0, n_tasks, chunk):
+            stop = min(start + chunk, n_tasks)
+            sl = jax.tree_util.tree_map(lambda a: a[start:stop], task_args)
+            pad = chunk - (stop - start)
+            if pad:
+                sl = jax.tree_util.tree_map(
+                    lambda a: np.concatenate(
+                        [a, np.repeat(a[-1:], pad, axis=0)]
+                    ),
+                    sl,
+                )
+            if put is not None:
+                sl = put(sl)
+            pending.append((fn(shared_args, sl), stop - start, pad))
+    except Exception as exc:
+        if "RESOURCE_EXHAUSTED" not in str(exc):
+            raise
+        # gather whatever was dispatched before the failure, then hand
+        # control back for a smaller-chunk resume
+        for dev_out, keep, pad in pending:
+            try:
+                out = jax.device_get(dev_out)
+            except Exception:
+                break
+            if timings is not None:
+                now = time.perf_counter()
+                timings.append((now - t_prev, keep))
+                t_prev = now
+            if pad:
+                out = jax.tree_util.tree_map(lambda a: a[:keep], out)
+            outs.append(out)
+            consumed += keep
+        raise _oom(exc) from None
+
     for dev_out, keep, pad in pending:
-        out = jax.device_get(dev_out)
+        try:
+            out = jax.device_get(dev_out)
+        except Exception as exc:
+            if "RESOURCE_EXHAUSTED" not in str(exc):
+                raise
+            raise _oom(exc) from None
         if timings is not None:
             now = time.perf_counter()
             timings.append((now - t_prev, keep))
@@ -304,9 +393,10 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
         if pad:
             out = jax.tree_util.tree_map(lambda a: a[:keep], out)
         outs.append(out)
-    if len(outs) == 1:
-        return outs[0]
-    return jax.tree_util.tree_map(lambda *xs: np.concatenate(xs, axis=0), *outs)
+        consumed += keep
+    if not concat:
+        return outs
+    return _concat_rounds(outs)
 
 
 def _leading_dim(task_args):

@@ -126,3 +126,77 @@ def test_tpu_backend_rounds(tpu_backend):
     finally:
         tpu_backend.round_size = None
     assert np.allclose(out["v"], W * 2.0)
+
+
+def test_batched_map_halves_round_on_oom(tpu_backend, monkeypatch):
+    """A round that exhausts device memory retries at half size
+    (device-aligned) instead of failing the whole search."""
+    import jax
+    import jax.numpy as jnp
+
+    from skdist_tpu.parallel import backend as backend_mod
+
+    real_jit = backend_mod._jit_vmapped
+    seen_chunks = []
+
+    def fussy_jit(kernel, static_args, *rest):
+        fn = real_jit(kernel, static_args, *rest)
+
+        def wrapper(shared, tasks):
+            chunk = jax.tree_util.tree_leaves(tasks)[0].shape[0]
+            seen_chunks.append(chunk)
+            if chunk > 8:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory (simulated)"
+                )
+            return fn(shared, tasks)
+
+        return wrapper
+
+    monkeypatch.setattr(backend_mod, "_jit_vmapped", fussy_jit)
+    tasks = {"x": np.arange(32, dtype=np.float32)}
+    out = tpu_backend.batched_map(
+        lambda shared, t: {"y": t["x"] * 2.0}, tasks
+    )
+    np.testing.assert_allclose(out["y"], np.arange(32) * 2.0)
+    assert max(seen_chunks) > 8          # the too-big round was tried
+    assert seen_chunks[-1] <= 8          # and halved until it fit
+
+
+def test_batched_map_oom_resumes_from_completed_rounds(tpu_backend,
+                                                       monkeypatch):
+    """After an OOM, completed rounds are KEPT and the run resumes at
+    the first unfinished task at a smaller chunk — no recomputation."""
+    import jax
+
+    from skdist_tpu.parallel import backend as backend_mod
+
+    real_jit = backend_mod._jit_vmapped
+    calls = []
+
+    def fussy_jit(kernel, static_args, *rest):
+        fn = real_jit(kernel, static_args, *rest)
+
+        def wrapper(shared, tasks):
+            chunk = jax.tree_util.tree_leaves(tasks)[0].shape[0]
+            first = float(jax.tree_util.tree_leaves(tasks)[0][0])
+            calls.append((chunk, first))
+            # the SECOND big round blows up; the first succeeds
+            if chunk > 8 and first >= 16:
+                raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
+            return fn(shared, tasks)
+
+        return wrapper
+
+    monkeypatch.setattr(backend_mod, "_jit_vmapped", fussy_jit)
+    tasks = {"x": np.arange(32, dtype=np.float32)}
+    out, timings = tpu_backend.batched_map(
+        lambda shared, t: {"y": t["x"] * 2.0}, tasks, round_size=16,
+        return_timings=True,
+    )
+    np.testing.assert_allclose(out["y"], np.arange(32) * 2.0)
+    # tasks 0-15 ran once at chunk 16 and were never re-dispatched
+    assert calls[0] == (16, 0.0)
+    assert all(first >= 16 for _, first in calls[1:])
+    # timings cover every task exactly once
+    assert sum(keep for _, keep in timings) == 32

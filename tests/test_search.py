@@ -233,6 +233,47 @@ def test_fit_params_sample_weight_sliced_per_fold(clf_data):
     assert sliced["flag"] is True and sliced["arr3"].shape == (3,)
 
 
+def test_batched_sample_weight_matches_generic(clf_data):
+    """sample_weight rides the batched device path (fit-only
+    weighting, unweighted scoring) and agrees with the generic host
+    path to the BASELINE 1e-5 tolerance."""
+    from sklearn.metrics import accuracy_score, make_scorer
+
+    X, y = clf_data
+    rng = np.random.RandomState(3)
+    w = rng.uniform(0.2, 2.0, size=len(y))
+    grid = {"C": [0.1, 1.0, 10.0]}
+    batched = DistGridSearchCV(
+        LogisticRegression(max_iter=100), grid, cv=3, scoring="accuracy",
+    ).fit(X, y, sample_weight=w)
+    generic = DistGridSearchCV(
+        LogisticRegression(max_iter=100), grid, cv=3,
+        scoring=make_scorer(accuracy_score),
+    ).fit(X, y, sample_weight=w)
+    np.testing.assert_allclose(
+        batched.cv_results_["mean_test_score"],
+        generic.cv_results_["mean_test_score"], atol=1e-5,
+    )
+    # weighting has teeth on-device: zero-weighting class 2 stops the
+    # searched models from ever predicting it
+    w0 = np.where(y == 2, 0.0, 1.0)
+    gw = DistGridSearchCV(
+        LogisticRegression(max_iter=100), {"C": [1.0]}, cv=3,
+        scoring="accuracy", preds=True,
+    ).fit(X, y, sample_weight=w0)
+    assert 2 not in np.argmax(gw.preds_, axis=1)
+
+    # wrong-length weights never reach the device path: the host path's
+    # per-task error_score contract reports the failure
+    bad = DistGridSearchCV(
+        LogisticRegression(max_iter=50), {"C": [1.0]}, cv=3, refit=False,
+        scoring="accuracy", error_score=0.0,
+    )
+    with pytest.warns(Warning):
+        bad.fit(X, y, sample_weight=np.ones(7))
+    assert (bad.cv_results_["mean_test_score"] == 0.0).all()
+
+
 def test_batched_timing_is_per_round(clf_data):
     """fit_time columns on the batched path come from measured
     per-round walls, not a uniform smear (round-1 VERDICT weak-4)."""
