@@ -1,0 +1,76 @@
+"""TPU sweep for the forest histogram kernel (VERDICT item 3).
+
+Times 100 trees on the NOTES benchmark shape (20k x 54, 7 classes,
+depth 8, 32 bins) for each hist_mode, plus the sklearn multicore CPU
+reference, and prints one JSON line per configuration. Run ON the chip
+(no JAX_PLATFORMS override); if the device never answers this hangs
+like any other device program — run it under a shell timeout.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def make_data(n=20000, d=54, k=7, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, d).astype(np.float32)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.argmax(X @ W + 0.5 * rng.normal(size=(n, k)), axis=1)
+    return X, y
+
+
+def time_forest(X, y, n_estimators=100, repeats=2, **kw):
+    from skdist_tpu.models.forest import RandomForestClassifier
+
+    walls = []
+    for r in range(repeats):
+        f = RandomForestClassifier(
+            n_estimators=n_estimators, max_depth=8, n_bins=32,
+            max_features="sqrt", random_state=r, **kw,
+        )
+        t0 = time.perf_counter()
+        f.fit(X, y)
+        walls.append(time.perf_counter() - t0)
+    return walls
+
+
+def main():
+    import jax
+
+    X, y = make_data()
+    platform = jax.devices()[0].platform
+    print(f"# platform: {platform} ({jax.devices()})", flush=True)
+
+    results = []
+    for mode in ("matmul", "scatter"):
+        walls = time_forest(X, y, hist_mode=mode)
+        rec = {
+            "config": f"hist_mode={mode}",
+            "cold_s": round(walls[0], 2),
+            "warm_s": round(min(walls[1:]), 2) if len(walls) > 1 else None,
+            "platform": platform,
+        }
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    # sklearn reference (multicore CPU)
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+
+    t0 = time.perf_counter()
+    SkRF(n_estimators=100, max_depth=8, n_jobs=-1, random_state=0).fit(X, y)
+    sk_s = time.perf_counter() - t0
+    print(json.dumps({"config": "sklearn n_jobs=-1", "wall_s": round(sk_s, 2)}),
+          flush=True)
+
+    best = min(r["warm_s"] or r["cold_s"] for r in results)
+    print(json.dumps({
+        "metric": "forest 100 trees 20k x 54 (warm wall)",
+        "value": best, "unit": "s",
+        "vs_sklearn_cpu": round(sk_s / best, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

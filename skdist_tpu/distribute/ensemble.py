@@ -96,7 +96,8 @@ class DistRandomForestClassifier(_DistForestMixin, RandomForestClassifier):
                  min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, bootstrap=True, oob_score=False,
                  class_weight=None, warm_start=False,
-                 random_state=None, n_jobs=None, verbose=0):
+                 random_state=None, n_jobs=None, verbose=0,
+                 hist_mode="auto"):
         RandomForestClassifier.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
             n_bins=n_bins, max_features=max_features,
@@ -105,6 +106,7 @@ class DistRandomForestClassifier(_DistForestMixin, RandomForestClassifier):
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
             oob_score=oob_score, class_weight=class_weight,
             warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+            hist_mode=hist_mode,
         )
         self.backend = backend
         self.partitions = partitions
@@ -118,7 +120,8 @@ class DistRandomForestRegressor(_DistForestMixin, RandomForestRegressor):
                  max_depth=8, n_bins=32, max_features=1.0,
                  min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, bootstrap=True, oob_score=False,
-                 warm_start=False, random_state=None, n_jobs=None, verbose=0):
+                 warm_start=False, random_state=None, n_jobs=None, verbose=0,
+                 hist_mode="auto"):
         RandomForestRegressor.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
             n_bins=n_bins, max_features=max_features,
@@ -126,7 +129,7 @@ class DistRandomForestRegressor(_DistForestMixin, RandomForestRegressor):
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
             oob_score=oob_score, warm_start=warm_start,
-            random_state=random_state, n_jobs=n_jobs,
+            random_state=random_state, n_jobs=n_jobs, hist_mode=hist_mode,
         )
         self.backend = backend
         self.partitions = partitions
@@ -141,7 +144,8 @@ class DistExtraTreesClassifier(_DistForestMixin, ExtraTreesClassifier):
                  min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, bootstrap=False, oob_score=False,
                  class_weight=None, warm_start=False,
-                 random_state=None, n_jobs=None, verbose=0):
+                 random_state=None, n_jobs=None, verbose=0,
+                 hist_mode="auto"):
         ExtraTreesClassifier.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
             n_bins=n_bins, max_features=max_features,
@@ -150,6 +154,7 @@ class DistExtraTreesClassifier(_DistForestMixin, ExtraTreesClassifier):
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
             oob_score=oob_score, class_weight=class_weight,
             warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+            hist_mode=hist_mode,
         )
         self.backend = backend
         self.partitions = partitions
@@ -163,7 +168,8 @@ class DistExtraTreesRegressor(_DistForestMixin, ExtraTreesRegressor):
                  max_depth=8, n_bins=32, max_features=1.0,
                  min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, bootstrap=False, oob_score=False,
-                 warm_start=False, random_state=None, n_jobs=None, verbose=0):
+                 warm_start=False, random_state=None, n_jobs=None, verbose=0,
+                 hist_mode="auto"):
         ExtraTreesRegressor.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
             n_bins=n_bins, max_features=max_features,
@@ -171,7 +177,7 @@ class DistExtraTreesRegressor(_DistForestMixin, ExtraTreesRegressor):
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
             oob_score=oob_score, warm_start=warm_start,
-            random_state=random_state, n_jobs=n_jobs,
+            random_state=random_state, n_jobs=n_jobs, hist_mode=hist_mode,
         )
         self.backend = backend
         self.partitions = partitions
@@ -185,14 +191,14 @@ class DistRandomTreesEmbedding(_DistForestMixin, RandomTreesEmbedding):
                  max_depth=5, n_bins=32, min_samples_split=2,
                  min_samples_leaf=1, min_impurity_decrease=0.0,
                  sparse_output=True, warm_start=False, random_state=None,
-                 n_jobs=None, verbose=0):
+                 n_jobs=None, verbose=0, hist_mode="auto"):
         RandomTreesEmbedding.__init__(
             self, n_estimators=n_estimators, max_depth=max_depth,
             n_bins=n_bins, min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease,
             sparse_output=sparse_output, warm_start=warm_start,
-            random_state=random_state, n_jobs=n_jobs,
+            random_state=random_state, n_jobs=n_jobs, hist_mode=hist_mode,
         )
         self.backend = backend
         self.partitions = partitions

@@ -317,8 +317,12 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         X_dev, meta, aux = _binary_prep(est, X_arr)
         if meta is None:
             return None
+        from ..models.linear import maybe_exact_matmuls
+
         static = _freeze(est._static_config(meta))
-        fit_kernel = type(est)._build_fit_kernel(meta, static)
+        fit_kernel = maybe_exact_matmuls(
+            type(est), type(est)._build_fit_kernel(meta, static)
+        )
         hyper = {
             k: np.float32(getattr(est, k)) for k in type(est)._hyper_names
         }
@@ -525,8 +529,12 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
         X_dev, meta, aux = _binary_prep(est, X_arr)
         if meta is None:
             return None
+        from ..models.linear import maybe_exact_matmuls
+
         static = _freeze(est._static_config(meta))
-        fit_kernel = type(est)._build_fit_kernel(meta, static)
+        fit_kernel = maybe_exact_matmuls(
+            type(est), type(est)._build_fit_kernel(meta, static)
+        )
         hyper = {
             k_: np.float32(getattr(est, k_)) for k_ in type(est)._hyper_names
         }

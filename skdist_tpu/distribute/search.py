@@ -221,11 +221,18 @@ def _cached_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
 
 def _build_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
     """One (fold-masked fit + scores) program; vmapped by the backend."""
-    fit_kernel = est_cls._build_fit_kernel(meta, static)
-    decision_kernel = est_cls._build_decision_kernel(meta, static)
+    from ..models.linear import maybe_exact_matmuls
+
+    fit_kernel = maybe_exact_matmuls(
+        est_cls, est_cls._build_fit_kernel(meta, static)
+    )
+    decision_kernel = maybe_exact_matmuls(
+        est_cls, est_cls._build_decision_kernel(meta, static)
+    )
     needs_proba = any(kind == "proba" for *_, kind in scorer_specs)
     proba_kernel = (
-        est_cls._build_proba_kernel(meta, static) if needs_proba else None
+        maybe_exact_matmuls(est_cls, est_cls._build_proba_kernel(meta, static))
+        if needs_proba else None
     )
 
     def kernel(shared, task):
@@ -371,9 +378,25 @@ class DistBaseSearchCV(BaseEstimator):
         # (fold masks compose with it multiplicatively); anything else
         # routes to the generic host path
         sw = fit_params.get("sample_weight")
-        sw_ok = sw is None or (
-            hasattr(sw, "__len__") and len(sw) == num_samples(X)
-        )
+        sw_ok = sw is None
+        if sw is not None:
+            try:
+                sw_arr = np.asarray(sw, dtype=np.float64)
+            except (ValueError, TypeError):
+                # ragged / non-numeric weights go to the host path where
+                # the per-task error_score contract handles the failure
+                sw_arr = None
+            if sw_arr is not None:
+                # (n, 1) column weights flatten; anything else non-1-D
+                # (0-d scalars, (n, k) matrices) is not a per-sample
+                # weight vector
+                if sw_arr.ndim == 2 and sw_arr.shape[1] == 1:
+                    sw_arr = sw_arr.ravel()
+                sw_ok = (
+                    sw_arr.ndim == 1 and sw_arr.shape[0] == num_samples(X)
+                )
+                if sw_ok:
+                    sw = sw_arr
         if (not fit_params or set(fit_params) == {"sample_weight"}) and sw_ok:
             # wrong-length sample_weight stays on the host path, where
             # the per-task error_score contract handles the failure

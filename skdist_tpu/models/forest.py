@@ -109,7 +109,7 @@ def _oob_aggregator(max_depth):
 def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
                             min_samples_split, min_samples_leaf,
                             min_impurity_decrease, extra, classification,
-                            bootstrap):
+                            bootstrap, hist_mode="auto"):
     """One-tree task kernel for ``backend.batched_map``: the task is a
     scalar PRNG seed (mirroring the reference's per-tree random states,
     ensemble.py:278). The seed is stored with the tree so OOB masks
@@ -119,7 +119,7 @@ def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
         max_features=max_features, min_samples_split=min_samples_split,
         min_samples_leaf=min_samples_leaf,
         min_impurity_decrease=min_impurity_decrease, extra=extra,
-        classification=classification,
+        classification=classification, hist_mode=hist_mode,
     )
     K = channels - 1 if classification else 1
 
@@ -158,7 +158,7 @@ class _BaseForest(BaseEstimator):
                  max_features="sqrt", min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, bootstrap=True, oob_score=False,
                  class_weight=None, warm_start=False, random_state=None,
-                 n_jobs=None):
+                 n_jobs=None, hist_mode="auto"):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.n_bins = n_bins
@@ -172,6 +172,7 @@ class _BaseForest(BaseEstimator):
         self.warm_start = warm_start
         self.random_state = random_state
         self.n_jobs = n_jobs
+        self.hist_mode = hist_mode
 
     @property
     def _classification(self):
@@ -243,6 +244,7 @@ class _BaseForest(BaseEstimator):
                 min_impurity_decrease=self.min_impurity_decrease,
                 extra=self._extra, classification=self._classification,
                 bootstrap=self.bootstrap,
+                hist_mode=getattr(self, "hist_mode", "auto"),
             )
             backend, round_size = self._resolve_fit_backend()
             Xb = np.asarray(apply_bins(jnp.asarray(X), jnp.asarray(edges)))
@@ -400,14 +402,15 @@ class RandomForestRegressor(_BaseForest, _ForestRegressorMixin):
     def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
                  max_features=1.0, min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, bootstrap=True, oob_score=False,
-                 warm_start=False, random_state=None, n_jobs=None):
+                 warm_start=False, random_state=None, n_jobs=None,
+                 hist_mode="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
             max_features=max_features, min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
             oob_score=oob_score, warm_start=warm_start,
-            random_state=random_state, n_jobs=n_jobs,
+            random_state=random_state, n_jobs=n_jobs, hist_mode=hist_mode,
         )
 
 
@@ -421,7 +424,7 @@ class ExtraTreesClassifier(_BaseForest, _ForestClassifierMixin):
                  max_features="sqrt", min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, bootstrap=False, oob_score=False,
                  class_weight=None, warm_start=False, random_state=None,
-                 n_jobs=None):
+                 n_jobs=None, hist_mode="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
             max_features=max_features, min_samples_split=min_samples_split,
@@ -429,6 +432,7 @@ class ExtraTreesClassifier(_BaseForest, _ForestClassifierMixin):
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
             oob_score=oob_score, class_weight=class_weight,
             warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+            hist_mode=hist_mode,
         )
 
 
@@ -438,14 +442,15 @@ class ExtraTreesRegressor(_BaseForest, _ForestRegressorMixin):
     def __init__(self, n_estimators=100, max_depth=8, n_bins=32,
                  max_features=1.0, min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, bootstrap=False, oob_score=False,
-                 warm_start=False, random_state=None, n_jobs=None):
+                 warm_start=False, random_state=None, n_jobs=None,
+                 hist_mode="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
             max_features=max_features, min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=bootstrap,
             oob_score=oob_score, warm_start=warm_start,
-            random_state=random_state, n_jobs=n_jobs,
+            random_state=random_state, n_jobs=n_jobs, hist_mode=hist_mode,
         )
 
 
@@ -460,13 +465,15 @@ class RandomTreesEmbedding(_BaseForest, TransformerMixin):
     def __init__(self, n_estimators=100, max_depth=5, n_bins=32,
                  min_samples_split=2, min_samples_leaf=1,
                  min_impurity_decrease=0.0, sparse_output=True,
-                 warm_start=False, random_state=None, n_jobs=None):
+                 warm_start=False, random_state=None, n_jobs=None,
+                 hist_mode="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth, n_bins=n_bins,
             max_features=1.0, min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_impurity_decrease=min_impurity_decrease, bootstrap=False,
             warm_start=warm_start, random_state=random_state, n_jobs=n_jobs,
+            hist_mode=hist_mode,
         )
         self.sparse_output = sparse_output
 

@@ -363,6 +363,9 @@ class _BaseTree(BaseEstimator):
         "min_samples_leaf", "min_impurity_decrease", "splitter",
         "random_state", "hist_mode",
     )
+    # histogram matmul operands (one-hots, counts) are exact in TPU's
+    # reduced-precision passes; forcing 'highest' would only add passes
+    _exact_matmuls = False
 
     def __init__(self, max_depth=8, n_bins=32, max_features=None,
                  min_samples_split=2, min_samples_leaf=1,

@@ -422,3 +422,19 @@ def test_hist_matmul_matches_scatter(clf_data, reg_data):
     np.testing.assert_array_equal(t_sc["thr"], t_mm["thr"])
     np.testing.assert_array_equal(t_sc["is_split"], t_mm["is_split"])
     np.testing.assert_allclose(t_sc["leaf"], t_mm["leaf"], atol=1e-5)
+
+
+def test_hist_mode_reaches_kernel_through_dist_wrappers(clf_data):
+    """hist_mode plumbs from the Dist* constructors down to
+    build_tree_kernel: both modes fit through the distributed wrapper
+    and produce identical trees for identical seeds (the structural
+    parity of test_hist_matmul_matches_scatter, end-to-end)."""
+    X, y = clf_data
+    preds = {}
+    for hm in ("scatter", "matmul"):
+        f = DistRandomForestClassifier(
+            n_estimators=4, max_depth=4, random_state=7, hist_mode=hm,
+        )
+        assert f.get_params()["hist_mode"] == hm
+        preds[hm] = f.fit(X, y).predict_proba(X)
+    np.testing.assert_allclose(preds["scatter"], preds["matmul"], atol=1e-6)

@@ -468,3 +468,58 @@ def test_verbose_prints(clf_data, capsys):
     out = capsys.readouterr().out
     assert "local backend" in out
     assert "Fitting 2 folds" in out
+
+
+def test_sample_weight_shape_routing(clf_data):
+    """Non-1-D sample_weight shapes route correctly (round-2 review):
+    (n,1) columns flatten onto the batched path; 0-d and ragged weights
+    fall to the host path where error_score applies instead of crashing
+    the dispatch guard."""
+    X, y = clf_data
+    rng = np.random.RandomState(5)
+    w = rng.uniform(0.2, 2.0, size=len(y))
+    grid = {"C": [0.1, 1.0]}
+    flat = DistGridSearchCV(
+        LogisticRegression(max_iter=60), grid, cv=3, scoring="accuracy",
+    ).fit(X, y, sample_weight=w)
+    col = DistGridSearchCV(
+        LogisticRegression(max_iter=60), grid, cv=3, scoring="accuracy",
+    ).fit(X, y, sample_weight=w.reshape(-1, 1))
+    np.testing.assert_allclose(
+        col.cv_results_["mean_test_score"],
+        flat.cv_results_["mean_test_score"], atol=1e-7,
+    )
+
+    # 0-d weight: guard must not crash (len() of unsized object); the
+    # host path runs and the estimator broadcasts the scalar — a valid fit
+    zd = DistGridSearchCV(
+        LogisticRegression(max_iter=30), {"C": [1.0]}, cv=3,
+        refit=False, scoring="accuracy",
+    ).fit(X, y, sample_weight=np.asarray(2.0))
+    assert np.isfinite(zd.cv_results_["mean_test_score"]).all()
+
+    # ragged weights: guard must not crash at dispatch; the host path's
+    # per-task error_score contract reports the failure
+    bad = DistGridSearchCV(
+        LogisticRegression(max_iter=30), {"C": [1.0]}, cv=3,
+        refit=False, scoring="accuracy", error_score=0.0,
+    )
+    with pytest.warns(Warning):
+        bad.fit(X, y, sample_weight=[[1.0], [2.0, 3.0]] * (len(y) // 2))
+    assert (bad.cv_results_["mean_test_score"] == 0.0).all()
+
+
+def test_exact_matmuls_flag_honoured():
+    """Linear kernels trace under 'highest' matmul precision (the
+    batched-vs-generic ≤1e-5 parity contract on TPU); tree kernels opt
+    out via _exact_matmuls=False at every consumer site."""
+    from skdist_tpu.models import DecisionTreeClassifier
+    from skdist_tpu.models.linear import maybe_exact_matmuls
+
+    assert getattr(LogisticRegression, "_exact_matmuls", True) is True
+    assert DecisionTreeClassifier._exact_matmuls is False
+
+    marker = lambda: None
+    assert maybe_exact_matmuls(DecisionTreeClassifier, marker) is marker
+    wrapped = maybe_exact_matmuls(LogisticRegression, marker)
+    assert wrapped is not marker and wrapped.__wrapped__ is marker
