@@ -128,6 +128,38 @@ def run_bench(platform, quick=False):
     }), flush=True)
 
 
+def _run_phase_child(phase, platform, timeout):
+    """Run one bench phase in a child process with a hard timeout.
+
+    The axon tunnel can wedge MID-RUN (observed round 2: the probe
+    answered, the quick phase completed, then a device call blocked
+    forever) — and a blocked device op is uninterruptible in-process,
+    so only process isolation turns "hang until the driver's rc=124"
+    into "lose one phase, keep every line already printed". The child
+    inherits stdout, so its JSON line lands the moment it prints.
+
+    Returns ``"ok"``, ``"timeout"`` (wedge — the device is gone for
+    this round), or ``"error"`` (the child crashed quickly; the device
+    may be fine and the failure is a real bug worth distinguishing
+    from a wedge in the driver artifact).
+    """
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, __file__, "--phase", phase, "--platform", platform]
+    )
+    try:
+        return "ok" if proc.wait(timeout=timeout) == 0 else "error"
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return "timeout"
+
+
 def main(quick=False):
     """Driver-safe entry.
 
@@ -137,24 +169,57 @@ def main(quick=False):
 
     - probe the device with a short timeout;
     - when the device is NOT answering (cpu / cpu-fallback), run ONLY
-      the quick shapes, marked ``"quick": true`` in the JSON, and stop —
-      a number is always emitted;
-    - when the device IS answering, emit the quick JSON line first (a
-      floor in case the tunnel drops mid-run), then the full-size line.
+      the quick shapes in-process (CPU cannot wedge), marked
+      ``"quick": true`` in the JSON, and stop — a number is always
+      emitted;
+    - when the device IS answering, every device-touching phase —
+      quick (also under ``--quick``) and full-size — runs in a CHILD
+      process with a hard timeout (see :func:`_run_phase_child`): a
+      mid-run tunnel wedge loses at most the current phase, and the
+      parent still exits 0 with every completed phase's JSON line on
+      stdout. If the quick phase itself dies, a forced-CPU quick line
+      is emitted as the floor, labelled ``"<name>-wedged-midrun"``
+      (timeout) or ``"<name>-quick-crashed"`` (fast nonzero exit —
+      the device may be fine, the bug signal is preserved); only a
+      wedge skips the full-size attempt.
     """
     from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
 
     platform = probe_platform_or_cpu(timeout=60)
     on_accelerator = platform not in ("cpu", "cpu-fallback")
 
-    if quick or not on_accelerator:
-        run_bench(platform, quick=True)
+    if not on_accelerator:
+        run_bench(platform, quick=True)  # CPU cannot wedge: in-process
         return
-    run_bench(platform, quick=True)
-    run_bench(platform, quick=False)
+    # every device-touching phase runs in a child — including --quick,
+    # whose in-process form would re-introduce the unprotected hang
+    status = _run_phase_child("quick", platform, timeout=300)
+    if status != "ok":
+        # device answered the probe but the phase died: emit the
+        # always-possible CPU floor so the driver artifact is never
+        # empty, labelling wedge vs crash distinctly
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        label = "wedged-midrun" if status == "timeout" else "quick-crashed"
+        run_bench(f"{platform}-{label}", quick=True)
+        if status == "timeout":  # the device is gone; don't queue more
+            return
+    if not quick:
+        _run_phase_child("full", platform, timeout=1200)
+
+
+def _phase_main(argv):
+    """Child entry: run exactly one phase on the probed platform."""
+    phase = argv[argv.index("--phase") + 1]
+    platform = argv[argv.index("--platform") + 1]
+    run_bench(platform, quick=(phase == "quick"))
 
 
 if __name__ == "__main__":
     import sys
 
-    main(quick="--quick" in sys.argv)
+    if "--phase" in sys.argv:
+        _phase_main(sys.argv)
+    else:
+        main(quick="--quick" in sys.argv)

@@ -69,9 +69,26 @@ def encode_labels(y):
 
 
 def prepare_sample_weight(sample_weight, n):
+    """Normalise user weights to a (n,) f32 vector.
+
+    Accepts scalars (broadcast), (n,) vectors, and (n, 1) columns
+    (flattened — a 2-D column would otherwise broadcast against the
+    (n,) per-sample loss into an (n, n) matrix and silently corrupt
+    the fit). Anything else is rejected loudly.
+    """
     if sample_weight is None:
         return np.ones(n, dtype=np.float32)
-    return np.asarray(sample_weight, dtype=np.float32)
+    sw = np.asarray(sample_weight, dtype=np.float32)
+    if sw.ndim == 0:
+        return np.full(n, float(sw), dtype=np.float32)
+    if sw.ndim == 2 and sw.shape[1] == 1:
+        sw = sw.ravel()
+    if sw.shape != (n,):
+        raise ValueError(
+            f"sample_weight has shape {np.shape(sample_weight)}; expected "
+            f"({n},), ({n}, 1) or a scalar"
+        )
+    return sw
 
 
 def class_weight_vector(class_weight, classes):
