@@ -135,29 +135,40 @@ def _run_phase_child(phase, platform, timeout):
     answered, the quick phase completed, then a device call blocked
     forever) — and a blocked device op is uninterruptible in-process,
     so only process isolation turns "hang until the driver's rc=124"
-    into "lose one phase, keep every line already printed". The child
-    inherits stdout, so its JSON line lands the moment it prints.
+    into "lose one phase, keep every line already printed". The child's
+    stdout is piped and relayed when the phase ends (or is killed), so
+    the parent knows whether a JSON line actually landed.
 
-    Returns ``"ok"``, ``"timeout"`` (wedge — the device is gone for
-    this round), or ``"error"`` (the child crashed quickly; the device
-    may be fine and the failure is a real bug worth distinguishing
-    from a wedge in the driver artifact).
+    Returns ``(status, emitted)``: status is ``"ok"``, ``"timeout"``
+    (wedge — the device is gone for this round), or ``"error"`` (the
+    child crashed quickly; the device may be fine and the failure is a
+    real bug worth distinguishing from a wedge in the driver
+    artifact); ``emitted`` is True when at least one JSON result line
+    reached stdout — a crash *after* a successful measurement must not
+    cause that measurement to be superseded by a CPU floor.
     """
     import subprocess
     import sys
 
     proc = subprocess.Popen(
-        [sys.executable, __file__, "--phase", phase, "--platform", platform]
+        [sys.executable, __file__, "--phase", phase, "--platform", platform],
+        stdout=subprocess.PIPE, text=True,
     )
     try:
-        return "ok" if proc.wait(timeout=timeout) == 0 else "error"
+        out, _ = proc.communicate(timeout=timeout)
+        status = "ok" if proc.returncode == 0 else "error"
     except subprocess.TimeoutExpired:
         proc.kill()
         try:
-            proc.wait(timeout=10)
+            out, _ = proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
-            pass
-        return "timeout"
+            out = ""
+        status = "timeout"
+    if out:
+        sys.stdout.write(out)
+        sys.stdout.flush()
+    emitted = any(ln.startswith("{") for ln in (out or "").splitlines())
+    return status, emitted
 
 
 def main(quick=False):
@@ -188,25 +199,37 @@ def main(quick=False):
     platform = probe_platform_or_cpu(timeout=60)
     on_accelerator = platform not in ("cpu", "cpu-fallback")
 
+    import sys
+
     if not on_accelerator:
         run_bench(platform, quick=True)  # CPU cannot wedge: in-process
         return
     # every device-touching phase runs in a child — including --quick,
     # whose in-process form would re-introduce the unprotected hang
-    status = _run_phase_child("quick", platform, timeout=300)
+    status, emitted = _run_phase_child("quick", platform, timeout=300)
     if status != "ok":
-        # device answered the probe but the phase died: emit the
-        # always-possible CPU floor so the driver artifact is never
-        # empty, labelling wedge vs crash distinctly
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
         label = "wedged-midrun" if status == "timeout" else "quick-crashed"
-        run_bench(f"{platform}-{label}", quick=True)
+        if not emitted:
+            # the phase died before measuring anything: emit the
+            # always-possible CPU floor so the artifact is never empty
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            run_bench(f"{platform}-{label}", quick=True)
+        else:
+            # a device measurement already landed; record the failure
+            # without superseding it as the last JSON line
+            print(f"[bench] quick phase {label} after emitting its "
+                  "result; keeping the device line as the headline",
+                  file=sys.stderr)
         if status == "timeout":  # the device is gone; don't queue more
             return
     if not quick:
-        _run_phase_child("full", platform, timeout=1200)
+        status, _ = _run_phase_child("full", platform, timeout=1200)
+        if status != "ok":
+            print(f"[bench] full-size phase {status}; the headline "
+                  "remains the last emitted (quick) line",
+                  file=sys.stderr)
 
 
 def _phase_main(argv):

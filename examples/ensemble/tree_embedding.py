@@ -1,0 +1,71 @@
+"""
+Tree-embedding feature transformation on circles data (counterpart of
+the reference's examples/ensemble/tree_embedding.py, which reported
+BernoulliNB 0.4965 raw → 0.9734 transformed and ExtraTrees 0.9470 raw
+→ 0.9837 transformed on make_circles).
+
+DistRandomTreesEmbedding fits extra-random regression trees on uniform
+random targets — all trees one vmapped XLA program — and one-hot
+encodes each sample's leaf per tree. A linearly-inseparable problem
+(concentric circles) becomes nearly separable in leaf space: naive
+Bayes goes from coin-flip to ~0.97.
+
+Sample output (CPU backend):
+    Naive Bayes -- Transformed: 0.9439
+    Naive Bayes -- Original:    0.4987
+    Extra Trees -- Transformed: 0.9412
+    Extra Trees -- Original:    0.9423
+
+Run: python examples/ensemble/tree_embedding.py
+"""
+
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# wedged-accelerator guard: use the TPU when it answers, else pin CPU
+from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
+
+probe_platform_or_cpu()
+import numpy as np
+from sklearn.datasets import make_circles
+from sklearn.model_selection import cross_val_score
+from sklearn.naive_bayes import BernoulliNB
+
+from sklearn.ensemble import ExtraTreesClassifier
+
+from skdist_tpu.distribute.ensemble import DistRandomTreesEmbedding
+
+
+def main():
+    X, y = make_circles(
+        n_samples=10000, factor=0.5, random_state=0, noise=0.15
+    )
+    X = X.astype(np.float32)
+
+    emb = DistRandomTreesEmbedding(
+        n_estimators=50, max_depth=5, random_state=0
+    )
+    X_t = emb.fit_transform(X).toarray().astype(np.float32)
+
+    nb_t = cross_val_score(BernoulliNB(), X_t, y, cv=3).mean()
+    nb_o = cross_val_score(BernoulliNB(), X, y, cv=3).mean()
+    print(f"Naive Bayes -- Transformed: {nb_t:.4f}")
+    print(f"Naive Bayes -- Original:    {nb_o:.4f}")
+
+    def ert_score(data):
+        # scoring models are plain sklearn, as in the reference — the
+        # featured component here is the distributed embedding itself
+        clf = ExtraTreesClassifier(
+            n_estimators=100, max_depth=None, random_state=0, n_jobs=-1
+        )
+        return float(cross_val_score(clf, data, y, cv=3).mean())
+
+    print(f"Extra Trees -- Transformed: {ert_score(X_t):.4f}")
+    print(f"Extra Trees -- Original:    {ert_score(X):.4f}")
+
+
+if __name__ == "__main__":
+    main()

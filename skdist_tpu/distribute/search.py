@@ -654,6 +654,11 @@ class DistBaseSearchCV(BaseEstimator):
         check_is_fitted(self, "best_estimator_")
         return self.best_estimator_.transform(X)
 
+    def inverse_transform(self, Xt):
+        self._check_refit("inverse_transform")
+        check_is_fitted(self, "best_estimator_")
+        return self.best_estimator_.inverse_transform(Xt)
+
     def score(self, X, y=None):
         check_is_fitted(self, "best_estimator_")
         if self.scorer_ is None:

@@ -523,3 +523,16 @@ def test_exact_matmuls_flag_honoured():
     assert maybe_exact_matmuls(DecisionTreeClassifier, marker) is marker
     wrapped = maybe_exact_matmuls(LogisticRegression, marker)
     assert wrapped is not marker and wrapped.__wrapped__ is marker
+
+
+def test_transform_inverse_transform_delegation():
+    """Fitted search delegates transform/inverse_transform to the
+    refit best_estimator_ (reference delegation block, search.py:875-908),
+    including the unsupervised y=None path."""
+    from sklearn.decomposition import PCA
+
+    X = np.random.RandomState(0).normal(size=(100, 6))
+    gs = DistGridSearchCV(PCA(), {"n_components": [2, 3]}, cv=3).fit(X)
+    Xt = gs.transform(X)
+    assert Xt.shape == (100, gs.best_params_["n_components"])
+    assert gs.inverse_transform(Xt).shape == X.shape
