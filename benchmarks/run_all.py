@@ -1,0 +1,285 @@
+"""
+The five BASELINE.json configs, shape-faithful and zero-egress.
+
+Each config prints one JSON line: `{"config": ..., "value": ...,
+"unit": ..., ...}` with cold/warm walls and, where cheap, an sklearn
+reference engine time. Real datasets are not fetchable here, so every
+workload matches the named dataset's shape:
+
+1. DistGridSearchCV(LogisticRegression) on 20news shape (11314x4096,
+   20 classes, 96 C's x 5 folds) — also bench.py's headline.
+2. DistRandomizedSearchCV(SGDClassifier) on covtype shape
+   (n x 54, 7 classes), n_iter=60, 5 folds.
+3. DistOneVsRestClassifier(LinearSVC) on 20news shape, 20 classes.
+4. DistRandomForestClassifier(n_estimators=256) on a HIGGS-shaped
+   subset (n x 28, binary).
+5. batch_predict predict_proba over 1M rows (the pandas-UDF analogue).
+
+Usage:
+    python benchmarks/run_all.py [--scale 0.05] [--config N] [--ref]
+
+--scale shrinks row counts (CPU smoke: --scale 0.02); --ref also times
+the sklearn/joblib engine on the same workload.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _emit(payload):
+    print(json.dumps(payload), flush=True)
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    out = fn()
+    return time.perf_counter() - t0, out
+
+
+def _platform():
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _text_width(scale):
+    """Feature width for the text-shaped configs. Row scaling alone
+    keeps the faithful d=4096; only deep smoke scales (< 0.2) shrink
+    the feature dimension too, with a loud notice — a silently
+    changed d would make fits/sec incomparable to BASELINE."""
+    if scale >= 0.2:
+        return 4096
+    print("[run_all] smoke scale: text feature width reduced to 512 "
+          "(results not comparable to BASELINE shapes)", file=sys.stderr)
+    return 512
+
+
+def make_tabular(n, d, k, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, d).astype(np.float32)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.argmax(X @ W + 0.7 * rng.normal(size=(n, k)), axis=1)
+    return X, y
+
+
+def config_1_gridsearch(scale, ref):
+    from bench import make_20news_shaped
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend
+
+    n = max(500, int(11314 * scale))
+    d = _text_width(scale)
+    X, y = make_20news_shaped(n=n, d=d, k=20)
+    grid = {"C": list(np.logspace(-3, 2, 96))}
+
+    def run():
+        return DistGridSearchCV(
+            LogisticRegression(max_iter=30, tol=1e-4), grid,
+            backend=TPUBackend(), cv=5, scoring="accuracy",
+        ).fit(X, y)
+
+    cold, _ = _timed(run)
+    warm, gs = _timed(run)
+    out = {
+        "config": "1: GridSearchCV LogReg 20news-shaped 96x5",
+        "shape": [n, d, 20], "cold_s": round(cold, 2),
+        "warm_s": round(warm, 2),
+        "value": round(480 / warm, 2), "unit": "fits/sec",
+        "best_score": float(gs.best_score_), "platform": _platform(),
+    }
+    if ref:
+        from sklearn.linear_model import LogisticRegression as SkLR
+        from sklearn.model_selection import GridSearchCV
+
+        sk_s, _ = _timed(lambda: GridSearchCV(
+            SkLR(max_iter=30, tol=1e-4), {"C": grid["C"][:8]}, cv=5,
+            n_jobs=-1,
+        ).fit(X, y))
+        # scale the 8-candidate joblib run up to the 96-candidate grid
+        out["sklearn_joblib_est_s"] = round(sk_s * 96 / 8, 1)
+    _emit(out)
+
+
+def config_2_randomized_sgd(scale, ref):
+    from skdist_tpu.distribute.search import DistRandomizedSearchCV
+    from skdist_tpu.models import SGDClassifier
+    from skdist_tpu.parallel import TPUBackend
+
+    n = max(2000, int(100_000 * scale))
+    X, y = make_tabular(n, 54, 7, seed=1)
+    dists = {"alpha": list(np.logspace(-6, -2, 60))}
+
+    def run():
+        return DistRandomizedSearchCV(
+            SGDClassifier(max_iter=20, random_state=0), dists, n_iter=60,
+            backend=TPUBackend(), cv=5, scoring="accuracy", random_state=0,
+        ).fit(X, y)
+
+    cold, _ = _timed(run)
+    warm, rs = _timed(run)
+    out = {
+        "config": "2: RandomizedSearchCV SGD covtype-shaped n_iter=60",
+        "shape": [n, 54, 7], "cold_s": round(cold, 2),
+        "warm_s": round(warm, 2),
+        "value": round(300 / warm, 2), "unit": "fits/sec",
+        "best_score": float(rs.best_score_), "platform": _platform(),
+    }
+    if ref:
+        from sklearn.linear_model import SGDClassifier as SkSGD
+        from sklearn.model_selection import RandomizedSearchCV
+
+        sk_s, _ = _timed(lambda: RandomizedSearchCV(
+            SkSGD(max_iter=20, random_state=0), dists, n_iter=10, cv=5,
+            n_jobs=-1, random_state=0,
+        ).fit(X, y))
+        out["sklearn_joblib_est_s"] = round(sk_s * 60 / 10, 1)
+    _emit(out)
+
+
+def config_3_ovr_svc(scale, ref):
+    from bench import make_20news_shaped
+    from skdist_tpu.distribute.multiclass import DistOneVsRestClassifier
+    from skdist_tpu.models import LinearSVC
+    from skdist_tpu.parallel import TPUBackend
+
+    n = max(500, int(11314 * scale))
+    d = _text_width(scale)
+    X, y = make_20news_shaped(n=n, d=d, k=20)
+
+    def run():
+        return DistOneVsRestClassifier(
+            LinearSVC(C=1.0, max_iter=100), backend=TPUBackend(),
+        ).fit(X, y)
+
+    cold, _ = _timed(run)
+    warm, ovr = _timed(run)
+    acc = float(np.mean(ovr.predict(X) == y))
+    out = {
+        "config": "3: OneVsRest LinearSVC 20news-shaped 20-class",
+        "shape": [n, d, 20], "cold_s": round(cold, 2),
+        "warm_s": round(warm, 2),
+        "value": round(20 / warm, 2), "unit": "binary fits/sec",
+        "train_acc": acc, "platform": _platform(),
+    }
+    if ref:
+        from sklearn.multiclass import OneVsRestClassifier
+        from sklearn.svm import LinearSVC as SkSVC
+
+        # iteration budget matched to the estimator under test
+        sk_s, _ = _timed(lambda: OneVsRestClassifier(
+            SkSVC(C=1.0, max_iter=100), n_jobs=-1,
+        ).fit(X, y))
+        out["sklearn_joblib_s"] = round(sk_s, 1)
+    _emit(out)
+
+
+def config_4_forest(scale, ref):
+    from skdist_tpu.distribute.ensemble import DistRandomForestClassifier
+    from skdist_tpu.parallel import TPUBackend
+
+    n = max(2000, int(200_000 * scale))
+    X, y = make_tabular(n, 28, 2, seed=2)
+
+    def run():
+        return DistRandomForestClassifier(
+            n_estimators=256, max_depth=8, random_state=0,
+            backend=TPUBackend(),
+        ).fit(X, y)
+
+    cold, _ = _timed(run)
+    warm, rf = _timed(run)
+    acc = float(np.mean(rf.predict(X) == y))
+    out = {
+        "config": "4: RandomForest 256 trees HIGGS-shaped",
+        "shape": [n, 28, 2], "cold_s": round(cold, 2),
+        "warm_s": round(warm, 2),
+        "value": round(256 / warm, 2), "unit": "trees/sec",
+        "train_acc": acc, "platform": _platform(),
+    }
+    if ref:
+        from sklearn.ensemble import RandomForestClassifier as SkRF
+
+        sk_s, _ = _timed(lambda: SkRF(
+            n_estimators=256, max_depth=8, n_jobs=-1, random_state=0,
+        ).fit(X, y))
+        out["sklearn_joblib_s"] = round(sk_s, 1)
+    _emit(out)
+
+
+def config_5_batch_predict(scale, ref):
+    from skdist_tpu.distribute.predict import batch_predict
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend
+
+    n_train = 5000
+    n_score = max(10_000, int(1_000_000 * scale))
+    X, y = make_tabular(n_train, 64, 10, seed=3)
+    model = LogisticRegression(max_iter=40).fit(X, y)
+    Xs = np.random.RandomState(4).rand(n_score, 64).astype(np.float32)
+
+    def run():
+        return batch_predict(
+            model, Xs, method="predict_proba", backend=TPUBackend(),
+        )
+
+    cold, _ = _timed(run)
+    warm, proba = _timed(run)
+    out = {
+        "config": "5: batch predict_proba 1M-row-shaped",
+        "rows": n_score, "cold_s": round(cold, 2),
+        "warm_s": round(warm, 3),
+        "value": round(n_score / warm), "unit": "rows/sec",
+        "proba_shape": list(proba.shape), "platform": _platform(),
+    }
+    if ref:
+        from sklearn.linear_model import LogisticRegression as SkLR
+
+        sk = SkLR(max_iter=40).fit(X, y)
+        sk_s, _ = _timed(lambda: sk.predict_proba(Xs))
+        out["sklearn_s"] = round(sk_s, 3)
+    _emit(out)
+
+
+CONFIGS = {
+    1: config_1_gridsearch,
+    2: config_2_randomized_sgd,
+    3: config_3_ovr_svc,
+    4: config_4_forest,
+    5: config_5_batch_predict,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="row-count multiplier (use ~0.02 for CPU smoke)")
+    ap.add_argument("--config", type=int, default=None,
+                    help="run one config (1-5) instead of all")
+    ap.add_argument("--ref", action="store_true",
+                    help="also time the sklearn/joblib engine")
+    args = ap.parse_args()
+
+    # Startup guard only: a wedged tunnel at launch falls back to CPU
+    # instead of hanging. Unlike bench.py this script does NOT isolate
+    # each config in a child process — a MID-suite wedge blocks until
+    # an external timeout, so on a flaky tunnel run it under `timeout`
+    # (build_tools/tpu_watch.sh does, and re-probes between steps).
+    from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
+
+    probe_platform_or_cpu()
+
+    todo = [args.config] if args.config else sorted(CONFIGS)
+    for idx in todo:
+        CONFIGS[idx](args.scale, args.ref)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""bf16 L-BFGS experiment on the headline workload (NOTES gap 3).
+
+Times the full 96x5 grid search with matmul_dtype=None (exact f32
+matmuls) vs 'bfloat16' (bf16 operands, f32 accumulation) and reports
+the cv_results_ deviation of bf16 from exact. Run ON the chip under a
+shell timeout; prints one JSON line per configuration.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from bench import make_20news_shaped
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend
+
+    platform = jax.devices()[0].platform
+    X, y = make_20news_shaped()
+    grid = {"C": list(np.logspace(-3, 2, 96))}
+
+    results = {}
+    for md in (None, "bfloat16"):
+        est = LogisticRegression(max_iter=30, tol=1e-4, matmul_dtype=md)
+
+        def run():
+            t0 = time.perf_counter()
+            gs = DistGridSearchCV(
+                est, grid, backend=TPUBackend(), cv=5, scoring="accuracy",
+            ).fit(X, y)
+            return time.perf_counter() - t0, gs
+
+        cold, _ = run()
+        warm, gs = run()
+        results[md] = gs
+        print(json.dumps({
+            "config": f"matmul_dtype={md}",
+            "cold_s": round(cold, 2), "warm_s": round(warm, 2),
+            "fits_per_sec": round(480 / warm, 2),
+            "best_score": float(gs.best_score_),
+            "platform": platform,
+        }), flush=True)
+
+    dev = float(np.max(np.abs(
+        results[None].cv_results_["mean_test_score"]
+        - results["bfloat16"].cv_results_["mean_test_score"]
+    )))
+    print(json.dumps({
+        "metric": "bf16 vs exact cv_results_ max deviation",
+        "value": dev,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Watch for the axon TPU tunnel to answer, then capture every pending
+# hardware measurement in one session (the tunnel's uptime windows are
+# short — round 2 got ~35 min). Logs land in build_tools/logs/.
+#
+# Usage: bash build_tools/tpu_watch.sh [max_minutes]
+
+cd "$(dirname "$0")/.."
+LOGDIR="build_tools/logs/$(date -u +%Y%m%dT%H%M%S)"
+mkdir -p "$LOGDIR"
+MAX_MIN=${1:-480}
+DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
+
+probe() {
+  timeout 45 python -c "
+import jax, jax.numpy as jnp
+(jnp.ones((256,256)) @ jnp.ones((256,256))).block_until_ready()
+assert jax.default_backend() not in ('cpu',)
+" 2>/dev/null
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if probe; then
+    echo "[tpu_watch] tunnel answered at $(date -u +%H:%M:%S); capturing to $LOGDIR"
+    timeout 1500 python build_tools/tpu_tree_sweep.py \
+      > "$LOGDIR/tree_sweep.log" 2>&1
+    echo "[tpu_watch] tree sweep rc=$? ($(date -u +%H:%M:%S))"
+    # re-probe before every further step: a wedge mid-capture must not
+    # burn the remaining timeouts or record CPU-fallback numbers as
+    # hardware measurements — go back to waiting instead
+    probe || { echo "[tpu_watch] tunnel wedged after tree sweep"; continue; }
+    timeout 1800 python bench.py > "$LOGDIR/bench_full.log" 2>&1
+    echo "[tpu_watch] bench rc=$? ($(date -u +%H:%M:%S))"
+    probe || { echo "[tpu_watch] tunnel wedged after bench"; continue; }
+    timeout 1800 python build_tools/tpu_bf16_check.py \
+      > "$LOGDIR/bf16_check.log" 2>&1
+    echo "[tpu_watch] bf16 check rc=$? ($(date -u +%H:%M:%S))"
+    probe || { echo "[tpu_watch] tunnel wedged after bf16 check"; continue; }
+    timeout 2400 python benchmarks/run_all.py --ref \
+      > "$LOGDIR/baseline_suite.log" 2>&1
+    echo "[tpu_watch] baseline suite rc=$? ($(date -u +%H:%M:%S))"
+    exit 0
+  fi
+  sleep 120
+done
+echo "[tpu_watch] deadline reached without a live tunnel"
+exit 1
