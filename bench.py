@@ -51,6 +51,16 @@ def make_20news_shaped(seed=0, n=11314, d=4096, k=20):
     return X, y
 
 
+def make_tabular(n, d, k, seed=0, noise=0.7):
+    """Covtype/HIGGS-style synthetic tabular problem — the shared
+    generator for benchmarks/run_all.py and build_tools sweeps."""
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, d).astype(np.float32)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.argmax(X @ W + noise * rng.normal(size=(n, k)), axis=1)
+    return X, y
+
+
 def run_bench(platform, quick=False):
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression

@@ -61,12 +61,7 @@ def _text_width(scale):
     return 512
 
 
-def make_tabular(n, d, k, seed=0):
-    rng = np.random.RandomState(seed)
-    X = rng.rand(n, d).astype(np.float32)
-    W = rng.normal(size=(d, k)).astype(np.float32)
-    y = np.argmax(X @ W + 0.7 * rng.normal(size=(n, k)), axis=1)
-    return X, y
+from bench import make_tabular  # shared synthetic tabular generator
 
 
 def config_1_gridsearch(scale, ref):

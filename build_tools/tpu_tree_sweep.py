@@ -8,17 +8,21 @@ like any other device program — run it under a shell timeout.
 """
 
 import json
+import os
 import time
 
 import numpy as np
 
 
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
 def make_data(n=20000, d=54, k=7, seed=0):
-    rng = np.random.RandomState(seed)
-    X = rng.rand(n, d).astype(np.float32)
-    W = rng.normal(size=(d, k)).astype(np.float32)
-    y = np.argmax(X @ W + 0.5 * rng.normal(size=(n, k)), axis=1)
-    return X, y
+    from bench import make_tabular
+
+    return make_tabular(n, d, k, seed=seed, noise=0.5)
 
 
 def time_forest(X, y, n_estimators=100, repeats=2, **kw):
@@ -44,7 +48,7 @@ def main():
     print(f"# platform: {platform} ({jax.devices()})", flush=True)
 
     results = []
-    for mode in ("matmul", "scatter"):
+    for mode in ("matmul", "pallas", "scatter"):
         walls = time_forest(X, y, hist_mode=mode)
         rec = {
             "config": f"hist_mode={mode}",

@@ -1,7 +1,10 @@
 #!/bin/bash
 # Watch for the axon TPU tunnel to answer, then capture every pending
-# hardware measurement in one session (the tunnel's uptime windows are
-# short — round 2 got ~35 min). Logs land in build_tools/logs/.
+# hardware measurement (the tunnel's uptime windows are short — round 2
+# got ~35 min). Logs land in a timestamped dir under build_tools/logs/.
+# Completed steps are marked with .done files, so a mid-capture wedge
+# resumes from the first UNfinished step on the next uptime window
+# instead of re-burning it on measurements already taken.
 #
 # Usage: bash build_tools/tpu_watch.sh [max_minutes]
 
@@ -19,29 +22,31 @@ assert jax.default_backend() not in ('cpu',)
 " 2>/dev/null
 }
 
+# run_step <name> <timeout_s> <cmd...>: skip if already done; re-probe
+# first so a wedge sends us back to waiting rather than burning the
+# timeout or recording CPU-fallback numbers as hardware measurements.
+run_step() {
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOGDIR/.${name}.done" ] && return 0
+  probe || { echo "[tpu_watch] tunnel not answering before $name"; return 1; }
+  timeout "$tmo" "$@" > "$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  echo "[tpu_watch] $name rc=$rc ($(date -u +%H:%M:%S))"
+  [ $rc -eq 0 ] && touch "$LOGDIR/.${name}.done"
+  return $rc
+}
+
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     echo "[tpu_watch] tunnel answered at $(date -u +%H:%M:%S); capturing to $LOGDIR"
-    timeout 1500 python build_tools/tpu_tree_sweep.py \
-      > "$LOGDIR/tree_sweep.log" 2>&1
-    echo "[tpu_watch] tree sweep rc=$? ($(date -u +%H:%M:%S))"
-    # re-probe before every further step: a wedge mid-capture must not
-    # burn the remaining timeouts or record CPU-fallback numbers as
-    # hardware measurements — go back to waiting instead
-    probe || { echo "[tpu_watch] tunnel wedged after tree sweep"; continue; }
-    timeout 1800 python bench.py > "$LOGDIR/bench_full.log" 2>&1
-    echo "[tpu_watch] bench rc=$? ($(date -u +%H:%M:%S))"
-    probe || { echo "[tpu_watch] tunnel wedged after bench"; continue; }
-    timeout 1800 python build_tools/tpu_bf16_check.py \
-      > "$LOGDIR/bf16_check.log" 2>&1
-    echo "[tpu_watch] bf16 check rc=$? ($(date -u +%H:%M:%S))"
-    probe || { echo "[tpu_watch] tunnel wedged after bf16 check"; continue; }
-    timeout 2400 python benchmarks/run_all.py --ref \
-      > "$LOGDIR/baseline_suite.log" 2>&1
-    echo "[tpu_watch] baseline suite rc=$? ($(date -u +%H:%M:%S))"
+    run_step tree_sweep 1500 python build_tools/tpu_tree_sweep.py || { sleep 60; continue; }
+    run_step bench_full 1800 python bench.py || { sleep 60; continue; }
+    run_step bf16_check 1800 python build_tools/tpu_bf16_check.py || { sleep 60; continue; }
+    run_step baseline_suite 2400 python benchmarks/run_all.py --ref || { sleep 60; continue; }
+    echo "[tpu_watch] all captures complete"
     exit 0
   fi
   sleep 120
 done
-echo "[tpu_watch] deadline reached without a live tunnel"
+echo "[tpu_watch] deadline reached without completing all captures"
 exit 1

@@ -89,6 +89,12 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
       hardware is built for, displacing the scatter that round-1
       measured as the forest bottleneck (42s vs sklearn's 7.4s per 100
       trees on 20k×54). f32 accumulation, exact 0/1 one-hots.
+    - ``"pallas"``: the same contraction as ``"matmul"`` executed by a
+      Pallas TPU kernel (``ops/pallas_hist.py``) that builds both
+      one-hot factors on the fly in VMEM — nothing of size (n, d·B) or
+      (n, nl·C) is ever materialised in HBM. Off-TPU it runs through
+      the Pallas interpreter (correct but slow; tests only). The
+      compiled path assumes ``n_bins >= 8`` (TPU sublane tiling).
     - ``"auto"``: matmul on accelerators, scatter on CPU.
     """
     d, B, C, D = n_features, n_bins, channels, max_depth
@@ -102,10 +108,15 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
             if jax.default_backend() != "cpu" and d * B <= 16384
             else "scatter"
         )
-    if hist_mode not in ("scatter", "matmul"):
+    if hist_mode not in ("scatter", "matmul", "pallas"):
         raise ValueError(
-            f"hist_mode must be 'auto', 'scatter' or 'matmul'; "
+            f"hist_mode must be 'auto', 'scatter', 'matmul' or 'pallas'; "
             f"got {hist_mode!r}"
+        )
+    if hist_mode == "pallas" and B < 8:
+        raise ValueError(
+            f"hist_mode='pallas' requires n_bins >= 8 (TPU sublane "
+            f"tiling); got n_bins={B}"
         )
 
     def node_scores(hist_cum):
@@ -156,6 +167,8 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
             # (n, d·B) one-hot of the binned features — the left matmul
             # factor for every level
             Xoh = jax.nn.one_hot(Xb, B, dtype=Ych.dtype).reshape(n, d * B)
+        elif hist_mode == "pallas":
+            pass  # one-hot factors are built inside the kernel, in VMEM
         else:
             # padded feature-major bins and the tiled channel matrix
             # each scatter consumes
@@ -191,6 +204,19 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
                     preferred_element_type=jnp.float32,
                 )
                 hist = hist.reshape(d, B, nl, C).transpose(0, 2, 1, 3)
+            elif hist_mode == "pallas":
+                # ---- same contraction, Pallas kernel: one-hot factors
+                # built in VMEM, nothing (n, d·B)-sized in HBM
+                from ..ops.pallas_hist import (
+                    level_histogram,
+                    pallas_supported,
+                )
+
+                node_key = jnp.where(at_level, rel, nl).astype(jnp.int32)
+                hist = level_histogram(
+                    Xb, node_key, Ych, nl=nl, n_bins=B,
+                    interpret=not pallas_supported(),
+                )
             else:
                 # ---- histogram: scan over feature BLOCKS, one scatter
                 # per block (fewer, larger scatters pipeline better

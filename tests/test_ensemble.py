@@ -438,3 +438,38 @@ def test_hist_mode_reaches_kernel_through_dist_wrappers(clf_data):
         assert f.get_params()["hist_mode"] == hm
         preds[hm] = f.fit(X, y).predict_proba(X)
     np.testing.assert_allclose(preds["scatter"], preds["matmul"], atol=1e-6)
+
+
+def test_hist_pallas_matches_scatter(clf_data):
+    """hist_mode='pallas' (interpret mode on the CPU mesh) grows the
+    identical tree to the scatter reference, including under vmap."""
+    import jax
+    import jax.numpy as jnp
+
+    from skdist_tpu.models.tree import (
+        build_tree_kernel,
+        classification_channels,
+    )
+    from skdist_tpu.ops.binning import apply_bins, quantile_bin_edges
+
+    X, y = clf_data
+    edges = quantile_bin_edges(X, 16)
+    Xb = apply_bins(jnp.asarray(X), jnp.asarray(edges))
+    Ych = classification_channels(jnp.asarray(y), jnp.ones(len(y)), 3)
+    cfg = dict(n_features=X.shape[1], n_bins=16, channels=4, max_depth=4,
+               max_features=X.shape[1], min_samples_split=2,
+               min_samples_leaf=1, min_impurity_decrease=0.0, extra=False,
+               classification=True)
+    key = jax.random.PRNGKey(3)
+    t_sc = build_tree_kernel(hist_mode="scatter", **cfg)(Xb, Ych, key)
+    t_pl = build_tree_kernel(hist_mode="pallas", **cfg)(Xb, Ych, key)
+    np.testing.assert_array_equal(t_sc["feat"], t_pl["feat"])
+    np.testing.assert_array_equal(t_sc["thr"], t_pl["thr"])
+    np.testing.assert_array_equal(t_sc["is_split"], t_pl["is_split"])
+    np.testing.assert_allclose(t_sc["leaf"], t_pl["leaf"], atol=1e-5)
+
+    keys = jax.random.split(key, 3)
+    trees = jax.vmap(
+        lambda kk: build_tree_kernel(hist_mode="pallas", **cfg)(Xb, Ych, kk)
+    )(keys)
+    assert trees["feat"].shape == (3, 31)
