@@ -1,16 +1,21 @@
 #!/bin/bash
 # Watch for the axon TPU tunnel to answer, then capture every pending
 # hardware measurement (the tunnel's uptime windows are short — round 2
-# got ~35 min). Logs land in a timestamped dir under build_tools/logs/.
-# Completed steps are marked with .done files, so a mid-capture wedge
-# resumes from the first UNfinished step on the next uptime window
-# instead of re-burning it on measurements already taken.
+# got ~35 min). Step markers persist in build_tools/logs/state/ ACROSS
+# watcher invocations, so a restart resumes from the first unfinished
+# step; logs land in a per-invocation timestamped dir. A step that
+# fails while the tunnel is still alive is a deterministic failure —
+# it is marked .failed and skipped so one broken step cannot forfeit
+# the window for the others; a step that fails with the tunnel dead
+# sends the watcher back to waiting.
 #
 # Usage: bash build_tools/tpu_watch.sh [max_minutes]
+# Reset captured state: rm -rf build_tools/logs/state
 
 cd "$(dirname "$0")/.."
+STATEDIR="build_tools/logs/state"
 LOGDIR="build_tools/logs/$(date -u +%Y%m%dT%H%M%S)"
-mkdir -p "$LOGDIR"
+mkdir -p "$STATEDIR" "$LOGDIR"
 MAX_MIN=${1:-480}
 DEADLINE=$(( $(date +%s) + MAX_MIN * 60 ))
 
@@ -22,28 +27,39 @@ assert jax.default_backend() not in ('cpu',)
 " 2>/dev/null
 }
 
-# run_step <name> <timeout_s> <cmd...>: skip if already done; re-probe
-# first so a wedge sends us back to waiting rather than burning the
-# timeout or recording CPU-fallback numbers as hardware measurements.
+# run_step <name> <timeout_s> <cmd...>
+# rc 0: done (now, previously, or deterministically failed — skip);
+# rc 1: tunnel gone mid-step — caller returns to the wait loop.
 run_step() {
   local name=$1 tmo=$2; shift 2
-  [ -f "$LOGDIR/.${name}.done" ] && return 0
+  [ -f "$STATEDIR/${name}.done" ] && return 0
+  [ -f "$STATEDIR/${name}.failed" ] && return 0
   probe || { echo "[tpu_watch] tunnel not answering before $name"; return 1; }
   timeout "$tmo" "$@" > "$LOGDIR/$name.log" 2>&1
   local rc=$?
   echo "[tpu_watch] $name rc=$rc ($(date -u +%H:%M:%S))"
-  [ $rc -eq 0 ] && touch "$LOGDIR/.${name}.done"
-  return $rc
+  if [ $rc -eq 0 ]; then
+    touch "$STATEDIR/${name}.done"
+    return 0
+  fi
+  if probe; then
+    # tunnel alive, step failed anyway: deterministic — don't let it
+    # eat the window; record and move on
+    echo "[tpu_watch] $name failed with tunnel alive; marking .failed"
+    touch "$STATEDIR/${name}.failed"
+    return 0
+  fi
+  return 1
 }
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     echo "[tpu_watch] tunnel answered at $(date -u +%H:%M:%S); capturing to $LOGDIR"
-    run_step tree_sweep 1500 python build_tools/tpu_tree_sweep.py || { sleep 60; continue; }
-    run_step bench_full 1800 python bench.py || { sleep 60; continue; }
-    run_step bf16_check 1800 python build_tools/tpu_bf16_check.py || { sleep 60; continue; }
-    run_step baseline_suite 2400 python benchmarks/run_all.py --ref || { sleep 60; continue; }
-    echo "[tpu_watch] all captures complete"
+    run_step tree_sweep 1500 python build_tools/tpu_tree_sweep.py || continue
+    run_step bench_full 1800 python bench.py || continue
+    run_step bf16_check 1800 python build_tools/tpu_bf16_check.py || continue
+    run_step baseline_suite 2400 python benchmarks/run_all.py --ref || continue
+    echo "[tpu_watch] all captures complete (or recorded as failed)"
     exit 0
   fi
   sleep 120

@@ -47,9 +47,16 @@ def as_dense_f32(X):
 
     Sparse input is densified: TPU/XLA has no efficient general sparse
     matmul, and the framework's hashing/encoding layers are expected to
-    bound width (see ``preprocessing.HashingVectorizerChunked``).
+    bound width (see ``preprocessing.HashingVectorizerChunked``). Large
+    matrices go through the native multithreaded densifier
+    (``native/densify.c``) — the zero-fill dominates scipy's
+    single-threaded ``toarray`` at device-feeding sizes.
     """
     if hasattr(X, "toarray"):  # scipy sparse
+        if hasattr(X, "tocsr") and X.shape[0] * X.shape[1] >= (1 << 22):
+            from ..native import csr_to_dense_f32
+
+            return csr_to_dense_f32(X)
         X = X.toarray()
     elif hasattr(X, "values") and not isinstance(X, np.ndarray):  # pandas
         X = X.values

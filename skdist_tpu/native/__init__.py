@@ -19,13 +19,13 @@ import threading
 
 import numpy as np
 
-_NATIVE = None
-_TRIED = False
+_EXTS = {}
 _LOAD_LOCK = threading.Lock()
 
 
-def _load_native():
-    """Import the compiled module, building it if necessary.
+def _load_ext(name, extra_flags=()):
+    """Import the compiled module ``_<name>`` (from ``<name>.c``),
+    building it on first use.
 
     Any failure anywhere (read-only tree, missing compiler, truncated
     artifact) returns None so callers take the pure-Python path — the
@@ -33,26 +33,25 @@ def _load_native():
     temp file and are renamed into place (atomic on POSIX) so
     concurrent processes never load a half-written .so.
     """
-    global _NATIVE, _TRIED
     with _LOAD_LOCK:
-        if _TRIED:
-            return _NATIVE
-        _TRIED = True
+        if name in _EXTS:
+            return _EXTS[name]
         try:
-            _NATIVE = _load_native_inner()
+            mod = _load_ext_inner(name, extra_flags)
         except Exception:
-            _NATIVE = None
-        return _NATIVE
+            mod = None
+        _EXTS[name] = mod
+        return mod
 
 
-def _load_native_inner():
+def _load_ext_inner(name, extra_flags):
     import importlib.util
 
     build_dir = os.path.join(os.path.dirname(__file__), "_build")
     os.makedirs(build_dir, exist_ok=True)
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    so_path = os.path.join(build_dir, f"_fasthash{suffix}")
-    src = os.path.join(os.path.dirname(__file__), "fasthash.c")
+    so_path = os.path.join(build_dir, f"_{name}{suffix}")
+    src = os.path.join(os.path.dirname(__file__), f"{name}.c")
     if not os.path.exists(so_path) or (
         os.path.exists(src)
         and os.path.getmtime(src) > os.path.getmtime(so_path)
@@ -63,18 +62,22 @@ def _load_native_inner():
         os.close(fd)
         try:
             subprocess.run(
-                [cc, "-O3", "-shared", "-fPIC", f"-I{include}", src,
-                 "-o", tmp_path],
+                [cc, "-O3", "-shared", "-fPIC", *extra_flags,
+                 f"-I{include}", src, "-o", tmp_path],
                 check=True, capture_output=True, timeout=120,
             )
             os.replace(tmp_path, so_path)
         finally:
             if os.path.exists(tmp_path):
                 os.unlink(tmp_path)
-    spec = importlib.util.spec_from_file_location("_fasthash", so_path)
+    spec = importlib.util.spec_from_file_location(f"_{name}", so_path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_native():
+    return _load_ext("fasthash")
 
 
 # ---------------------------------------------------------------------------
@@ -206,3 +209,37 @@ def hash_documents(docs, n_features=2**12, ngram_range=(1, 1),
 
 def native_available():
     return _load_native() is not None
+
+
+# ---------------------------------------------------------------------------
+# multithreaded CSR -> dense f32 (densify.c)
+# ---------------------------------------------------------------------------
+
+def csr_to_dense_f32(X, force_python=False, n_threads=None):
+    """Densify a scipy sparse matrix to a C-contiguous float32 array.
+
+    The host-side boundary feeding the device: TPU has no general
+    sparse matmul, so hashed-text CSR matrices densify before
+    ``device_put``. The C kernel partitions rows across threads
+    (zero-fill + scatter per block, GIL released); the fallback is
+    scipy's single-threaded ``toarray``. Duplicate entries accumulate
+    in both paths (scipy CSR semantics).
+    """
+    csr = X.tocsr()
+    n_rows, n_cols = csr.shape
+    mod = None if force_python else _load_ext("densify", ("-pthread",))
+    if mod is None or n_rows == 0 or n_cols == 0:
+        return np.ascontiguousarray(csr.toarray(), dtype=np.float32)
+    data = np.ascontiguousarray(csr.data, dtype=np.float32)
+    indices = np.ascontiguousarray(csr.indices)
+    if indices.dtype not in (np.int32, np.int64):
+        indices = indices.astype(np.int64)
+    indptr = np.ascontiguousarray(csr.indptr, dtype=np.int64)
+    out = np.empty((n_rows, n_cols), dtype=np.float32)
+    if n_threads is None:
+        n_threads = min(16, os.cpu_count() or 1)
+    mod.csr_to_dense(
+        out, data, indices, indptr, n_rows, n_cols,
+        indices.dtype.itemsize, int(n_threads),
+    )
+    return out

@@ -81,3 +81,70 @@ def test_in_pipeline_with_search(clf_data):
         ("clf", SkLR(max_iter=200)),
     ]).fit(docs, y)
     assert pipe.score(docs, y) == 1.0
+
+
+def test_csr_to_dense_matches_scipy():
+    """Native multithreaded densifier vs scipy toarray: identical
+    output (incl. duplicate-entry accumulation), f32 C-contiguous."""
+    from scipy import sparse
+
+    from skdist_tpu.native import csr_to_dense_f32
+
+    rng = np.random.RandomState(7)
+    X = sparse.random(300, 90, density=0.05, random_state=rng,
+                      format="coo", dtype=np.float64)
+    # duplicate coordinates must accumulate, like scipy CSR
+    rows = np.concatenate([X.row, X.row[:7]])
+    cols = np.concatenate([X.col, X.col[:7]])
+    vals = np.concatenate([X.data, X.data[:7]])
+    Xd = sparse.coo_matrix((vals, (rows, cols)), shape=X.shape)
+    ref = np.asarray(Xd.tocsr().toarray(), dtype=np.float32)
+
+    out = csr_to_dense_f32(Xd)
+    assert out.dtype == np.float32 and out.flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(out, ref)
+
+    # int64 index path
+    c = Xd.tocsr()
+    c.indices = c.indices.astype(np.int64)
+    c.indptr = c.indptr.astype(np.int64)
+    np.testing.assert_array_equal(csr_to_dense_f32(c), ref)
+
+    # fallback contract
+    np.testing.assert_array_equal(
+        csr_to_dense_f32(Xd, force_python=True), ref
+    )
+
+    # empty matrix edge
+    empty = sparse.csr_matrix((0, 5), dtype=np.float32)
+    assert csr_to_dense_f32(empty).shape == (0, 5)
+
+
+def test_as_dense_f32_sparse_routes_through_densifier(monkeypatch):
+    from scipy import sparse
+
+    import skdist_tpu.native as native_mod
+    from skdist_tpu.models.linear import as_dense_f32
+
+    calls = []
+    real = native_mod.csr_to_dense_f32
+
+    def spy(X, **kw):
+        calls.append(X.shape)
+        return real(X, **kw)
+
+    monkeypatch.setattr(native_mod, "csr_to_dense_f32", spy)
+
+    rng = np.random.RandomState(8)
+    # large enough to cross the native threshold (>= 2^22 cells)
+    X = sparse.random(2100, 2048, density=0.005, random_state=rng,
+                      format="csr", dtype=np.float32)
+    out = as_dense_f32(X)
+    assert calls == [(2100, 2048)], "large sparse must route natively"
+    np.testing.assert_array_equal(out, np.asarray(X.toarray(), np.float32))
+
+    # small sparse stays on the plain toarray path
+    small = sparse.random(50, 40, density=0.1, random_state=rng,
+                          format="csr", dtype=np.float32)
+    as_dense_f32(small)
+    assert calls == [(2100, 2048)], "small sparse must NOT route natively"
