@@ -30,10 +30,26 @@ assert jax.default_backend() not in ('cpu',)
 # run_step <name> <timeout_s> <cmd...>
 # rc 0: done (now, previously, or deterministically failed — skip);
 # rc 1: tunnel gone mid-step — caller returns to the wait loop.
+# A .failed marker is honoured only while it is NEWER than every
+# source file under skdist_tpu/ bench.py build_tools/*.py — a fix to
+# the failing code invalidates the marker, so the watcher retries the
+# exact capture the fix was made for instead of skipping it forever.
 run_step() {
   local name=$1 tmo=$2; shift 2
   [ -f "$STATEDIR/${name}.done" ] && return 0
-  [ -f "$STATEDIR/${name}.failed" ] && return 0
+  # timed out earlier in THIS invocation: don't burn the rest of the
+  # window re-attempting it (a fresh watcher run will retry)
+  [ -f "$LOGDIR/${name}.timedout" ] && return 0
+  if [ -f "$STATEDIR/${name}.failed" ]; then
+    local newer
+    newer=$(find skdist_tpu bench.py benchmarks build_tools -name '*.py' \
+              -newer "$STATEDIR/${name}.failed" 2>/dev/null | head -1)
+    if [ -z "$newer" ]; then
+      return 0
+    fi
+    echo "[tpu_watch] $name: sources changed since .failed ($newer); retrying"
+    rm -f "$STATEDIR/${name}.failed"
+  fi
   probe || { echo "[tpu_watch] tunnel not answering before $name"; return 1; }
   timeout "$tmo" "$@" > "$LOGDIR/$name.log" 2>&1
   local rc=$?
@@ -42,9 +58,18 @@ run_step() {
     touch "$STATEDIR/${name}.done"
     return 0
   fi
+  if [ $rc -eq 124 ]; then
+    # killed by our own timeout: slow-but-alive tunnel or mid-step
+    # wedge, NOT a deterministic failure — no persistent .failed, but
+    # skip it for the rest of this invocation so the remaining steps
+    # still get the window
+    echo "[tpu_watch] $name timed out; skipping for this invocation"
+    touch "$LOGDIR/${name}.timedout"
+    return 0
+  fi
   if probe; then
-    # tunnel alive, step failed anyway: deterministic — don't let it
-    # eat the window; record and move on
+    # tunnel alive, step failed fast anyway: deterministic — don't let
+    # it eat the window; record and move on
     echo "[tpu_watch] $name failed with tunnel alive; marking .failed"
     touch "$STATEDIR/${name}.failed"
     return 0

@@ -53,7 +53,10 @@ def as_dense_f32(X):
     single-threaded ``toarray`` at device-feeding sizes.
     """
     if hasattr(X, "toarray"):  # scipy sparse
-        if hasattr(X, "tocsr") and X.shape[0] * X.shape[1] >= (1 << 22):
+        # 1-D sparse arrays (scipy >= 1.8 csr_array) have a 1-tuple
+        # shape; only 2-D input takes the native CSR fast path
+        if (hasattr(X, "tocsr") and len(X.shape) == 2
+                and X.shape[0] * X.shape[1] >= (1 << 22)):
             from ..native import csr_to_dense_f32
 
             return csr_to_dense_f32(X)

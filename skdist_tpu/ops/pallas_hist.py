@@ -71,6 +71,11 @@ def level_histogram(Xb, node_key, Ych, *, nl, n_bins, interpret=False,
         node_key = jnp.pad(node_key, (0, n_pad - n),
                            constant_values=np.int32(nl))
         Ych = jnp.pad(Ych, ((0, n_pad - n), (0, 0)))
+    # Mosaic tiles the LAST TWO dims of each block; a (1, S) block over
+    # the (d, n) array would put a size-1 block on the d axis (neither
+    # 8-divisible nor full). Lift d to a leading grid-only dim so the
+    # last two block dims are (1==full, S).
+    XbT = XbT.reshape(d, 1, n_pad)
     node_key = node_key.reshape(1, n_pad)
 
     def kernel(xb_ref, nk_ref, ych_ref, out_ref):
@@ -78,7 +83,7 @@ def level_histogram(Xb, node_key, Ych, *, nl, n_bins, interpret=False,
         li = pl.program_id(1)
 
         # M (S, B): bin one-hot of this feature's sample chunk
-        bins = xb_ref[0, :]  # (S,) int32
+        bins = xb_ref[0, 0, :]  # (S,) int32
         M = (
             bins[:, None] == lax.broadcasted_iota(jnp.int32, (S, B), 1)
         ).astype(jnp.float32)
@@ -120,7 +125,7 @@ def level_histogram(Xb, node_key, Ych, *, nl, n_bins, interpret=False,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, S), lambda f, l, s: (f, s)),
+            pl.BlockSpec((1, 1, S), lambda f, l, s: (f, 0, s)),
             pl.BlockSpec((1, S), lambda f, l, s: (0, s)),
             pl.BlockSpec((S, C), lambda f, l, s: (s, 0)),
         ],

@@ -148,3 +148,22 @@ def test_as_dense_f32_sparse_routes_through_densifier(monkeypatch):
                           format="csr", dtype=np.float32)
     as_dense_f32(small)
     assert calls == [(2100, 2048)], "small sparse must NOT route natively"
+
+
+def test_as_dense_f32_1d_sparse_array():
+    """1-D scipy sparse arrays (csr_array of a vector) have a 1-tuple
+    shape; the native-path size guard must not index shape[1]
+    (regression: IndexError before the len(shape)==2 check)."""
+    import scipy.sparse as sparse
+
+    from skdist_tpu.models.linear import as_dense_f32
+
+    try:
+        v = sparse.csr_array(np.arange(5, dtype=np.float64))
+    except (TypeError, ValueError):  # scipy without 1-D sparse support
+        import pytest
+
+        pytest.skip("scipy version lacks 1-D sparse arrays")
+    out = as_dense_f32(v)
+    assert out.shape == (5, 1) and out.dtype == np.float32
+    np.testing.assert_array_equal(out.ravel(), np.arange(5, dtype=np.float32))
