@@ -216,26 +216,15 @@ def _run_phase_child(phase, platform, timeout):
     reached stdout — a crash *after* a successful measurement must not
     cause that measurement to be superseded by a CPU floor.
     """
-    import subprocess
     import sys
 
-    proc = subprocess.Popen(
+    from skdist_tpu.utils.childproc import relay, run_child_with_deadline
+
+    status, _, out = run_child_with_deadline(
         [sys.executable, __file__, "--phase", phase, "--platform", platform],
-        stdout=subprocess.PIPE, text=True,
+        timeout,
     )
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-        status = "ok" if proc.returncode == 0 else "error"
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        try:
-            out, _ = proc.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            out = ""
-        status = "timeout"
-    if out:
-        sys.stdout.write(out)
-        sys.stdout.flush()
+    relay(out)
     emitted = any(ln.startswith("{") for ln in (out or "").splitlines())
     return status, emitted
 

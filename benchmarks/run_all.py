@@ -252,6 +252,43 @@ CONFIGS = {
 }
 
 
+# per-config child timeouts (s): generous for the TPU path; the global
+# budget below additionally caps the SUM so the suite always finishes
+# (with whatever it captured) inside the watcher's outer timeout
+_CONFIG_TIMEOUTS = {1: 600, 2: 600, 3: 600, 4: 1200, 5: 300}
+
+# total wall budget for the whole suite; just under tpu_watch.sh's
+# 2400 s step timeout so the parent reports pending configs itself
+# instead of being SIGTERMed mid-config (override via env)
+_TOTAL_BUDGET_S = float(os.environ.get("RUN_ALL_BUDGET_S", 2340))
+
+# child exit code meaning "tunnel dead, full-scale run refused"
+_RC_TUNNEL_DEAD = 3
+
+
+def _run_config_child(idx, args, budget_left):
+    """One config in a child process with a hard deadline.
+
+    The axon tunnel can wedge MID-suite (observed: config 2 blocked for
+    40 min until the watcher's outer timeout, losing configs 3-5).
+    A blocked device op is uninterruptible in-process, so only process
+    isolation bounds the damage to one config; the shared runner kills
+    the child's whole process group and bounds the post-kill wait.
+    Returns 'ok', 'error', 'timeout', or 'dead' (child refused: tunnel
+    down at full scale)."""
+    from skdist_tpu.utils.childproc import run_child_with_deadline
+
+    cmd = [sys.executable, __file__, "--as-child", "--config", str(idx),
+           "--scale", str(args.scale)]
+    if args.ref:
+        cmd.append("--ref")
+    timeout = min(_CONFIG_TIMEOUTS.get(idx, 600), budget_left)
+    status, rc, _ = run_child_with_deadline(cmd, timeout, capture=False)
+    if status == "error" and rc == _RC_TUNNEL_DEAD:
+        return "dead"
+    return status
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0,
@@ -260,20 +297,44 @@ def main():
                     help="run one config (1-5) instead of all")
     ap.add_argument("--ref", action="store_true",
                     help="also time the sklearn/joblib engine")
+    ap.add_argument("--as-child", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: in-process run
     args = ap.parse_args()
 
-    # Startup guard only: a wedged tunnel at launch falls back to CPU
-    # instead of hanging. Unlike bench.py this script does NOT isolate
-    # each config in a child process — a MID-suite wedge blocks until
-    # an external timeout, so on a flaky tunnel run it under `timeout`
-    # (build_tools/tpu_watch.sh does, and re-probes between steps).
     from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
 
-    probe_platform_or_cpu()
+    if args.as_child:
+        platform = probe_platform_or_cpu()
+        if platform in ("cpu-fallback",) and args.scale >= 0.2:
+            # never grind a full-scale workload on fallback CPU (the
+            # round-1 bench failure mode) — tell the parent instead
+            print(f"[run_all] config {args.config}: tunnel dead at "
+                  "full scale; refusing CPU fallback", file=sys.stderr)
+            sys.exit(_RC_TUNNEL_DEAD)
+        CONFIGS[args.config](args.scale, args.ref)
+        return
 
+    t0 = time.perf_counter()
     todo = [args.config] if args.config else sorted(CONFIGS)
-    for idx in todo:
-        CONFIGS[idx](args.scale, args.ref)
+    for i, idx in enumerate(todo):
+        left = _TOTAL_BUDGET_S - (time.perf_counter() - t0)
+        if left < 60:
+            print(f"[run_all] budget exhausted; configs {todo[i:]} "
+                  "not attempted", file=sys.stderr)
+            break
+        status = _run_config_child(idx, args, left)
+        if status == "ok":
+            continue
+        print(f"[run_all] config {idx}: {status}", file=sys.stderr)
+        if status == "dead":
+            break
+        if status == "timeout":
+            # distinguish a slow config from a wedged tunnel before
+            # spending the next config's timeout on a dead device
+            if probe_platform_or_cpu(fresh=True) == "cpu-fallback":
+                print("[run_all] tunnel not answering; stopping",
+                      file=sys.stderr)
+                break
 
 
 if __name__ == "__main__":

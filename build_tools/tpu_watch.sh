@@ -42,7 +42,8 @@ run_step() {
   [ -f "$LOGDIR/${name}.timedout" ] && return 0
   if [ -f "$STATEDIR/${name}.failed" ]; then
     local newer
-    newer=$(find skdist_tpu bench.py benchmarks build_tools -name '*.py' \
+    newer=$(find skdist_tpu bench.py benchmarks build_tools \
+              \( -name '*.py' -o -name '*.c' -o -name '*.sh' \) \
               -newer "$STATEDIR/${name}.failed" 2>/dev/null | head -1)
     if [ -z "$newer" ]; then
       return 0
@@ -84,10 +85,22 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_step bench_full 1800 python bench.py || continue
     run_step bf16_check 1800 python build_tools/tpu_bf16_check.py || continue
     run_step baseline_suite 2400 python benchmarks/run_all.py --ref || continue
+    # steps that timed out this pass: clear their markers and go
+    # around again (after a cooldown) while the window lasts, instead
+    # of exiting 0 with captures silently missing
+    if compgen -G "$LOGDIR/*.timedout" > /dev/null; then
+      echo "[tpu_watch] timed-out steps pending:" "$LOGDIR"/*.timedout
+      rm -f "$LOGDIR"/*.timedout
+      sleep 120
+      continue
+    fi
     echo "[tpu_watch] all captures complete (or recorded as failed)"
     exit 0
   fi
   sleep 120
 done
 echo "[tpu_watch] deadline reached without completing all captures"
+if compgen -G "$LOGDIR/*.timedout" > /dev/null; then
+  echo "[tpu_watch] still pending:" "$LOGDIR"/*.timedout
+fi
 exit 1

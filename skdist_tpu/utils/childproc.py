@@ -1,0 +1,67 @@
+"""
+Shared wedge-isolation child runner for the benchmark drivers
+(bench.py, benchmarks/run_all.py).
+
+The axon TPU tunnel can wedge such that a device op blocks forever and
+uninterruptibly — in-process timeouts cannot fire, and even SIGKILL may
+leave the child in an unkillable D-state. The only reliable containment
+is: run the device-touching phase in a CHILD process, enforce the
+deadline from the parent, kill the whole process GROUP on expiry (so
+grandchildren spawned by the phase die too), and bound the post-kill
+wait so an unkillable child is abandoned rather than inherited as a
+parent hang (the round-2 bug this module consolidates: one driver's
+copy of this logic dropped the bounded wait and could hang forever in
+``subprocess.run``'s internal ``wait()``).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+
+def run_child_with_deadline(cmd, timeout, kill_wait=10, capture=True):
+    """Run ``cmd`` with a hard deadline; never block past
+    ``timeout + kill_wait``.
+
+    Returns ``(status, returncode, stdout_text)``:
+      status: 'ok' (rc 0), 'error' (nonzero rc), or 'timeout'
+      stdout_text: captured stdout ('' when nothing landed), or None
+        with ``capture=False`` (child inherits the parent's stdout).
+
+    The child is started in its own session (process group) so the
+    deadline kill reaches grandchildren as well.
+    """
+    popen_kw = {"start_new_session": True}
+    if capture:
+        popen_kw.update(stdout=subprocess.PIPE, text=True)
+    proc = subprocess.Popen(cmd, **popen_kw)
+    out = None
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        status = "ok" if proc.returncode == 0 else "error"
+    except subprocess.TimeoutExpired:
+        _kill_group(proc)
+        try:
+            out, _ = proc.communicate(timeout=kill_wait)
+        except subprocess.TimeoutExpired:
+            pass  # unkillable child: abandon, do not inherit its hang
+        status = "timeout"
+    return status, proc.returncode, (out if capture else None)
+
+
+def _kill_group(proc):
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def relay(out):
+    """Forward a child's captured stdout to this process's stdout."""
+    if out:
+        sys.stdout.write(out)
+        sys.stdout.flush()

@@ -26,7 +26,7 @@ _PROBE_CODE = (
 _RESULT = None
 
 
-def probe_platform_or_cpu(timeout=30, post_kill_wait=10):
+def probe_platform_or_cpu(timeout=30, post_kill_wait=10, fresh=False):
     """Return the live default JAX platform name, or pin CPU in-process
     and return 'cpu-fallback' when the device never answers.
 
@@ -34,22 +34,31 @@ def probe_platform_or_cpu(timeout=30, post_kill_wait=10):
     accelerator there too); short-circuits an explicit cpu pin — both
     the env-var form and an in-process ``jax.config`` pin (the latter is
     what conftest.py does, and paying the subprocess timeout there would
-    be pure waste). The first call's verdict is memoised for the process.
+    be pure waste). The first call's verdict is memoised for the process;
+    ``fresh=True`` re-probes (for long-lived orchestrators asking "is
+    the tunnel still alive NOW" — note it cannot un-pin a CPU fallback
+    already applied to this process's jax config).
     """
     global _RESULT
-    if _RESULT is not None:
+    if _RESULT is not None and not fresh:
         return _RESULT
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         _RESULT = "cpu"
         return _RESULT
-    jax_mod = sys.modules.get("jax")
-    if jax_mod is not None:
-        try:
-            if (jax_mod.config.jax_platforms or "").strip() == "cpu":
-                _RESULT = "cpu"
-                return _RESULT
-        except AttributeError:
-            pass
+    # In-process cpu pin short-circuit — but NOT when the pin was
+    # applied by this module's own earlier fallback and the caller asks
+    # for a fresh verdict: a fresh probe must be able to answer
+    # 'cpu-fallback' (tunnel still dead) or report a recovered tunnel,
+    # not misread the fallback pin as a deliberate user pin.
+    if not (fresh and _RESULT == "cpu-fallback"):
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                if (jax_mod.config.jax_platforms or "").strip() == "cpu":
+                    _RESULT = "cpu"
+                    return _RESULT
+            except AttributeError:
+                pass
     import tempfile
 
     fd, out_path = tempfile.mkstemp(suffix=".probe")

@@ -1,0 +1,54 @@
+"""Tests for the shared wedge-isolation child runner
+(utils/childproc.py) used by bench.py and benchmarks/run_all.py."""
+
+import sys
+import time
+
+from skdist_tpu.utils.childproc import run_child_with_deadline
+
+
+def _py(code):
+    return [sys.executable, "-c", code]
+
+
+def test_ok_captures_stdout():
+    status, rc, out = run_child_with_deadline(
+        _py("print('hello'); print('{\"x\": 1}')"), timeout=30
+    )
+    assert status == "ok" and rc == 0
+    assert "hello" in out and '{"x": 1}' in out
+
+
+def test_error_propagates_returncode():
+    status, rc, out = run_child_with_deadline(
+        _py("import sys; print('partial'); sys.exit(3)"), timeout=30
+    )
+    assert status == "error" and rc == 3
+    assert "partial" in out
+
+
+def test_timeout_kills_process_group():
+    # child spawns a grandchild; both must die at the deadline (the
+    # group kill), and the call must return promptly, not block on the
+    # grandchild holding the stdout pipe open
+    code = (
+        "import subprocess, sys, time;"
+        "p = subprocess.Popen([sys.executable, '-c', 'import time; time.sleep(60)']);"
+        "print('spawned', flush=True);"
+        "time.sleep(60)"
+    )
+    t0 = time.perf_counter()
+    # timeout must comfortably cover interpreter cold-start so the
+    # child reaches its print before the deadline fires
+    status, rc, out = run_child_with_deadline(_py(code), timeout=5, kill_wait=10)
+    wall = time.perf_counter() - t0
+    assert status == "timeout"
+    assert "spawned" in (out or "")
+    assert wall < 25, f"did not return promptly after kill ({wall:.1f}s)"
+
+
+def test_no_capture_mode():
+    status, rc, out = run_child_with_deadline(
+        _py("pass"), timeout=30, capture=False
+    )
+    assert status == "ok" and out is None
