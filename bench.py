@@ -79,7 +79,7 @@ def run_bench(platform, quick=False):
     def run_once():
         t0 = time.perf_counter()
         gs = DistGridSearchCV(
-            est, grid, backend=TPUBackend(), cv=5, scoring="accuracy",
+            est, grid, backend=TPUBackend(reuse_broadcast=True), cv=5, scoring="accuracy",
         ).fit(X, y)
         return time.perf_counter() - t0, gs
 
@@ -113,7 +113,7 @@ def run_bench(platform, quick=False):
     parity_est = LogisticRegression(max_iter=200, tol=1e-6)
     sub_grid = {"C": [0.01, 0.1, 1.0]}
     b = DistGridSearchCV(
-        parity_est, sub_grid, backend=TPUBackend(), cv=5,
+        parity_est, sub_grid, backend=TPUBackend(reuse_broadcast=True), cv=5,
         scoring="neg_log_loss",
     ).fit(X, y)
     g = DistGridSearchCV(
@@ -146,7 +146,7 @@ def run_bench(platform, quick=False):
     # ill-conditioned extreme of the real grid (C=100) + its floor
     ill_est = LogisticRegression(C=100.0, max_iter=200, tol=1e-6)
     bi = DistGridSearchCV(
-        ill_est, {"C": [100.0]}, backend=TPUBackend(), cv=5,
+        ill_est, {"C": [100.0]}, backend=TPUBackend(reuse_broadcast=True), cv=5,
         scoring="neg_log_loss",
     ).fit(X, y)
     gi = DistGridSearchCV(

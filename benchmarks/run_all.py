@@ -78,7 +78,7 @@ def config_1_gridsearch(scale, ref):
     def run():
         return DistGridSearchCV(
             LogisticRegression(max_iter=30, tol=1e-4), grid,
-            backend=TPUBackend(), cv=5, scoring="accuracy",
+            backend=TPUBackend(reuse_broadcast=True), cv=5, scoring="accuracy",
         ).fit(X, y)
 
     cold, _ = _timed(run)
@@ -115,7 +115,7 @@ def config_2_randomized_sgd(scale, ref):
     def run():
         return DistRandomizedSearchCV(
             SGDClassifier(max_iter=20, random_state=0), dists, n_iter=60,
-            backend=TPUBackend(), cv=5, scoring="accuracy", random_state=0,
+            backend=TPUBackend(reuse_broadcast=True), cv=5, scoring="accuracy", random_state=0,
         ).fit(X, y)
 
     cold, _ = _timed(run)
@@ -151,7 +151,7 @@ def config_3_ovr_svc(scale, ref):
 
     def run():
         return DistOneVsRestClassifier(
-            LinearSVC(C=1.0, max_iter=100), backend=TPUBackend(),
+            LinearSVC(C=1.0, max_iter=100), backend=TPUBackend(reuse_broadcast=True),
         ).fit(X, y)
 
     cold, _ = _timed(run)
@@ -186,7 +186,7 @@ def config_4_forest(scale, ref):
     def run():
         return DistRandomForestClassifier(
             n_estimators=256, max_depth=8, random_state=0,
-            backend=TPUBackend(),
+            backend=TPUBackend(reuse_broadcast=True),
         ).fit(X, y)
 
     cold, _ = _timed(run)
@@ -222,7 +222,7 @@ def config_5_batch_predict(scale, ref):
 
     def run():
         return batch_predict(
-            model, Xs, method="predict_proba", backend=TPUBackend(),
+            model, Xs, method="predict_proba", backend=TPUBackend(reuse_broadcast=True),
         )
 
     cold, _ = _timed(run)

@@ -182,8 +182,9 @@ def _binary_prep(est, X_arr):
     estimator implementing the batched-fit contract: calls the
     estimator's own _prep_fit_data with a synthetic two-class y so
     data-dependent context (tree bin edges etc.) is built exactly as a
-    real binary fit would build it; the device-resident X is reused so
-    the matrix transfers once. Returns (None,)*3 if prep fails or the
+    real binary fit would build it; X stays host-staged and is placed
+    (and, with reuse_broadcast, cached) once by the backend's
+    batched_map. Returns (None,)*3 if prep fails or the
     estimator is not a classifier (no 'classes' meta) — those take the
     generic host path."""
     if getattr(est, "_estimator_type", None) != "classifier":

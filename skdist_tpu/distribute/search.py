@@ -487,13 +487,17 @@ class DistBaseSearchCV(BaseEstimator):
             kernel = _cached_cv_kernel(
                 est_cls, meta, static, scorer_specs, self.return_train_score
             )
+            # all leaves stay host-staged: batched_map performs the one
+            # sharded placement (through the reuse-broadcast cache when
+            # enabled — data["X"] is the SAME host array across buckets,
+            # so multi-bucket grids re-place it for free on cache hits)
             shared = {
                 "X": data["X"],
                 "y": data["y"],
                 "sw": data["sw"],
                 "aux": extract_aux(data),
-                "train_masks": jnp.asarray(train_masks),
-                "test_masks": jnp.asarray(test_masks),
+                "train_masks": train_masks,
+                "test_masks": test_masks,
             }
             # stack task axis: bucket candidates × folds, split fastest
             task_hyper = {name: [] for name in hyper_names}

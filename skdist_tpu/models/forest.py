@@ -249,9 +249,9 @@ class _BaseForest(BaseEstimator):
             backend, round_size = self._resolve_fit_backend()
             Xb = np.asarray(apply_bins(jnp.asarray(X), jnp.asarray(edges)))
             shared = {
-                "Xb": jnp.asarray(Xb),
-                "y": jnp.asarray(y_enc),
-                "sw": jnp.asarray(sw),
+                "Xb": Xb,  # host-staged: batched_map places (and can
+                "y": np.asarray(y_enc),  # cache) the sharded replicas
+                "sw": np.asarray(sw),
             }
             new_trees = backend.batched_map(
                 kernel, {"seed": seeds}, shared, round_size=round_size

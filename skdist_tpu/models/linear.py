@@ -69,6 +69,23 @@ def as_dense_f32(X):
     return np.ascontiguousarray(X, dtype=np.float32)
 
 
+def host_stage(x):
+    """Stage an array for backend placement: host arrays stay host,
+    device arrays stay put.
+
+    ``_prep_fit_data`` used to ``jnp.asarray`` every leaf, which
+    performed an eager uncommitted default-device transfer that the
+    backend's ``batched_map`` immediately re-placed with a sharded
+    ``device_put`` — and which made the reuse-broadcast cache inert
+    (it keys on HOST array identity). Staying host defers the single
+    transfer to the placement layer, where sharding and the opt-in
+    reuse cache live.
+    """
+    if hasattr(x, "sharding"):  # already a jax array: leave it be
+        return x
+    return np.asarray(x)
+
+
 def encode_labels(y):
     """y → (int32 indices, classes array)."""
     y = np.asarray(y)
@@ -353,9 +370,9 @@ class _LinearClassifierBase(_LinearModelBase, ClassifierMixin):
             "cw_arr": class_weight_vector(getattr(self, "class_weight", None), classes),
         }
         data = {
-            "X": jnp.asarray(X),
-            "y": jnp.asarray(y_idx),
-            "sw": jnp.asarray(sw),
+            "X": host_stage(X),
+            "y": host_stage(y_idx),
+            "sw": host_stage(sw),
         }
         return data, meta
 
@@ -788,7 +805,7 @@ class Ridge(_LinearModelBase, RegressorMixin, _RidgeKernelMixin):
         y = np.asarray(y, dtype=np.float32)
         sw = prepare_sample_weight(sample_weight, X.shape[0])
         meta = {"n_features": X.shape[1], "y_ndim": y.ndim}
-        data = {"X": jnp.asarray(X), "y": jnp.asarray(y), "sw": jnp.asarray(sw)}
+        data = {"X": host_stage(X), "y": host_stage(y), "sw": host_stage(sw)}
         return data, meta
 
     @classmethod

@@ -42,6 +42,7 @@ from .linear import (
     as_dense_f32,
     encode_labels,
     get_kernel,
+    host_stage,
     prepare_sample_weight,
 )
 
@@ -419,15 +420,15 @@ class _BaseTree(BaseEstimator):
         if self._classification:
             y_idx, classes = encode_labels(y)
             meta.update(classes=classes, n_classes=len(classes))
-            data = {"X": jnp.asarray(X), "y": jnp.asarray(y_idx),
-                    "sw": jnp.asarray(sw)}
+            data = {"X": host_stage(X), "y": host_stage(y_idx),
+                    "sw": host_stage(sw)}
         else:
-            data = {"X": jnp.asarray(X),
-                    "y": jnp.asarray(np.asarray(y, np.float32)),
-                    "sw": jnp.asarray(sw)}
+            data = {"X": host_stage(X),
+                    "y": np.asarray(y, np.float32),
+                    "sw": host_stage(sw)}
         # extra data-dependent fit context; the distributed search
         # forwards non-(X,y,sw) entries to the kernel as ``aux``
-        data["edges"] = jnp.asarray(edges)
+        data["edges"] = host_stage(edges)
         return data, meta
 
     def _static_config(self, meta):

@@ -159,7 +159,8 @@ class TPUBackend(TaskBackend):
     is_device_backend = True
 
     def __init__(self, devices=None, axis_name="tasks", round_size=None,
-                 n_jobs=None, data_axis_size=1, mesh=None):
+                 n_jobs=None, data_axis_size=1, mesh=None,
+                 reuse_broadcast=False):
         """``data_axis_size`` > 1 builds a 2D ('tasks', 'data') mesh:
         that many devices cooperate on each task with row-sharded shared
         data (GSPMD inserts the psum of gram/gradient partials over
@@ -168,12 +169,21 @@ class TPUBackend(TaskBackend):
         An explicit ``mesh`` (e.g. from ``parallel.mesh`` helpers) is
         used as-is; its leading axis is the task axis and a 'data' axis,
         if present, row-shards.
+
+        ``reuse_broadcast=True`` caches device-resident copies of shared
+        arrays across fits (keyed by host-array identity + sharding), so
+        repeated fits on the same X skip the host→device transfer — the
+        analogue of reusing one ``sc.broadcast`` handle, with the same
+        contract: mutating a host array after it was broadcast is user
+        error (the cached device copy would go stale; reference Spark
+        broadcasts behave identically). Off by default.
         """
         import jax
         from jax.sharding import Mesh
 
         self.round_size = round_size
         self.n_jobs = n_jobs
+        self.reuse_broadcast = reuse_broadcast
         if mesh is not None:
             self.mesh = mesh
             self.devices = list(mesh.devices.flat)
@@ -254,7 +264,18 @@ class TPUBackend(TaskBackend):
             )
         else:
             shared_shardings = rep_sharding
-        shared_args = jax.device_put(shared_args, shared_shardings)
+        if isinstance(shared_shardings, NamedSharding):
+            # single sharding for the whole tree: leaf-wise put through
+            # the reuse cache (sharding-spec trees skip the cache — the
+            # 2D row-sharded case re-puts every fit)
+            shared_args = jax.tree_util.tree_map(
+                lambda a: _cached_device_put(
+                    a, shared_shardings, self.reuse_broadcast
+                ),
+                shared_args,
+            )
+        else:
+            shared_args = jax.device_put(shared_args, shared_shardings)
         fn = _jit_vmapped(
             kernel, static_args, task_sharding, shared_shardings
         )
@@ -292,6 +313,48 @@ class TPUBackend(TaskBackend):
                 )
         out = _concat_rounds(rounds_out)
         return (out, timings) if return_timings else out
+
+
+# Device-broadcast reuse cache (opt-in via TPUBackend(reuse_broadcast=
+# True)): host array identity + sharding -> device-resident replica.
+# Entries validate the weakref target IS the original host array, so a
+# recycled id() can never serve a stale buffer; a weakref finalizer
+# evicts the entry (freeing the pinned device HBM) as soon as the host
+# array is collected, and a FIFO bound caps pinned HBM regardless.
+_BCAST_CACHE = {}
+_BCAST_MAX = 4
+_BCAST_MIN_BYTES = 1 << 20  # caching tiny arrays is pure overhead
+_BCAST_HITS = 0  # diagnostics + test observability
+
+
+def _cached_device_put(leaf, sharding, enabled):
+    import weakref
+
+    import jax
+
+    global _BCAST_HITS
+    if not enabled or not isinstance(leaf, np.ndarray) \
+            or leaf.nbytes < _BCAST_MIN_BYTES:
+        return jax.device_put(leaf, sharding)
+    key = (id(leaf), sharding)
+    ent = _BCAST_CACHE.get(key)
+    if ent is not None:
+        ref, dev = ent
+        if ref() is leaf:
+            _BCAST_HITS += 1
+            return dev
+        _BCAST_CACHE.pop(key, None)  # id() recycled; never serve stale
+    dev = jax.device_put(leaf, sharding)
+    _BCAST_CACHE[key] = (
+        weakref.ref(leaf, lambda _ref: _BCAST_CACHE.pop(key, None)),
+        dev,
+    )
+    while len(_BCAST_CACHE) > _BCAST_MAX:
+        try:
+            _BCAST_CACHE.pop(next(iter(_BCAST_CACHE)))
+        except (KeyError, StopIteration):  # concurrent eviction
+            break
+    return dev
 
 
 class _RoundsExhausted(Exception):
