@@ -214,6 +214,17 @@ class TPUBackend(TaskBackend):
         """Task-axis extent: the number of task slots per round."""
         return self.mesh.shape[self.axis_name]
 
+    def _free_device_bytes(self):
+        """Free HBM on the first mesh device, or None where the backend
+        reports no stats (CPU virtual devices return None)."""
+        try:
+            stats = self.devices[0].memory_stats()
+        except Exception:
+            return None
+        if not stats or "bytes_limit" not in stats:
+            return None
+        return stats["bytes_limit"] - stats.get("bytes_in_use", 0)
+
     # generic host path (non-JAX estimators under a TPU backend still
     # fan out on host threads, like pyspark running a python closure)
     def run_tasks(self, fn, tasks, verbose=0):
@@ -280,6 +291,16 @@ class TPUBackend(TaskBackend):
             kernel, static_args, task_sharding, shared_shardings
         )
         put = lambda t: jax.device_put(t, task_sharding)
+        # Proactive round sizing (NOTES gap 5 closed): where the device
+        # reports memory stats, AOT-compile the round program and shrink
+        # the first round to fit BEFORE dispatch — a device OOM costs a
+        # wasted round and, on a flaky tunnel, risks a wedge. The
+        # reactive halving below stays as the backstop for workloads
+        # whose true footprint beats the linear estimate.
+        exec_fn, chunk = _aot_exec_fn(
+            fn, shared_args, task_args, chunk, d,
+            self._free_device_bytes(),
+        )
         # HBM-adaptive rounds: a round that exhausts device memory is
         # halved (device-count aligned) and the run RESUMES from the
         # first unfinished task — completed rounds are kept, not
@@ -296,7 +317,7 @@ class TPUBackend(TaskBackend):
             )
             try:
                 rounds_out.extend(_run_in_rounds(
-                    fn, sub, shared_args, n_tasks - offset, chunk,
+                    exec_fn, sub, shared_args, n_tasks - offset, chunk,
                     put=put, timings=timings, concat=False,
                 ))
                 break
@@ -322,7 +343,12 @@ class TPUBackend(TaskBackend):
 # evicts the entry (freeing the pinned device HBM) as soon as the host
 # array is collected, and a FIFO bound caps pinned HBM regardless.
 _BCAST_CACHE = {}
-_BCAST_MAX = 4
+# must exceed the number of >= _BCAST_MIN_BYTES leaves ONE fit places
+# (a CV fit's shared tree has 5: X, y, sw, train/test masks) or the
+# fit's own placement pass FIFO-evicts X before any refit can hit it;
+# eviction is LRU (hits refresh recency) so long-lived X outlives
+# transient per-fit leaves
+_BCAST_MAX = 16
 _BCAST_MIN_BYTES = 1 << 20  # caching tiny arrays is pure overhead
 _BCAST_HITS = 0  # diagnostics + test observability
 
@@ -342,6 +368,8 @@ def _cached_device_put(leaf, sharding, enabled):
         ref, dev = ent
         if ref() is leaf:
             _BCAST_HITS += 1
+            if _BCAST_CACHE.pop(key, None) is not None:  # LRU refresh
+                _BCAST_CACHE[key] = ent
             return dev
         _BCAST_CACHE.pop(key, None)  # id() recycled; never serve stale
     dev = jax.device_put(leaf, sharding)
@@ -469,6 +497,92 @@ def _leading_dim(task_args):
     if not leaves:
         raise ValueError("batched_map needs at least one task-axis array")
     return leaves[0].shape[0]
+
+
+#: AOT executables keyed by (jit fn, shared shape sig, chunk) — the jit
+#: fn itself is memoised in _JIT_CACHE, so this composes to the same
+#: lifetime jit's own compilation cache would have had
+_AOT_CACHE = {}
+
+
+def _shape_sig(tree):
+    import jax
+
+    return tuple(
+        (tuple(l.shape), str(l.dtype)) for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _aot_exec_fn(fn, shared_args, task_args, chunk, d, free_bytes,
+                 headroom=0.85):
+    """Return ``(exec_fn, chunk)`` for the round loop.
+
+    ``exec_fn(shared, task_slice)`` runs an AOT-compiled executable for
+    the slice's chunk size (compiled lazily per chunk, cached across
+    fits). When ``free_bytes`` is known, the requested chunk's program
+    is compiled up front and its ``memory_analysis()`` footprint
+    (temps + outputs + task arguments; shared arguments are already
+    device-resident and excluded from ``free_bytes``) is scaled
+    linearly per task to shrink the first round to ``headroom`` of free
+    memory — one extra compile at most, and none when the requested
+    chunk already fits.
+    """
+    import jax
+
+    if not hasattr(fn, "lower"):
+        # not an AOT-capable jit function (e.g. a test double): run it
+        # directly and rely on the reactive backstop alone
+        return fn, chunk
+
+    shared_sig = _shape_sig(shared_args)
+
+    def _compiled_for(n_chunk, task_like):
+        key = (fn, shared_sig, n_chunk)
+        comp = _AOT_CACHE.get(key)
+        if comp is None:
+            structs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    (n_chunk,) + tuple(a.shape[1:]), a.dtype
+                ),
+                task_like,
+            )
+            comp = fn.lower(shared_args, structs).compile()
+            _AOT_CACHE[key] = comp
+        return comp
+
+    def exec_fn(shared, sl):
+        n_chunk = _leading_dim(sl)
+        return _compiled_for(n_chunk, sl)(shared, sl)
+
+    if free_bytes is None or free_bytes <= 0:
+        return exec_fn, chunk
+
+    try:
+        ma = _compiled_for(chunk, task_args).memory_analysis()
+        task_arg_bytes = sum(
+            int(np.prod(l.shape[1:])) * l.dtype.itemsize * chunk
+            for l in jax.tree_util.tree_leaves(task_args)
+        )
+        needed = (
+            int(ma.temp_size_in_bytes)
+            + int(ma.output_size_in_bytes)
+            + task_arg_bytes
+        )
+    except Exception:
+        return exec_fn, chunk  # no analysis on this backend: reactive only
+
+    allowed = int(free_bytes * headroom)
+    if needed > allowed and chunk > d:
+        per_task = max(1, needed // chunk)
+        new_chunk = max(d, (allowed // per_task) // d * d)
+        if new_chunk < chunk:
+            warnings.warn(
+                f"batched_map: compiled round footprint ~{needed >> 20} MiB "
+                f"exceeds {allowed >> 20} MiB free; starting at "
+                f"round_size={new_chunk} (pass partitions to override)"
+            )
+            chunk = new_chunk
+    return exec_fn, chunk
 
 
 _JIT_CACHE = {}

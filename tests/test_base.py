@@ -311,3 +311,50 @@ def test_broadcast_cache_evicts_on_host_gc(monkeypatch):
     gc.collect()
     assert len(backend_mod._BCAST_CACHE) == 0, \
         "dead host array must not pin its device replica"
+
+
+def test_proactive_round_sizing(tpu_backend):
+    """_aot_exec_fn shrinks the first round (device-count aligned) when
+    the compiled footprint exceeds free memory, leaves it alone when
+    memory is ample, and its executables compute the same results the
+    plain jit path would."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from skdist_tpu.parallel import backend as backend_mod
+
+    bk = tpu_backend
+    mesh = bk.mesh
+    ts = NamedSharding(mesh, P(bk.axis_name))
+    rs = NamedSharding(mesh, P())
+
+    def kernel(shared, t):
+        return {"s": jnp.sum(shared["X"]) * t["c"]}
+
+    fn = backend_mod._jit_vmapped(kernel, None, ts, rs)
+    shared = jax.device_put({"X": np.ones((64, 8), np.float32)}, rs)
+    tasks = {"c": np.arange(32, dtype=np.float32)}
+    d = bk.n_devices
+
+    # ample memory: chunk untouched
+    exec_fn, chunk = backend_mod._aot_exec_fn(
+        fn, shared, tasks, 32, d, free_bytes=1 << 40
+    )
+    assert chunk == 32
+
+    # tiny budget: shrinks, stays a positive multiple of the device count
+    with pytest.warns(UserWarning, match="compiled round footprint"):
+        exec_fn2, chunk2 = backend_mod._aot_exec_fn(
+            fn, shared, tasks, 32, d, free_bytes=64
+        )
+    assert chunk2 < 32 and chunk2 >= d and chunk2 % d == 0
+
+    # executables agree with the plain jit call
+    sl = jax.device_put(
+        {"c": tasks["c"][:d]}, ts
+    )
+    np.testing.assert_allclose(
+        np.asarray(exec_fn(shared, sl)["s"]),
+        np.asarray(fn(shared, sl)["s"]),
+    )

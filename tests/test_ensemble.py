@@ -473,3 +473,31 @@ def test_hist_pallas_matches_scatter(clf_data):
         lambda kk: build_tree_kernel(hist_mode="pallas", **cfg)(Xb, Ych, kk)
     )(keys)
     assert trees["feat"].shape == (3, 31)
+
+
+def test_forest_bin_memo_engages_on_refit(clf_data, tpu_backend):
+    """With reuse_broadcast, a second fit on the same host X must reuse
+    the memoised binning (same Xb identity) and give identical trees;
+    without it the memo must stay cold."""
+    from skdist_tpu.distribute.ensemble import DistRandomForestClassifier
+    from skdist_tpu.models import forest as forest_mod
+    from skdist_tpu.parallel import TPUBackend
+
+    X, y = clf_data
+    forest_mod._BIN_MEMO.clear()
+    kw = dict(n_estimators=4, max_depth=4, random_state=0)
+    bk = TPUBackend(reuse_broadcast=True)
+    f1 = DistRandomForestClassifier(backend=bk, **kw).fit(X, y)
+    assert len(forest_mod._BIN_MEMO) == 1
+    key = next(iter(forest_mod._BIN_MEMO))
+    xb_first = forest_mod._BIN_MEMO[key][2]
+    assert xb_first is not None
+    f2 = DistRandomForestClassifier(backend=bk, **kw).fit(X, y)
+    assert forest_mod._BIN_MEMO[key][2] is xb_first, \
+        "refit on the same X must reuse the memoised Xb"
+    np.testing.assert_array_equal(f1.predict(X), f2.predict(X))
+
+    forest_mod._BIN_MEMO.clear()
+    DistRandomForestClassifier(backend=tpu_backend, **kw).fit(X, y)
+    assert len(forest_mod._BIN_MEMO) == 0, \
+        "memo must stay cold without reuse_broadcast"
