@@ -1,0 +1,125 @@
+"""Two-process SPMD smoke: the real multi-host code path on CPU.
+
+Each process contributes 2 virtual CPU devices (4 global); both run the
+SAME DistGridSearchCV over a ``multihost_task_mesh`` and print their
+mean_test_score vector. The parent compares the two processes' outputs
+to each other and to a single-process reference run.
+
+Usage: python build_tools/multiproc_smoke.py          # parent
+       (spawns itself with --child <pid> twice)
+"""
+
+import os
+import subprocess
+import sys
+
+PORT = int(os.environ.get("MULTIPROC_SMOKE_PORT", "12356"))
+
+
+def child(pid):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from skdist_tpu.parallel.mesh import initialize_cluster, multihost_task_mesh
+
+    initialize_cluster(
+        coordinator_address=f"localhost:{PORT}", num_processes=2,
+        process_id=pid,
+    )
+    mesh = multihost_task_mesh(data_axis_size=2)
+    assert jax.process_count() == 2
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "tasks": 2, "data": 2,
+    }, mesh.devices.shape
+
+    import numpy as np
+
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(120, 6)).astype(np.float32)
+    y = (X @ rng.normal(size=(6, 3)).astype(np.float32)).argmax(1)
+
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=20), {"C": [0.1, 1.0, 10.0]},
+        backend=TPUBackend(mesh=mesh), cv=3, scoring="accuracy",
+    ).fit(X, y)
+    print("SCORES", pid, list(np.round(gs.cv_results_["mean_test_score"], 6)),
+          flush=True)
+
+
+def single_reference():
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import numpy as np
+
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(120, 6)).astype(np.float32)
+    y = (X @ rng.normal(size=(6, 3)).astype(np.float32)).argmax(1)
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=20), {"C": [0.1, 1.0, 10.0]},
+        backend=TPUBackend(), cv=3, scoring="accuracy",
+    ).fit(X, y)
+    print("SCORES ref",
+          list(np.round(gs.cv_results_["mean_test_score"], 6)), flush=True)
+
+
+def main():
+    procs = [
+        subprocess.Popen(
+            [sys.executable, __file__, "--child", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    ok = True
+    for i, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out = "(timeout)"
+        outs.append(out)
+        if p.returncode != 0:
+            ok = False
+        print(f"--- child {i} rc={p.returncode}")
+        print(out[-2000:])
+    ref = subprocess.run(
+        [sys.executable, __file__, "--ref"], capture_output=True,
+        text=True, timeout=240,
+    )
+    print("---", ref.stdout.strip()[-200:])
+    score_lines = [
+        ln for out in outs for ln in out.splitlines() if ln.startswith("SCORES")
+    ]
+    ref_line = [ln for ln in ref.stdout.splitlines() if ln.startswith("SCORES")]
+    if not ok or len(score_lines) != 2 or not ref_line:
+        print("MULTIPROC SMOKE: FAIL")
+        sys.exit(1)
+    v0 = score_lines[0].split("[", 1)[1]
+    v1 = score_lines[1].split("[", 1)[1]
+    vr = ref_line[0].split("[", 1)[1]
+    assert v0 == v1 == vr, (v0, v1, vr)
+    print("MULTIPROC SMOKE: PASS (both processes match the single-process run)")
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child(int(sys.argv[sys.argv.index("--child") + 1]))
+    elif "--ref" in sys.argv:
+        single_reference()
+    else:
+        main()

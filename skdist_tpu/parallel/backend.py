@@ -398,6 +398,32 @@ class _RoundsExhausted(Exception):
         self.cause = cause
 
 
+def _gather_host(tree):
+    """collect(): device outputs → host numpy.
+
+    Single-process: plain ``device_get``. Multi-process SPMD: outputs
+    sharded over a mesh that spans processes are not fully addressable,
+    so each leaf is assembled with ``process_allgather`` (a collective
+    — safe because the round loop is replicated SPMD, every process
+    gathers the same leaves in the same order). This is the DCN leg of
+    the reference's ``collect()``: per-host shards ride the allgather,
+    and every host ends with the full result, which is what the
+    driver-side cv_results_ assembly expects.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    def one(x):
+        if getattr(x, "is_fully_addressable", True):
+            return jax.device_get(x)
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 def _concat_rounds(outs):
     import jax
 
@@ -457,7 +483,7 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
         # control back for a smaller-chunk resume
         for dev_out, keep, pad in pending:
             try:
-                out = jax.device_get(dev_out)
+                out = _gather_host(dev_out)
             except Exception:
                 break
             if timings is not None:
@@ -472,7 +498,7 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
 
     for dev_out, keep, pad in pending:
         try:
-            out = jax.device_get(dev_out)
+            out = _gather_host(dev_out)
         except Exception as exc:
             if "RESOURCE_EXHAUSTED" not in str(exc):
                 raise
