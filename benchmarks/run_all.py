@@ -83,12 +83,17 @@ def config_1_gridsearch(scale, ref):
 
     cold, _ = _timed(run)
     warm, gs = _timed(run)
+    from bench import _F32_HIGHEST_PASSES, lbfgs_fit_flops, mfu_fields
+
+    flops = lbfgs_fit_flops(int(0.8 * n), d, 20, 30) * 480
     out = {
         "config": "1: GridSearchCV LogReg 20news-shaped 96x5",
         "shape": [n, d, 20], "cold_s": round(cold, 2),
         "warm_s": round(warm, 2),
         "value": round(480 / warm, 2), "unit": "fits/sec",
         "best_score": float(gs.best_score_), "platform": _platform(),
+        **mfu_fields(flops / warm / 1e12, passes=_F32_HIGHEST_PASSES,
+                     basis="n_iter assumed = max_iter = 30"),
     }
     if ref:
         from sklearn.linear_model import LogisticRegression as SkLR
@@ -199,6 +204,18 @@ def config_4_forest(scale, ref):
         "value": round(256 / warm, 2), "unit": "trees/sec",
         "train_acc": acc, "platform": _platform(),
     }
+    from bench import forest_tree_flops, mfu_fields
+    from skdist_tpu.models.tree import resolve_hist_config
+
+    mode, _blk = resolve_hist_config(28, 32)
+    out["hist_mode"] = mode
+    if mode in ("matmul", "pallas"):
+        # binary classification: channels = 2 classes + count = 3; the
+        # one-hot contraction operands are exact at default (1-pass)
+        # matmul precision, so peak is the full bf16 number
+        flops = forest_tree_flops(n, 28, 32, 3, 8) * 256
+        out.update(mfu_fields(flops / warm / 1e12, passes=1,
+                              basis=f"hist_mode={mode}, depth 8"))
     if ref:
         from sklearn.ensemble import RandomForestClassifier as SkRF
 

@@ -83,6 +83,20 @@ def batch_predict(model, X, method="predict", backend=None,
     if batch_size is None:
         batch_size = max(1, min(n, 1 << 18))
 
+    sparse_groups = _sparse_row_groups(X, n)
+    if sparse_groups is not None:
+        # tall-sparse (e.g. 1M rows x 2**18 hashed cols): the full
+        # densified matrix can never exist, but each row group's can —
+        # stream groups through the normal path and concatenate.
+        # Group-local densification stays under the budget by
+        # construction, so as_dense_f32's guardrail never fires here.
+        outs = [
+            batch_predict(model, X[i:j], method=method, backend=backend,
+                          batch_size=batch_size)
+            for i, j in sparse_groups
+        ]
+        return np.concatenate(outs, axis=0)
+
     device_out = _try_device_predict(model, X, method, backend, batch_size)
     if device_out is not None:
         return device_out
@@ -96,6 +110,29 @@ def batch_predict(model, X, method="predict", backend=None,
     ]
     outs = backend.run_tasks(lambda c: np.asarray(fn(c)), chunks)
     return np.concatenate(outs, axis=0)
+
+
+def _sparse_row_groups(X, n):
+    """Row-group plan [(start, stop), ...] for a 2-D sparse X whose
+    densified whole would blow the memory budget; None when X is not
+    sparse or fits as-is. Groups target 1/8 of the budget (several
+    groups in flight: host staging + device replica + outputs)."""
+    if not (hasattr(X, "toarray") and hasattr(X, "tocsr")
+            and len(X.shape) == 2):
+        return None
+    from ..utils.meminfo import densify_budget_bytes
+
+    budget, _ = densify_budget_bytes()
+    if budget is None:
+        return None
+    d = int(X.shape[1])
+    est = int(n) * d * 4
+    if est <= budget // 2:
+        return None
+    rows = max(1, int(budget // 8) // max(d * 4, 1))
+    if rows >= n:
+        return None
+    return [(i, min(i + rows, n)) for i in range(0, n, rows)]
 
 
 def _try_device_predict(model, X, method, backend, batch_size):

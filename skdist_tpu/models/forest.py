@@ -15,6 +15,8 @@ wrappers (``distribute/ensemble.py``) shard the same axis over the TPU
 mesh via ``backend.batched_map``.
 """
 
+from functools import lru_cache
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -36,6 +38,7 @@ from .tree import (
     feature_importances_from_tree,
     n_tree_nodes,
     regression_channels,
+    resolve_hist_config,
     resolve_max_features,
     tree_predict_kernel,
 )
@@ -109,11 +112,35 @@ def _oob_aggregator(max_depth):
 def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
                             min_samples_split, min_samples_leaf,
                             min_impurity_decrease, extra, classification,
-                            bootstrap, hist_mode="auto"):
+                            bootstrap, hist_mode="auto", hist_block=None):
     """One-tree task kernel for ``backend.batched_map``: the task is a
     scalar PRNG seed (mirroring the reference's per-tree random states,
     ensemble.py:278). The seed is stored with the tree so OOB masks
-    (``_oob_aggregator``) regenerate the bootstrap draw on demand."""
+    (``_oob_aggregator``) regenerate the bootstrap draw on demand.
+
+    The kernel is MEMOISED on its full static config: ``_jit_vmapped``'s
+    compile cache keys on kernel identity, so handing back the same
+    closure for the same config is what lets a warm refit (or the next
+    forest in a grid) skip XLA compilation entirely — a fresh closure
+    per fit silently recompiled every forest. ``hist_mode="auto"`` is
+    resolved to a concrete (mode, block) BEFORE the memo key, so a
+    recalibration (the on-chip sweep writes one mid-process) still
+    takes effect on the next fit."""
+    hist_mode, hist_block = resolve_hist_config(
+        d, n_bins, hist_mode, hist_block
+    )
+    return _forest_kernel_cached(
+        d, n_bins, channels, max_depth, max_features, min_samples_split,
+        min_samples_leaf, min_impurity_decrease, extra, classification,
+        bootstrap, hist_mode, hist_block,
+    )
+
+
+@lru_cache(maxsize=64)
+def _forest_kernel_cached(d, n_bins, channels, max_depth, max_features,
+                          min_samples_split, min_samples_leaf,
+                          min_impurity_decrease, extra, classification,
+                          bootstrap, hist_mode, hist_block):
     grow = build_tree_kernel(
         n_features=d, n_bins=n_bins, channels=channels, max_depth=max_depth,
         max_features=max_features, min_samples_split=min_samples_split,
@@ -144,56 +171,64 @@ def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
     return kernel
 
 
-# (X identity, n_bins) -> (weakref(X), edges, Xb) — same identity +
-# weakref-validation scheme as the backend's broadcast cache: a recycled
-# id() can never serve stale bins, and collecting X evicts the entry
-_BIN_MEMO = {}
+# Two SEPARATE memos, same identity + weakref-validation scheme as the
+# backend's broadcast cache (a recycled id() can never serve stale
+# entries; collecting X evicts them):
+#   _EDGE_MEMO: (id(X), n_bins) -> (weakref(X), quantile edges) —
+#       written ONLY by _memo_edges, so it only ever holds edges that
+#       are quantile_bin_edges(X) for that exact X.
+#   _XB_MEMO:   (id(X), n_bins) -> (weakref(X), edges, Xb) — written
+#       by _memo_apply_bins with WHATEVER edges the caller passed
+#       (a warm_start refit legitimately applies inherited edges).
+# Keeping them separate closes the poisoning path where a warm-start
+# apply on a new X wrote its inherited edges where _memo_edges would
+# later serve them as X's own quantile edges, silently changing the
+# trees a subsequent fresh fit grows.
+_EDGE_MEMO = {}
+_XB_MEMO = {}
 _BIN_MEMO_MAX = 4
 
 
-def _memo_entry(X, n_bins, enabled):
+def _memo_lookup(memo, X, n_bins, enabled):
     if not enabled or not isinstance(X, np.ndarray):
         return None, None
     key = (id(X), int(n_bins))
-    ent = _BIN_MEMO.get(key)
+    ent = memo.get(key)
     if ent is not None:
         if ent[0]() is X:
             return key, ent
-        _BIN_MEMO.pop(key, None)
+        memo.pop(key, None)
     return key, None
 
 
-def _memo_store(key, X, edges, Xb):
+def _memo_store(memo, key, X, *values):
     import weakref
 
-    _BIN_MEMO[key] = (
-        weakref.ref(X, lambda _r: _BIN_MEMO.pop(key, None)), edges, Xb,
-    )
-    while len(_BIN_MEMO) > _BIN_MEMO_MAX:
+    memo[key] = (weakref.ref(X, lambda _r: memo.pop(key, None)), *values)
+    while len(memo) > _BIN_MEMO_MAX:
         try:
-            _BIN_MEMO.pop(next(iter(_BIN_MEMO)))
+            memo.pop(next(iter(memo)))
         except (KeyError, StopIteration):
             break
 
 
 def _memo_edges(X, n_bins, enabled):
-    key, ent = _memo_entry(X, n_bins, enabled)
+    key, ent = _memo_lookup(_EDGE_MEMO, X, n_bins, enabled)
     if ent is not None:
         return ent[1]
     edges = quantile_bin_edges(X, n_bins)
     if key is not None:
-        _memo_store(key, X, np.asarray(edges), None)
+        _memo_store(_EDGE_MEMO, key, X, np.asarray(edges))
     return edges
 
 
 def _memo_apply_bins(X, edges, n_bins, enabled):
-    key, ent = _memo_entry(X, n_bins, enabled)
-    if ent is not None and ent[2] is not None \
-            and np.array_equal(ent[1], edges):
+    key, ent = _memo_lookup(_XB_MEMO, X, n_bins, enabled)
+    if ent is not None and np.array_equal(ent[1], edges):
         return ent[2]
     Xb = np.asarray(apply_bins(jnp.asarray(X), jnp.asarray(edges)))
     if key is not None:
-        _memo_store(key, X, np.asarray(edges), Xb)
+        _memo_store(_XB_MEMO, key, X, np.asarray(edges), Xb)
     return Xb
 
 

@@ -51,8 +51,18 @@ def as_dense_f32(X):
     matrices go through the native multithreaded densifier
     (``native/densify.c``) — the zero-fill dominates scipy's
     single-threaded ``toarray`` at device-feeding sizes.
+
+    Guardrail: a sparse input whose densified form cannot fit the
+    tighter of available host RAM / free HBM (or the
+    ``SKDIST_DENSIFY_BUDGET_BYTES`` override) raises an informative
+    error up front instead of grinding into an OOM — real
+    ``HashingVectorizer`` widths (2**18+) on tall inputs are exactly
+    this case. Remedies are in the message; ``batch_predict`` avoids
+    the check entirely by streaming row groups.
     """
     if hasattr(X, "toarray"):  # scipy sparse
+        if len(X.shape) == 2:
+            _check_densify_budget(X.shape[0], X.shape[1])
         # 1-D sparse arrays (scipy >= 1.8 csr_array) have a 1-tuple
         # shape; only 2-D input takes the native CSR fast path
         if (hasattr(X, "tocsr") and len(X.shape) == 2
@@ -67,6 +77,33 @@ def as_dense_f32(X):
     if X.ndim == 1:
         X = X.reshape(-1, 1)
     return np.ascontiguousarray(X, dtype=np.float32)
+
+
+def _check_densify_budget(n_rows, n_cols):
+    """Refuse a densification that cannot fit, with remedies."""
+    from ..utils.meminfo import BUDGET_ENV, densify_budget_bytes
+
+    est = int(n_rows) * int(n_cols) * 4
+    budget, source = densify_budget_bytes()
+    if budget is None or est <= budget:
+        return
+
+    def _fmt(b):
+        return (f"{b / 1e9:.2f} GB" if b >= 1e8 else f"{b / 1e6:.1f} MB")
+
+    raise ValueError(
+        f"densifying this ({n_rows}, {n_cols}) sparse input needs "
+        f"~{_fmt(est)} as float32, but only ~{_fmt(budget)} "
+        f"is available ({source}). Hashed-text widths this large do not "
+        "belong on the device dense path. Options: (1) re-hash to a "
+        "bounded width — the Encoderizer configs cap HashingVectorizer "
+        "at 2**12..2**14 for exactly this reason (distribute/_defaults"
+        ".py); (2) for inference use distribute.batch_predict, which "
+        "streams sparse rows in groups and never materialises the full "
+        "dense matrix; (3) fit on a row subset or reduce features "
+        "first (TruncatedSVDTransformer); (4) raise the limit "
+        f"explicitly via {BUDGET_ENV} if you know better."
+    )
 
 
 def host_stage(x):

@@ -62,10 +62,46 @@ def n_tree_nodes(max_depth):
     return 2 ** (max_depth + 1) - 1
 
 
+def resolve_hist_config(n_features, n_bins, hist_mode="auto",
+                        hist_block=None):
+    """Concrete ``(hist_mode, hist_block)`` for this platform + shape.
+
+    ``"auto"`` takes the MEASURED per-platform winner from
+    ``models/hist_calib.json`` (written by ``build_tools/
+    tpu_tree_sweep.py``) with a width guard — matmul/pallas contract a
+    (n, d·B)-sized one-hot, so they degrade to scatter above the
+    calibrated ``d·B`` bound. Platforms with no calibration fall back
+    to the shape heuristic (matmul on accelerators at tabular widths).
+    Resolution happens OUTSIDE the kernel caches, so recalibrating
+    mid-process (the sweep does) takes effect on the next fit.
+    """
+    from .hist_calib import DEFAULT_MAX_MATMUL_DB, get_calibration
+
+    d, B = n_features, n_bins
+    calib = get_calibration(jax.default_backend())
+    if hist_mode == "auto":
+        if calib is not None:
+            hist_mode = calib["mode"]
+            if (hist_mode in ("matmul", "pallas")
+                    and d * B > calib.get(
+                        "max_matmul_db", DEFAULT_MAX_MATMUL_DB)):
+                hist_mode = "scatter"
+        else:
+            hist_mode = (
+                "matmul"
+                if jax.default_backend() != "cpu"
+                and d * B <= DEFAULT_MAX_MATMUL_DB
+                else "scatter"
+            )
+    if hist_block is None:
+        hist_block = (calib or {}).get("hist_block") or 8
+    return hist_mode, int(hist_block)
+
+
 def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
                       min_samples_split, min_samples_leaf,
                       min_impurity_decrease, extra, classification,
-                      hist_block=8, hist_mode="auto"):
+                      hist_block=None, hist_mode="auto"):
     """Returns ``kernel(Xb, Ych, key) -> tree`` growing one tree.
 
     - ``Xb`` (n, d) int32 binned features
@@ -96,19 +132,18 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
       (n, nl·C) is ever materialised in HBM. Off-TPU it runs through
       the Pallas interpreter (correct but slow; tests only). The
       compiled path assumes ``n_bins >= 8`` (TPU sublane tiling).
-    - ``"auto"``: matmul on accelerators, scatter on CPU.
+    - ``"auto"``: the MEASURED per-platform winner from
+      ``models/hist_calib.json`` (written by the on-chip sweep,
+      ``build_tools/tpu_tree_sweep.py``), with a width guard — matmul /
+      pallas degrade to scatter above the calibrated ``d·B`` bound.
+      Platforms with no calibration entry fall back to the shape
+      heuristic: matmul on accelerators for tabular widths, scatter
+      otherwise. ``hist_block=None`` likewise takes the calibrated
+      scatter block size.
     """
     d, B, C, D = n_features, n_bins, channels, max_depth
     K = C - 1 if classification else 1  # leaf output width
-    if hist_mode == "auto":
-        # matmul materialises a dense (n, d·B) one-hot; on wide data
-        # (hashed-text widths) that dwarfs HBM and its FLOPs scale with
-        # d·B, so auto only picks it for the tabular widths it wins at
-        hist_mode = (
-            "matmul"
-            if jax.default_backend() != "cpu" and d * B <= 16384
-            else "scatter"
-        )
+    hist_mode, hist_block = resolve_hist_config(d, B, hist_mode, hist_block)
     if hist_mode not in ("scatter", "matmul", "pallas"):
         raise ValueError(
             f"hist_mode must be 'auto', 'scatter', 'matmul' or 'pallas'; "

@@ -1,0 +1,73 @@
+"""Best-effort memory budgets for densification guardrails.
+
+The reference never densified: Spark broadcast chunks
+(``/root/reference/skdist/distribute/multiclass.py:35-62``) existed
+precisely because X was big. The TPU path densifies for the MXU, so it
+needs to know — BEFORE allocating — whether a densified sparse input
+can exist at all; an uninformative OOM minutes later on a flaky tunnel
+is the failure mode this prevents.
+"""
+
+import os
+import sys
+
+#: explicit operator override (bytes) for the densification budget
+BUDGET_ENV = "SKDIST_DENSIFY_BUDGET_BYTES"
+
+
+def available_host_bytes():
+    """Currently-available physical host memory, or None off-POSIX."""
+    try:
+        return os.sysconf("SC_AVPHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        return None
+
+
+def free_device_bytes_if_live():
+    """Free HBM on the default device — ONLY if a jax backend is
+    already initialised in this process. Never triggers device init
+    itself: this is called from host-side data plumbing that may run
+    before (or instead of) any device work, and initialising a wedged
+    tunnel from a shape check would be absurd."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return None
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:  # nothing initialised yet
+            return None
+        dev = jax_mod.devices()[0]
+        stats = dev.memory_stats()
+        if not stats:
+            return None
+        free = stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+        return free if free > 0 else None
+    except Exception:
+        return None
+
+
+def densify_budget_bytes():
+    """(budget, source_description) for a full densified allocation.
+
+    The binding constraint is the tighter of available host RAM (the
+    dense ndarray is built on host) and free HBM when a device backend
+    is live (fit paths place the whole X). Returns (None, "") when
+    nothing can be determined.
+    """
+    env = os.environ.get(BUDGET_ENV)
+    if env:
+        try:
+            return int(float(env)), f"{BUDGET_ENV} override"
+        except ValueError:
+            pass
+    candidates = []
+    host = available_host_bytes()
+    if host:
+        candidates.append((host, "available host RAM"))
+    dev = free_device_bytes_if_live()
+    if dev:
+        candidates.append((dev, "free device HBM"))
+    if not candidates:
+        return None, ""
+    return min(candidates)

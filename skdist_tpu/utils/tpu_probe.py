@@ -24,6 +24,12 @@ _PROBE_CODE = (
 # any backend (a parent holding the device would starve the child), and
 # a wedged device should cost its timeout once, not per entry point
 _RESULT = None
+# set the moment this module pins jax to CPU: once pinned, the
+# in-process platform IS cpu-fallback for the rest of the process no
+# matter what a later fresh probe observes, so _RESULT must never be
+# overwritten with a recovered tunnel's name (the recovered name is
+# still *returned* so orchestrators can dispatch fresh child processes)
+_PINNED = False
 
 
 def probe_platform_or_cpu(timeout=30, post_kill_wait=10, fresh=False):
@@ -36,13 +42,24 @@ def probe_platform_or_cpu(timeout=30, post_kill_wait=10, fresh=False):
     what conftest.py does, and paying the subprocess timeout there would
     be pure waste). The first call's verdict is memoised for the process;
     ``fresh=True`` re-probes (for long-lived orchestrators asking "is
-    the tunnel still alive NOW" — note it cannot un-pin a CPU fallback
-    already applied to this process's jax config).
+    the tunnel still alive NOW"). After a cpu-fallback pin a fresh probe
+    that finds a recovered tunnel returns the live platform name — the
+    caller can use it in fresh child processes — but the memo stays
+    'cpu-fallback', because this process's jax config remains pinned.
     """
-    global _RESULT
+    global _RESULT, _PINNED
     if _RESULT is not None and not fresh:
         return _RESULT
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # ALSO pin in-process: this environment's sitecustomize
+        # re-registers the axon plugin at interpreter start and can
+        # override the env var's platform choice, so "cpu" in the env
+        # does not by itself stop jax from initialising the (possibly
+        # wedged) tunnel backend on first device use. The config pin is
+        # authoritative; idempotent when cpu was already selected.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
         _RESULT = "cpu"
         return _RESULT
     # In-process cpu pin short-circuit — but NOT when the pin was
@@ -75,6 +92,11 @@ def probe_platform_or_cpu(timeout=30, post_kill_wait=10, fresh=False):
             with open(out_path) as f:
                 name = f.read().strip()
             if name:
+                if _PINNED:
+                    # tunnel recovered but this process is already
+                    # pinned to CPU: report liveness without letting
+                    # later memoised calls misread the in-process state
+                    return name
                 _RESULT = name
                 return _RESULT
             reason = "probe produced no platform name"
@@ -100,8 +122,10 @@ def probe_platform_or_cpu(timeout=30, post_kill_wait=10, fresh=False):
         f"[skdist_tpu] {reason}; falling back to CPU for this process",
         file=sys.stderr,
     )
-    import jax
+    if not _PINNED:
+        import jax
 
-    jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_platforms", "cpu")
+        _PINNED = True
     _RESULT = "cpu-fallback"
     return _RESULT

