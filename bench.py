@@ -107,6 +107,16 @@ def _persist_best(payload):
             if os.path.exists(_BEST_PATH):
                 with open(_BEST_PATH) as f:
                     best = json.load(f)
+            same_workload = best is not None and (
+                best.get("metric") == payload["metric"]
+                and best.get("aux", {}).get("n_fits")
+                == payload["aux"].get("n_fits")
+            )
+            if best is not None and not same_workload:
+                # the workload changed (the watcher re-runs after source
+                # edits): fits/sec across different workloads are
+                # incomparable — a stale best must not shadow fresh runs
+                best = None
             if best is None or payload["value"] > best.get("value", 0):
                 tmp = _BEST_PATH + ".tmp"
                 with open(tmp, "w") as f:

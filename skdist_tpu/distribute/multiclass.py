@@ -16,9 +16,10 @@ TPU-first design:
   program. Per-task label vectors are derived *on device* from the
   shared label matrix (``y_bin = Y[:, c]``); OvO's per-pair row subsets
   — shape-dynamic in the reference — become 0/1 sample-weight masks
-  (SURVEY §7.3 hard part 1). Negative down-sampling is a Bernoulli
-  weight mask drawn from a per-task PRNG stream (probabilistic, vs the
-  reference's exact subsample — documented divergence).
+  (SURVEY §7.3 hard part 1). Negative down-sampling is EXACT: per-class
+  keep masks with the host path's target arithmetic and RandomState
+  draw are precomputed on host and ride the task axis, so both paths
+  of one estimator share sampling semantics.
 - **generic path**: any sklearn-compatible estimator, one host task per
   class/pair with exact reference semantics (exact down-sampling,
   ConstantPredictor fallback, best_estimator_ unwrapping).
@@ -327,30 +328,23 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         hyper = {
             k: np.float32(getattr(est, k)) for k in type(est)._hyper_names
         }
-        max_negatives, method = self.max_negatives, self.method
-        seed = self.random_state if self.random_state is not None else 0
+        max_negatives = self.max_negatives
+        use_masks = max_negatives is not None
 
         def kernel(shared, task):
             y_bin = shared["Y"][:, task["cls"]]
             w = shared["sw"]
-            if max_negatives is not None:
-                # Bernoulli analogue of the reference's exact subsample
-                key = jax.random.fold_in(jax.random.PRNGKey(seed), task["cls"])
-                pos = y_bin == 1
-                n_pos = jnp.sum(pos)
-                n_neg = jnp.sum(~pos)
-                if method == "multiplier":
-                    target = max_negatives * n_pos
-                else:
-                    target = (
-                        float(max_negatives) * n_neg
-                        if isinstance(max_negatives, float)
-                        else jnp.float32(max_negatives)
-                    )
-                p_keep = jnp.clip(target / jnp.maximum(n_neg, 1), 0.0, 1.0)
-                r = jax.random.uniform(key, w.shape)
-                keep = pos | (r < p_keep)
-                w = w * keep
+            if use_masks:
+                # EXACT down-sampling: per-class keep masks are
+                # precomputed on host with the same target arithmetic
+                # and RandomState draw as the host path's
+                # _negatives_mask (reference multiclass.py:76-106) and
+                # ride the task axis — zero-weighting a row is
+                # equivalent to dropping it for the weighted solvers.
+                # (Replaces the round-2 Bernoulli approximation, whose
+                # sampling semantics silently differed from the host
+                # path of the same estimator.)
+                w = w * task["keep"]
             return fit_kernel(
                 shared["X"], y_bin, w, shared["hyper"], shared["aux"]
             )
@@ -365,6 +359,8 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         estimators = [None] * n_classes
         if live.size:
             task_args = {"cls": live.astype(np.int32)}
+            if use_masks:
+                task_args["keep"] = self._exact_keep_masks(Y, live)
             from ..parallel import row_sharded_specs
 
             stacked = backend.batched_map(
@@ -387,6 +383,42 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
             estimators[cls_idx] = cp
         self.estimators_ = estimators
         return True
+
+    def _exact_keep_masks(self, Y, live):
+        """(n_live, n) f32 keep weights mirroring ``_negatives_mask``:
+        per class, all positives kept plus an EXACT uniform
+        without-replacement draw of the target number of negatives,
+        from a fresh RandomState(random_state) per class — the same
+        construction the host path performs per binary fit."""
+        n = Y.shape[0]
+        keep = np.ones((live.size, n), dtype=np.float32)
+        for i, cls in enumerate(live):
+            y_bin = np.asarray(Y[:, cls])
+            pos_mask = y_bin == 1
+            n_pos = int(pos_mask.sum())
+            n_neg = n - n_pos
+            if self.method == "ratio":
+                target = (
+                    self.max_negatives
+                    if isinstance(self.max_negatives, int)
+                    else int(round(self.max_negatives * n_neg))
+                )
+            elif self.method == "multiplier":
+                target = int(self.max_negatives * n_pos)
+            else:
+                raise ValueError(
+                    "Unknown method. Options are 'ratio' or 'multiplier'."
+                )
+            if target >= n_neg:
+                continue
+            rng = np.random.RandomState(self.random_state)
+            neg_idx = np.where(~pos_mask)[0]
+            keep_neg = rng.choice(neg_idx, size=target, replace=False)
+            mask = np.zeros(n, dtype=np.float32)
+            mask[pos_mask] = 1.0
+            mask[keep_neg] = 1.0
+            keep[i] = mask
+        return keep
 
     def _col_label(self, col_idx):
         """Original class label for column ``col_idx`` of the (possibly

@@ -90,6 +90,7 @@ def batch_predict(model, X, method="predict", backend=None,
         # stream groups through the normal path and concatenate.
         # Group-local densification stays under the budget by
         # construction, so as_dense_f32's guardrail never fires here.
+        X = X.tocsr()  # coo & friends don't support row slicing
         outs = [
             batch_predict(model, X[i:j], method=method, backend=backend,
                           batch_size=batch_size)

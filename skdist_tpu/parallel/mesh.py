@@ -68,18 +68,35 @@ def multihost_task_mesh(data_axis_size=None):
     to :func:`task_data_mesh`; in a genuine multi-host run any
     construction failure propagates loudly instead of silently falling
     back to a single-host mesh (which would wedge the SPMD program the
-    moment other hosts enter the collective)."""
+    moment other hosts enter the collective).
+
+    ``data_axis_size`` may exceed the local device count when it is a
+    multiple of it: the 'data' axis then SPANS processes (e.g. 4 hosts
+    × 2 devices with ``data_axis_size=4`` → each fit's row sharding
+    crosses 2 hosts). Per-fit reductions (gram/gradient psums) then
+    ride DCN for the cross-host hop — legitimate when X is too big for
+    one host's devices, but prefer keeping 'data' within a host and
+    fanning 'tasks' across hosts when the workload allows it.
+    """
     import jax
 
     local = jax.local_device_count()
     if data_axis_size is None:
         data_axis_size = local
-    if data_axis_size < 1 or local % data_axis_size != 0:
+    n_hosts = jax.process_count()
+    n_global = local * n_hosts
+    within_host = data_axis_size >= 1 and local % data_axis_size == 0
+    cross_host = (
+        data_axis_size > local
+        and data_axis_size % local == 0
+        and n_global % data_axis_size == 0
+    )
+    if not (within_host or cross_host):
         raise ValueError(
             f"data_axis_size={data_axis_size} must divide the local "
-            f"device count {local}"
+            f"device count {local}, or be a multiple of it that divides "
+            f"the global device count {n_global}"
         )
-    n_hosts = jax.process_count()
     if n_hosts == 1:
         return task_data_mesh(data_axis_size=data_axis_size)
     from jax.sharding import Mesh
@@ -87,10 +104,11 @@ def multihost_task_mesh(data_axis_size=None):
     # Deterministic construction (create_hybrid_device_mesh assumes
     # slice-granule topologies and rejects common pod slices): order
     # the global devices by (process, device id) so each contiguous
-    # data_axis_size group lives inside ONE process — 'data'-axis
-    # collectives (gram/gradient psums) ride ICI; the 'tasks' axis
-    # spans processes over DCN, which is fine because tasks never talk
-    # to each other.
+    # data_axis_size group covers whole processes — within-host groups
+    # keep 'data'-axis collectives (gram/gradient psums) on ICI; a
+    # cross-host group spans the minimal number of adjacent processes.
+    # The 'tasks' axis spans processes over DCN, which is fine because
+    # tasks never talk to each other.
     devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
     arr = np.array(devices).reshape(-1, data_axis_size)
     return Mesh(arr, ("tasks", "data"))

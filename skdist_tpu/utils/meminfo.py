@@ -9,7 +9,6 @@ is the failure mode this prevents.
 """
 
 import os
-import sys
 
 #: explicit operator override (bytes) for the densification budget
 BUDGET_ENV = "SKDIST_DENSIFY_BUDGET_BYTES"
@@ -23,37 +22,17 @@ def available_host_bytes():
         return None
 
 
-def free_device_bytes_if_live():
-    """Free HBM on the default device — ONLY if a jax backend is
-    already initialised in this process. Never triggers device init
-    itself: this is called from host-side data plumbing that may run
-    before (or instead of) any device work, and initialising a wedged
-    tunnel from a shape check would be absurd."""
-    jax_mod = sys.modules.get("jax")
-    if jax_mod is None:
-        return None
-    try:
-        from jax._src import xla_bridge
-
-        if not xla_bridge._backends:  # nothing initialised yet
-            return None
-        dev = jax_mod.devices()[0]
-        stats = dev.memory_stats()
-        if not stats:
-            return None
-        free = stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
-        return free if free > 0 else None
-    except Exception:
-        return None
-
-
 def densify_budget_bytes():
     """(budget, source_description) for a full densified allocation.
 
-    The binding constraint is the tighter of available host RAM (the
-    dense ndarray is built on host) and free HBM when a device backend
-    is live (fit paths place the whole X). Returns (None, "") when
-    nothing can be determined.
+    The binding constraint is available host RAM: the dense ndarray is
+    built on host, and host feasibility is a prerequisite for every
+    downstream path. Free HBM is deliberately NOT part of the bound —
+    a mesh with a 'data' axis row-shards X across devices, so one
+    device's free HBM is the wrong ceiling (it would reject multi-chip
+    fits that are fine); device-side fitting is the job of the
+    backend's AOT memory-analysis round sizing and its OOM backstop.
+    Returns (None, "") when nothing can be determined.
     """
     env = os.environ.get(BUDGET_ENV)
     if env:
@@ -61,13 +40,7 @@ def densify_budget_bytes():
             return int(float(env)), f"{BUDGET_ENV} override"
         except ValueError:
             pass
-    candidates = []
     host = available_host_bytes()
     if host:
-        candidates.append((host, "available host RAM"))
-    dev = free_device_bytes_if_live()
-    if dev:
-        candidates.append((dev, "free device HBM"))
-    if not candidates:
-        return None, ""
-    return min(candidates)
+        return host, "available host RAM"
+    return None, ""

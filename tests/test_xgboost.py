@@ -1,0 +1,37 @@
+"""External-estimator tier: xgboost under the generic backend path
+(reference ``skdist/tests/test_spark.py:165-187`` — the reference's
+last test tier, gated on xgboost exactly as here).
+
+xgboost is not in the baked environment, so this normally skips; it
+runs wherever a user installs xgboost, proving arbitrary third-party
+sklearn-API estimators ride ``backend.run_tasks`` with fit_params
+(early stopping + eval_set) passed through per fold.
+"""
+
+import numpy as np
+import pytest
+
+xgboost = pytest.importorskip("xgboost")
+
+
+def test_xgboost_randomized_search_with_early_stopping():
+    from skdist_tpu.distribute.search import DistRandomizedSearchCV
+
+    X = np.array([[1, 1, 1], [0, 0, 0], [-1, -1, -1]] * 100, dtype=np.float32)
+    y = np.array([0, 0, 1] * 100)
+    X_test = np.array([[1, 1, 0], [-2, 0, 5], [1, 1, 1]] * 10,
+                      dtype=np.float32)
+    y_test = np.array([1, 1, 0] * 10)
+
+    clf = DistRandomizedSearchCV(
+        xgboost.XGBClassifier(
+            eval_metric="logloss", early_stopping_rounds=10,
+        ),
+        {"max_depth": [3, 5]}, cv=3, n_iter=2, random_state=0,
+    )
+    # eval_set is a fit_params passthrough; the per-fold slicer must
+    # leave non-row-aligned params (a list of tuples) untouched
+    clf.fit(X, y, eval_set=[(X_test, y_test)])
+    preds = clf.predict(X[:3])
+    assert np.allclose(preds, np.array([0, 0, 1]))
+    assert hasattr(clf, "best_score_")
