@@ -83,13 +83,21 @@ def batch_predict(model, X, method="predict", backend=None,
     if batch_size is None:
         batch_size = max(1, min(n, 1 << 18))
 
+    if _is_sparse_2d(X):
+        device_out = _try_device_predict_sparse(
+            model, X, method, backend, batch_size
+        )
+        if device_out is not None:
+            return device_out
+
     sparse_groups = _sparse_row_groups(X, n)
     if sparse_groups is not None:
-        # tall-sparse (e.g. 1M rows x 2**18 hashed cols): the full
-        # densified matrix can never exist, but each row group's can —
-        # stream groups through the normal path and concatenate.
-        # Group-local densification stays under the budget by
-        # construction, so as_dense_f32's guardrail never fires here.
+        # tall-sparse input headed for a HOST model whose densified
+        # whole would blow the budget: the full dense matrix can never
+        # exist, but each row group's can — stream groups through the
+        # normal path and concatenate. Group-local densification stays
+        # under the budget by construction, so as_dense_f32's guardrail
+        # never fires here.
         X = X.tocsr()  # coo & friends don't support row slicing
         outs = [
             batch_predict(model, X[i:j], method=method, backend=backend,
@@ -111,6 +119,111 @@ def batch_predict(model, X, method="predict", backend=None,
     ]
     outs = backend.run_tasks(lambda c: np.asarray(fn(c)), chunks)
     return np.concatenate(outs, axis=0)
+
+
+def _is_sparse_2d(X):
+    return (hasattr(X, "toarray") and hasattr(X, "tocsr")
+            and len(X.shape) == 2)
+
+
+def _pack_csr_rows(X):
+    """CSR → (idx (n, m) int32, val (n, m) f32), m = max nnz per row,
+    padded with (0, 0.0). The device-side scatter reconstructs each
+    row exactly: padding adds 0.0 to column 0."""
+    indptr = np.asarray(X.indptr)
+    nnz = np.diff(indptr)
+    m = max(1, int(nnz.max()) if nnz.size else 1)
+    n = X.shape[0]
+    pos = indptr[:-1, None] + np.arange(m)[None, :]
+    mask = np.arange(m)[None, :] < nnz[:, None]
+    idx = np.zeros((n, m), np.int32)
+    val = np.zeros((n, m), np.float32)
+    idx[mask] = np.asarray(X.indices)[pos[mask]]
+    val[mask] = np.asarray(X.data)[pos[mask]]
+    return idx, val
+
+
+def _try_device_predict_sparse(model, X, method, backend, batch_size):
+    """Device CSR path for sparse inference (VERDICT round-2 item 5):
+    ship only (idx, val) — 2·nnz·4 bytes, not n·d·4 — and rebuild each
+    row block ON DEVICE with one scatter-add, then run the model's
+    existing decision/proba kernel on the dense block (the matmul stays
+    on the MXU; the host never materialises anything (n, d)-sized).
+    Returns None when the model has no device kernels, handing over to
+    the host paths. Rows with wildly skewed nnz pay padding to the max
+    row; hashed-text rows are near-uniform, the target workload.
+    """
+    if not hasattr(model, "_params") or not hasattr(model, "_meta"):
+        return None
+    from ..models.linear import _freeze, get_kernel
+    import jax
+    import jax.numpy as jnp
+
+    which = "proba" if method == "predict_proba" else "decision"
+    try:
+        kernel = get_kernel(
+            type(model), which, model._meta,
+            _freeze(model._static_config(model._meta)),
+        )
+    except AttributeError:
+        return None
+
+    X = X.tocsr()
+    n, d = X.shape
+    idx, val = _pack_csr_rows(X)
+    m = idx.shape[1]
+
+    # bound the packed task tensors the same way the dense streaming
+    # bounds densified groups: (idx+val) is n·m·8 bytes
+    from ..utils.meminfo import densify_budget_bytes
+
+    budget, _ = densify_budget_bytes()
+    if budget is not None and n * m * 8 > budget // 2:
+        rows = max(1, int(budget // 8) // max(m * 8, 1))
+        if rows < n:
+            outs = [
+                _try_device_predict_sparse(
+                    model, X[i:min(i + rows, n)], method, backend,
+                    batch_size)
+                for i in range(0, n, rows)
+            ]
+            return np.concatenate(outs, axis=0)
+
+    block = min(batch_size, max(1, n))
+    n_blocks = -(-n // block)
+    pad = n_blocks * block - n
+    if pad:
+        idx = np.concatenate([idx, np.zeros((pad, m), idx.dtype)])
+        val = np.concatenate([val, np.zeros((pad, m), val.dtype)])
+    idx = idx.reshape(n_blocks, block, m)
+    val = val.reshape(n_blocks, block, m)
+
+    params = jax.tree_util.tree_map(jnp.asarray, model._params)
+    rows_iota = np.arange(block)
+
+    def block_kernel(shared, task):
+        dense = jnp.zeros((block, d), jnp.float32).at[
+            rows_iota[:, None], task["idx"]
+        ].add(task["val"])
+        return {"out": kernel(shared["params"], dense)}
+
+    out = backend.batched_map(
+        block_kernel, {"idx": idx, "val": val}, {"params": params}
+    )["out"]
+    out = out.reshape(-1, *out.shape[2:])[:n]
+    return _postprocess_predict(model, out, method)
+
+
+def _postprocess_predict(model, out, method):
+    if method == "predict":
+        if getattr(model, "_estimator_type", None) == "classifier":
+            if out.ndim == 1:
+                idx = (out > 0).astype(np.int64)
+            else:
+                idx = np.argmax(out, axis=1)
+            return model.classes_[idx]
+        return out
+    return out
 
 
 def _sparse_row_groups(X, n):

@@ -131,14 +131,17 @@ def test_sparse_width_guardrail(monkeypatch):
 
 def test_batch_predict_streams_sparse_groups(clf_data, tpu_backend,
                                              monkeypatch):
-    """Over-budget sparse inference must stream row groups and match
-    the un-chunked result exactly."""
+    """Over-budget sparse inference headed for a HOST model must stream
+    row groups and match the un-chunked result exactly (device models
+    take the CSR device path instead — covered separately)."""
     import scipy.sparse as sp
+
+    from sklearn.linear_model import LogisticRegression as SkLR
 
     from skdist_tpu.utils.meminfo import BUDGET_ENV
 
     X, y = clf_data
-    model = LogisticRegression(max_iter=100).fit(X, y)
+    model = SkLR(max_iter=200).fit(X, y)
     Xs = sp.csr_matrix(X)
     expected = model.predict_proba(X)
 
@@ -154,3 +157,48 @@ def test_batch_predict_streams_sparse_groups(clf_data, tpu_backend,
     out = batch_predict(model, Xs, method="predict_proba",
                         backend=tpu_backend)
     np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_device_csr_predict_matches_dense(clf_data, tpu_backend):
+    """The CSR device path (pack idx/val, scatter-rebuild on device,
+    existing kernel on the dense block) must match dense inference
+    exactly, for both proba and predict, including empty rows."""
+    import scipy.sparse as sp
+
+    from skdist_tpu.distribute.predict import (
+        _pack_csr_rows,
+        _try_device_predict_sparse,
+    )
+
+    X, y = clf_data
+    X = (X * (np.abs(X) > 0.5)).astype(np.float32)  # make it sparse
+    model = LogisticRegression(max_iter=100).fit(X, y)
+    Xs = sp.csr_matrix(X)
+
+    idx, val = _pack_csr_rows(Xs)
+    assert idx.shape == val.shape
+    assert idx.shape[1] == int(np.diff(Xs.indptr).max())
+
+    out = _try_device_predict_sparse(
+        model, Xs, "predict_proba", tpu_backend, batch_size=64
+    )
+    np.testing.assert_allclose(out, model.predict_proba(X), atol=1e-5)
+    preds = _try_device_predict_sparse(
+        model, Xs, "predict", tpu_backend, batch_size=64
+    )
+    assert (preds == model.predict(X)).all()
+
+    # all-empty matrix: max nnz clamps to 1, output well-formed
+    Xz = sp.csr_matrix(X.shape, dtype=np.float32)
+    out = _try_device_predict_sparse(
+        model, Xz, "predict_proba", tpu_backend, batch_size=64
+    )
+    assert out.shape == (X.shape[0], len(np.unique(y)))
+
+    # host models hand back None (no device kernels)
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    sk = SkLR(max_iter=100).fit(X, y)
+    assert _try_device_predict_sparse(
+        sk, Xs, "predict", tpu_backend, 64
+    ) is None
