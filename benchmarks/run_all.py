@@ -85,15 +85,17 @@ def config_1_gridsearch(scale, ref):
     warm, gs = _timed(run)
     from bench import _F32_HIGHEST_PASSES, lbfgs_fit_flops, mfu_fields
 
+    platform = _platform()
     flops = lbfgs_fit_flops(int(0.8 * n), d, 20, 30) * 480
     out = {
         "config": "1: GridSearchCV LogReg 20news-shaped 96x5",
         "shape": [n, d, 20], "cold_s": round(cold, 2),
         "warm_s": round(warm, 2),
         "value": round(480 / warm, 2), "unit": "fits/sec",
-        "best_score": float(gs.best_score_), "platform": _platform(),
+        "best_score": float(gs.best_score_), "platform": platform,
         **mfu_fields(flops / warm / 1e12, passes=_F32_HIGHEST_PASSES,
-                     basis="n_iter assumed = max_iter = 30"),
+                     basis="n_iter assumed = max_iter = 30",
+                     platform=platform),
     }
     if ref:
         from sklearn.linear_model import LogisticRegression as SkLR
@@ -197,12 +199,13 @@ def config_4_forest(scale, ref):
     cold, _ = _timed(run)
     warm, rf = _timed(run)
     acc = float(np.mean(rf.predict(X) == y))
+    platform = _platform()
     out = {
         "config": "4: RandomForest 256 trees HIGGS-shaped",
         "shape": [n, 28, 2], "cold_s": round(cold, 2),
         "warm_s": round(warm, 2),
         "value": round(256 / warm, 2), "unit": "trees/sec",
-        "train_acc": acc, "platform": _platform(),
+        "train_acc": acc, "platform": platform,
     }
     from bench import forest_tree_flops, mfu_fields
     from skdist_tpu.models.tree import resolve_hist_config
@@ -215,7 +218,8 @@ def config_4_forest(scale, ref):
         # matmul precision, so peak is the full bf16 number
         flops = forest_tree_flops(n, 28, 32, 3, 8) * 256
         out.update(mfu_fields(flops / warm / 1e12, passes=1,
-                              basis=f"hist_mode={mode}, depth 8"))
+                              basis=f"hist_mode={mode}, depth 8",
+                              platform=platform))
     if ref:
         from sklearn.ensemble import RandomForestClassifier as SkRF
 

@@ -501,14 +501,17 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
     outs = []
     consumed = 0
     pending = []
+    in_gather = False
 
     def _oom(exc):
         return _RoundsExhausted(outs, consumed, exc)
 
     def _gather_oldest():
-        nonlocal t_prev, consumed
+        nonlocal t_prev, consumed, in_gather
         dev_out, keep, pad = pending.pop(0)
+        in_gather = True
         out = _gather_host(dev_out)
+        in_gather = False
         if timings is not None:
             now = time.perf_counter()
             timings.append((now - t_prev, keep))
@@ -540,13 +543,27 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
     except Exception as exc:
         if "RESOURCE_EXHAUSTED" not in str(exc):
             raise
-        # gather whatever was dispatched before the failure, then hand
-        # control back for a smaller-chunk resume
-        while pending:
-            try:
-                _gather_oldest()
-            except Exception:
-                break
+        # _RoundsExhausted.completed is consumed by batched_map as a
+        # CONTIGUOUS task prefix (offset += consumed), so what may be
+        # salvaged depends on where the failure surfaced:
+        if in_gather:
+            # inside _gather_oldest (the normal case under async
+            # dispatch): the failed round was already popped, so every
+            # round still pending comes AFTER the gap — gathering it
+            # into outs would silently misalign later outputs to
+            # earlier tasks (round-3 advisor, high). Drop them; the
+            # resume re-runs from the first missing task.
+            pending.clear()
+        else:
+            # at dispatch: everything pending precedes the failed
+            # round — gather it to extend the contiguous prefix,
+            # stopping at the first round that itself fails
+            while pending:
+                try:
+                    _gather_oldest()
+                except Exception:
+                    pending.clear()
+                    break
         raise _oom(exc) from None
     if not concat:
         return outs

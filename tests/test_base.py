@@ -202,6 +202,42 @@ def test_batched_map_oom_resumes_from_completed_rounds(tpu_backend,
     assert sum(keep for _, keep in timings) == 32
 
 
+def test_batched_map_oom_in_gather_keeps_prefix_contiguous(tpu_backend,
+                                                          monkeypatch):
+    """An OOM that surfaces inside the GATHER of a round (the normal
+    case under async dispatch) must not let later pending rounds slide
+    into the completed prefix: the failed round was already popped, so
+    draining the queue would misalign later outputs to earlier tasks
+    and the resume would silently skip the failed round's tasks
+    (round-3 advisor, high)."""
+    import jax
+
+    from skdist_tpu.parallel import backend as backend_mod
+
+    real_gather = backend_mod._gather_host
+    blown = []
+
+    def fussy_gather(tree):
+        out = real_gather(tree)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        # blow up once, on the gather of the SECOND 16-task round
+        # (tasks 16-31, first output 2*16=32) while round 3 is pending
+        if not blown and leaf.shape[0] == 16 and float(leaf[0]) == 32.0:
+            blown.append(True)
+            raise RuntimeError("RESOURCE_EXHAUSTED (simulated, gather)")
+        return out
+
+    monkeypatch.setattr(backend_mod, "_gather_host", fussy_gather)
+    tasks = {"x": np.arange(64, dtype=np.float32)}
+    out = tpu_backend.batched_map(
+        lambda shared, t: {"y": t["x"] * 2.0}, tasks, round_size=16,
+    )
+    assert blown, "the simulated gather failure never fired"
+    # every task's output at its own position — the buggy drain put
+    # round 3's outputs at round 2's task offsets
+    np.testing.assert_allclose(out["y"], np.arange(64) * 2.0)
+
+
 def test_cached_device_put_reuse_and_safety():
     """reuse_broadcast cache: (a) same host array + sharding returns the
     SAME device buffer; (b) an entry whose weakref no longer targets the
