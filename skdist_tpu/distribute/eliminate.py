@@ -225,8 +225,10 @@ class DistFeatureEliminator(BaseEstimator):
         base_kernel = _cached_cv_kernel(
             type(est), meta, static, scorer_specs, False
         )
+        from ..models.linear import hyper_float
+
         hyper = {
-            k: np.float32(getattr(est, k)) for k in type(est)._hyper_names
+            k: hyper_float(getattr(est, k)) for k in type(est)._hyper_names
         }
 
         def kernel(shared, task):

@@ -448,7 +448,9 @@ class DistBaseSearchCV(BaseEstimator):
         if needs_proba and not hasattr(type(estimator), "_build_proba_kernel"):
             return None
 
-        from ..models.linear import as_dense_f32, _freeze, extract_aux
+        from ..models.linear import (
+            as_dense_f32, _freeze, extract_aux, hyper_float,
+        )
         import jax.numpy as jnp
 
         try:
@@ -506,9 +508,9 @@ class DistBaseSearchCV(BaseEstimator):
                 cand = candidate_params[cand_idx]
                 for s in range(n_splits):
                     for name in hyper_names:
-                        task_hyper[name].append(
-                            float(cand.get(name, getattr(bucket_est, name)))
-                        )
+                        task_hyper[name].append(float(hyper_float(
+                            cand.get(name, getattr(bucket_est, name))
+                        )))
                     split_ids.append(s)
             task_args = {
                 "hyper": {

@@ -122,19 +122,35 @@ def lbfgs_minimize(fun, w0, max_iter=100, tol=1e-4, history=10, max_ls=20):
 
 
 def sgd_minimize(grad_fn, w0, n_samples, key, max_epochs, batch_size,
-                 learning_rate_fn, shuffle=True):
+                 learning_rate_fn, shuffle=True, loss_fn=None, tol=None,
+                 n_iter_no_change=5):
     """Mini-batch SGD with per-step learning-rate schedule.
 
     ``grad_fn(w, idx) -> grad`` computes the (penalised) gradient on the
     sample index batch ``idx``. Fixed-shape batches: ``n_samples`` is
     padded up to a multiple of ``batch_size`` with wrap-around indices —
     acceptable for the stochastic setting and keeps shapes static.
+
+    Early stopping (sklearn ``SGDClassifier``'s no-validation rule):
+    when ``loss_fn(w, idx) -> weighted mean batch loss`` and ``tol`` (a
+    traced scalar is fine — it may ride a vmapped hyper axis) are
+    given, the mean per-batch training loss of each epoch is tracked;
+    an epoch that fails to beat ``best_loss - tol`` counts against
+    ``n_iter_no_change``, and once the count is reached the lane
+    FREEZES — the scan still runs ``max_epochs`` iterations (static
+    shape, vmap-batchable), but stopped lanes keep their weights, so
+    ``tol`` semantics hold per task without dynamic trip counts. A
+    ``tol`` of ``-inf`` (the mapping for sklearn's ``tol=None``) never
+    triggers and reproduces the fixed-epoch behaviour.
+
+    Returns ``(w, n_epochs_run)``.
     """
     n_batches = -(-n_samples // batch_size)
     padded = n_batches * batch_size
+    track = loss_fn is not None and tol is not None
 
     def epoch(carry, ekey):
-        w, step = carry
+        w, step, best, bad, stopped, n_done = carry
         if shuffle:
             perm = jax.random.permutation(ekey, padded) % n_samples
         else:
@@ -142,14 +158,37 @@ def sgd_minimize(grad_fn, w0, n_samples, key, max_epochs, batch_size,
         batches = perm.reshape(n_batches, batch_size)
 
         def one(carry, idx):
-            w, step = carry
+            w, step, acc = carry
             g = grad_fn(w, idx)
             lr = learning_rate_fn(step)
-            return (w - lr * g, step + 1), None
+            w_new = w - lr * g
+            if track:
+                acc = acc + loss_fn(w_new, idx)
+            return (w_new, step + 1, acc), None
 
-        (w, step), _ = lax.scan(one, (w, step), batches)
-        return (w, step), None
+        (w_new, step_new, acc), _ = lax.scan(
+            one, (w, step, jnp.float32(0.0)), batches
+        )
+        if not track:
+            return (w_new, step_new, best, bad, stopped,
+                    n_done + 1), None
+        loss = acc / n_batches
+        improved = loss < best - tol
+        bad_new = jnp.where(improved, 0, bad + 1)
+        newly_stopped = bad_new >= n_iter_no_change
+        # frozen lanes keep everything; live lanes advance and may stop
+        keep = stopped
+        return (
+            jnp.where(keep, w, w_new),
+            jnp.where(keep, step, step_new),
+            jnp.where(keep, best, jnp.minimum(best, loss)),
+            jnp.where(keep, bad, bad_new),
+            jnp.logical_or(keep, newly_stopped),
+            jnp.where(keep, n_done, n_done + 1),
+        ), None
 
     keys = jax.random.split(key, max_epochs)
-    (w, _), _ = lax.scan(epoch, (w0, jnp.array(0)), keys)
-    return w
+    state0 = (w0, jnp.array(0), jnp.float32(jnp.inf), jnp.array(0),
+              jnp.array(False), jnp.array(0))
+    (w, _, _, _, _, n_done), _ = lax.scan(epoch, state0, keys)
+    return w, n_done

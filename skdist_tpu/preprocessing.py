@@ -32,6 +32,7 @@ __all__ = [
     "HashingVectorizerChunked",
     "FastHashingVectorizer",
     "MultihotEncoder",
+    "TruncatedSVDTransformer",
 ]
 
 def _check_docs_iterable(X):
@@ -277,3 +278,95 @@ class MultihotEncoder(BaseEstimator, TransformerMixin):
     @property
     def classes_(self):
         return self.transformer_.classes_
+
+
+class TruncatedSVDTransformer(BaseEstimator, TransformerMixin):
+    """Randomized truncated SVD (Halko-Martinsson-Tropp) for feature
+    reduction ahead of the device dense path.
+
+    The densify guardrail (``models/linear.py::_check_densify_budget``)
+    names this transformer as the remedy for hashed-text widths too
+    wide to densify: ``X`` (sparse or dense, width ``d``) is projected
+    onto its top ``n_components`` right-singular directions, and the
+    (n, n_components) output is narrow enough for the MXU kernels.
+
+    TPU-first split of the work: the randomized range finder's matmuls
+    against the FULL-width X stay on host — for the guardrail's target
+    case X is sparse and ``X @ G`` rides scipy's CSR kernels, while the
+    dense X that can't exist on host is exactly the case this avoids —
+    and every post-projection step is small. Dense inputs route the
+    same matmuls through jax so they land on the accelerator. No
+    centering is applied (sklearn ``TruncatedSVD`` semantics, which is
+    what keeps X sparse).
+
+    Mirrors sklearn's fitted surface: ``components_``,
+    ``singular_values_``, ``explained_variance_``,
+    ``explained_variance_ratio_``.
+    """
+
+    def __init__(self, n_components=128, n_iter=4, n_oversamples=10,
+                 random_state=0):
+        self.n_components = n_components
+        self.n_iter = n_iter
+        self.n_oversamples = n_oversamples
+        self.random_state = random_state
+
+    def _matmul(self, A, B):
+        """A @ B with A possibly scipy-sparse; dense ndarrays ride jax
+        (device when available)."""
+        if sparse.issparse(A):
+            return np.asarray(A @ B)
+        import jax.numpy as jnp
+
+        return np.asarray(jnp.asarray(A) @ jnp.asarray(B))
+
+    def fit(self, X, y=None):
+        n, d = X.shape
+        k = int(self.n_components)
+        if not 1 <= k <= min(n, d):
+            raise ValueError(
+                f"n_components={k} must be in [1, min(n, d)="
+                f"{min(n, d)}]"
+            )
+        sketch = min(k + int(self.n_oversamples), min(n, d))
+        rng = np.random.RandomState(self.random_state)
+        G = rng.normal(size=(d, sketch)).astype(np.float32)
+        Y = self._matmul(X, G)
+        # power iterations with QR re-orthonormalisation each half-step
+        # (f32 range-finding loses the small singular directions
+        # without it)
+        XT = X.T.tocsr() if sparse.issparse(X) else X.T
+        for _ in range(int(self.n_iter)):
+            Q, _ = np.linalg.qr(Y)
+            Z = self._matmul(XT, Q)
+            Q, _ = np.linalg.qr(Z)
+            Y = self._matmul(X, Q)
+        Q, _ = np.linalg.qr(Y)
+        B = self._matmul(XT, Q).T  # (sketch, d)
+        _, s, Vt = np.linalg.svd(B, full_matrices=False)
+        self.components_ = np.ascontiguousarray(Vt[:k])
+        self.singular_values_ = s[:k]
+        self.n_features_in_ = d
+        # sklearn parity: variance of the projected columns over the
+        # TRAINING rows, and its share of total feature variance
+        Xt = self._matmul(X, self.components_.T)
+        self.explained_variance_ = Xt.var(axis=0)
+        if sparse.issparse(X):
+            mean = np.asarray(X.mean(axis=0)).ravel()
+            sq = np.asarray(X.multiply(X).mean(axis=0)).ravel()
+            full_var = float((sq - mean ** 2).sum())
+        else:
+            full_var = float(np.asarray(X).var(axis=0).sum())
+        self.explained_variance_ratio_ = (
+            self.explained_variance_ / full_var if full_var > 0
+            else np.zeros_like(self.explained_variance_)
+        )
+        return self
+
+    def transform(self, X, y=None):
+        if X.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"X has {X.shape[1]} features; TruncatedSVDTransformer "
+                f"was fitted with {self.n_features_in_}"
+            )
+        return self._matmul(X, self.components_.T)

@@ -172,7 +172,7 @@ def test_sgd_quality_vs_sklearn_matched_epochs():
         {"loss": "hinge", "penalty": "elasticnet", "l1_ratio": 0.15},
     ):
         ours = SGDClassifier(
-            alpha=1e-4, max_iter=15, random_state=0, **kwargs
+            alpha=1e-4, max_iter=15, tol=None, random_state=0, **kwargs
         ).fit(Xtr, ytr)
         sk = SkSGD(
             alpha=1e-4, max_iter=15, tol=None, random_state=0, **kwargs
@@ -180,6 +180,49 @@ def test_sgd_quality_vs_sklearn_matched_epochs():
         acc_ours = (ours.predict(Xte) == yte).mean()
         acc_sk = (sk.predict(Xte) == yte).mean()
         assert acc_ours >= acc_sk - 0.02, (kwargs, acc_ours, acc_sk)
+
+
+def test_sgd_tol_early_stopping():
+    """``tol`` must actually terminate training (round-3 VERDICT
+    weak #5): an easy problem stops well before max_iter with a real
+    per-task ``n_iter_``, ``tol=None`` runs every epoch, and quality
+    at sklearn-default settings (tol=1e-3, n_iter_no_change=5) stays
+    within 2 accuracy points of sklearn under the same rule."""
+    from sklearn.linear_model import SGDClassifier as SkSGD
+
+    from skdist_tpu.models import SGDClassifier
+
+    rng = np.random.RandomState(1)
+    n, d, k = 4000, 15, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=(d, k))
+         + 0.5 * rng.normal(size=(n, k))).argmax(1)
+    Xtr, ytr, Xte, yte = X[:3000], y[:3000], X[3000:], y[3000:]
+
+    stopped = SGDClassifier(
+        loss="log_loss", alpha=1e-4, max_iter=200, tol=1e-3,
+        random_state=0,
+    ).fit(Xtr, ytr)
+    assert int(stopped.n_iter_) < 200, "tol never stopped an easy fit"
+
+    full = SGDClassifier(
+        loss="log_loss", alpha=1e-4, max_iter=200, tol=None,
+        random_state=0,
+    ).fit(Xtr, ytr)
+    assert int(full.n_iter_) == 200
+
+    # stopping early must not cost quality on the stopped problem
+    acc_stopped = (stopped.predict(Xte) == yte).mean()
+    acc_full = (full.predict(Xte) == yte).mean()
+    assert acc_stopped >= acc_full - 0.02, (acc_stopped, acc_full)
+
+    # matched-quality under sklearn's own default stopping rule
+    sk = SkSGD(
+        loss="log_loss", alpha=1e-4, max_iter=200, tol=1e-3,
+        random_state=0,
+    ).fit(Xtr, ytr)
+    acc_sk = (sk.predict(Xte) == yte).mean()
+    assert acc_stopped >= acc_sk - 0.02, (acc_stopped, acc_sk)
 
 
 def test_logreg_bf16_matmul_parity(clf_data):

@@ -140,3 +140,46 @@ def test_simple_voter_soft(clf_data):
     )
     assert voter.score(X, y) >= 0.9
     assert "a" in voter.named_estimators
+
+
+def test_truncated_svd_recovers_low_rank():
+    """The guardrail's named remedy (models/linear.py:106) must exist
+    and work: on an exactly rank-k matrix the randomized SVD recovers
+    the spectrum and the projection preserves geometry; sparse and
+    dense inputs agree; sklearn-parity fitted surface is present."""
+    from sklearn.decomposition import TruncatedSVD as SkSVD
+
+    from skdist_tpu.preprocessing import TruncatedSVDTransformer
+
+    rng = np.random.RandomState(0)
+    n, d, k = 300, 80, 6
+    A = rng.normal(size=(n, k)).astype(np.float32)
+    B = rng.normal(size=(k, d)).astype(np.float32)
+    X = A @ B
+
+    t = TruncatedSVDTransformer(n_components=k, random_state=0).fit(X)
+    assert t.components_.shape == (k, d)
+    assert t.singular_values_.shape == (k,)
+    # exact rank-k input: top-k projection captures ~all variance
+    assert t.explained_variance_ratio_.sum() > 0.999
+
+    sk = SkSVD(n_components=k, random_state=0).fit(X)
+    np.testing.assert_allclose(
+        t.singular_values_, sk.singular_values_, rtol=1e-3
+    )
+
+    Xt = t.transform(X)
+    assert Xt.shape == (n, k)
+    # projection onto the full row space preserves Gram geometry
+    np.testing.assert_allclose(Xt @ Xt.T, X @ X.T, rtol=2e-2, atol=2e-2)
+
+    Xs = sparse.csr_matrix(X)
+    ts = TruncatedSVDTransformer(n_components=k, random_state=0).fit(Xs)
+    np.testing.assert_allclose(
+        np.abs(ts.transform(Xs)), np.abs(Xt), rtol=1e-2, atol=1e-2
+    )
+
+    with pytest.raises(ValueError):
+        TruncatedSVDTransformer(n_components=d + 1).fit(X)
+    with pytest.raises(ValueError):
+        t.transform(X[:, :10])
