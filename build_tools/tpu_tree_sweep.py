@@ -90,6 +90,13 @@ def main():
         # off-TPU pallas runs through the interpreter — minutes per
         # tree at this shape, and never a mode auto would pick on cpu
         configs = [c for c in configs if c[0] != "pallas"]
+    from skdist_tpu.models.native_forest import native_forest_supported
+
+    if native_forest_supported(32):
+        # the host C engine competes on every platform that can build
+        # it — on a TPU host it serves LocalBackend/sc=None fits even
+        # when the device engine wins the distributed path
+        configs.append(("native", None))
 
     # ---- pass 1: rank with 20-tree forests
     ranking = []

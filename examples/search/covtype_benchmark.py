@@ -16,18 +16,20 @@ RandomForestClassifier(n_jobs=-1)) and prints the spark_ml.py-style
 comparison table (the reference's table pitted sk-dist against Spark
 ML: 85.7s vs 448.4s LR, 9.24s vs 768.5s RF).
 
-Sample output (CPU backend, 8 shared cores, --rows 20000
---head-to-head; on the CPU fallback the vmapped XLA path loses to
-liblinear/Cython — the accelerator is where the batched path wins,
-cf. the measured 57-82 fits/sec TPU runs in NOTES.md):
+Sample output (CPU backend, --rows 20000 --head-to-head; the LR grid
+on the CPU fallback loses to liblinear — the accelerator is where the
+batched path wins, cf. the measured 57-82 fits/sec TPU runs in
+NOTES.md — while forests run the host C engine
+(models/native_forest.py, hist_mode='native' via calibration) and BEAT
+sklearn's Cython engine on the same cores):
     -- workload: (20000, 54) features, 7 classes
-    -- DistGridSearchCV LR (20 fits): 13.2s, CV f1 0.7486
-    -- DistRandomForest (100 trees): 45.3s, train f1 0.7311
+    -- DistGridSearchCV LR (20 fits): 12.1s, CV f1 0.7486
+    -- DistRandomForest (100 trees): 6.3s, train f1 0.7300
     engine                          wall_s     quality
-    skdist_tpu LR grid                13.2   CV 0.7486
-    sklearn LR grid (joblib -1)        1.6   CV 0.7486
-    skdist_tpu RF 100 trees           45.3  fit 0.7311
-    sklearn RF 100 trees (-1)          8.3  fit 0.7375
+    skdist_tpu LR grid                12.1   CV 0.7486
+    sklearn LR grid (joblib -1)        1.3   CV 0.7486
+    skdist_tpu RF 100 trees            6.3  fit 0.7300
+    sklearn RF 100 trees (-1)          7.1  fit 0.7375
 
 Run: python examples/search/covtype_benchmark.py [--rows 100000] [--head-to-head]
 """

@@ -86,6 +86,13 @@ def _bootstrap_counts(seed, n, dtype=jnp.float32):
     return jnp.zeros((n,), dtype).at[idx].add(1.0)
 
 
+@lru_cache(maxsize=8)
+def _bootstrap_counts_batch(n):
+    """Jitted (seeds,) -> (T, n) bootstrap counts; cached per n so
+    repeat host-engine fits skip re-tracing (~2 s per fit otherwise)."""
+    return jax.jit(jax.vmap(lambda s: _bootstrap_counts(s, n)))
+
+
 def _oob_aggregator(max_depth):
     """Cached jitted OOB aggregation (same function-identity caching
     rationale as _forest_walker). Masks are regenerated from the stored
@@ -126,8 +133,10 @@ def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
     resolved to a concrete (mode, block) BEFORE the memo key, so a
     recalibration (the on-chip sweep writes one mid-process) still
     takes effect on the next fit."""
+    # allow_native=False: this kernel IS the XLA path — forest.fit
+    # routes native-mode fits to the host engine before reaching here
     hist_mode, hist_block = resolve_hist_config(
-        d, n_bins, hist_mode, hist_block
+        d, n_bins, hist_mode, hist_block, allow_native=False
     )
     return _forest_kernel_cached(
         d, n_bins, channels, max_depth, max_features, min_samples_split,
@@ -331,26 +340,44 @@ class _BaseForest(BaseEstimator):
             if n_prev:  # advance the stream past already-drawn seeds
                 rng.randint(MAX_RAND_SEED, size=n_prev)
             seeds = rng.randint(MAX_RAND_SEED, size=n_more).astype(np.int32)
-            kernel = make_forest_tree_kernel(
-                d=d, n_bins=self.n_bins, channels=channels,
-                max_depth=self.max_depth,
-                max_features=resolve_max_features(self.max_features, d),
-                min_samples_split=self.min_samples_split,
-                min_samples_leaf=self.min_samples_leaf,
-                min_impurity_decrease=self.min_impurity_decrease,
-                extra=self._extra, classification=self._classification,
-                bootstrap=self.bootstrap,
-                hist_mode=getattr(self, "hist_mode", "auto"),
-            )
             Xb = _memo_apply_bins(X, edges, self.n_bins, reuse)
-            shared = {
-                "Xb": Xb,  # host-staged: batched_map places (and can
-                "y": np.asarray(y_enc),  # cache) the sharded replicas
-                "sw": np.asarray(sw),
-            }
-            new_trees = backend.batched_map(
-                kernel, {"seed": seeds}, shared, round_size=round_size
+            mode, _ = resolve_hist_config(
+                d, self.n_bins, getattr(self, "hist_mode", "auto")
             )
+            use_native = mode == "native" and self._can_use_native(backend)
+            if (mode == "native" and not use_native
+                    and getattr(self, "hist_mode", "auto") == "native"
+                    and isinstance(backend, LocalBackend)):
+                # explicit opt-in that can't be honored on this host;
+                # the distributed-backend case raises from
+                # resolve_hist_config(allow_native=False) below instead
+                raise ValueError(
+                    "hist_mode='native' requested but the C histogram "
+                    "kernel is unavailable (no working compiler?) or "
+                    f"n_bins={self.n_bins} > 256"
+                )
+            if use_native:
+                new_trees = self._fit_native(Xb, y_enc, sw, seeds, d)
+            else:
+                kernel = make_forest_tree_kernel(
+                    d=d, n_bins=self.n_bins, channels=channels,
+                    max_depth=self.max_depth,
+                    max_features=resolve_max_features(self.max_features, d),
+                    min_samples_split=self.min_samples_split,
+                    min_samples_leaf=self.min_samples_leaf,
+                    min_impurity_decrease=self.min_impurity_decrease,
+                    extra=self._extra, classification=self._classification,
+                    bootstrap=self.bootstrap,
+                    hist_mode=getattr(self, "hist_mode", "auto"),
+                )
+                shared = {
+                    "Xb": Xb,  # host-staged: batched_map places (and can
+                    "y": np.asarray(y_enc),  # cache) the sharded replicas
+                    "sw": np.asarray(sw),
+                }
+                new_trees = backend.batched_map(
+                    kernel, {"seed": seeds}, shared, round_size=round_size
+                )
             if prev is not None:
                 self._trees = jax.tree_util.tree_map(
                     lambda a, b: np.concatenate([a, b], axis=0), prev, new_trees
@@ -362,6 +389,59 @@ class _BaseForest(BaseEstimator):
         if self.oob_score:
             self._compute_oob(X, y_enc)
         return self
+
+    def _can_use_native(self, backend):
+        """The host C engine serves single-host fits only: distributed
+        backends shard the tree axis over the device mesh, where the
+        XLA kernel is the engine. ``n_bins`` must fit the C kernel's
+        uint8 bin keying."""
+        from .native_forest import native_forest_supported
+
+        return isinstance(backend, LocalBackend) and native_forest_supported(
+            self.n_bins
+        )
+
+    def _fit_native(self, Xb, y_enc, sw, seeds, d):
+        """Grow trees with the host engine (models/native_forest.py):
+        same histogram algorithm, per-level accumulation in the
+        multithreaded C kernel instead of an XLA scatter, zero compile
+        time. Bootstrap weights reproduce the device path's
+        ``_bootstrap_counts`` draw exactly — OOB scoring regenerates
+        masks from the stored seeds through that one function, so both
+        engines must agree on what each seed drew."""
+        from .native_forest import grow_forest_native
+
+        n = Xb.shape[0]
+        sw = np.asarray(sw, np.float32)
+        bootstrap = self.bootstrap
+
+        def weights(t0, t1):
+            # per-chunk: a 500-tree x 1M-row fit must not materialise
+            # the full (T, n) weight matrix the engine's budget
+            # chunking exists to avoid
+            if bootstrap:
+                counts = np.asarray(
+                    _bootstrap_counts_batch(n)(jnp.asarray(seeds[t0:t1]))
+                )
+                return sw[None, :] * counts
+            return np.broadcast_to(sw, (t1 - t0, n)).copy()
+
+        n_jobs = self.n_jobs
+        # joblib convention: None -> default, negative -> all cores
+        # (LocalBackend treats the same attribute this way; the C
+        # kernel would clamp a raw -1 to ONE thread)
+        n_threads = None if n_jobs is None or n_jobs < 1 else int(n_jobs)
+        return grow_forest_native(
+            Xb, y_enc, weights, seeds,
+            n_bins=self.n_bins, max_depth=self.max_depth,
+            max_features=resolve_max_features(self.max_features, d),
+            min_samples_split=self.min_samples_split,
+            min_samples_leaf=self.min_samples_leaf,
+            min_impurity_decrease=self.min_impurity_decrease,
+            extra=self._extra, classification=self._classification,
+            n_classes=len(getattr(self, "classes_", ())) or 1,
+            n_threads=n_threads,
+        )
 
     def _compute_oob(self, X, y_enc):
         """Real out-of-bag scoring (the reference stubbed this,

@@ -43,7 +43,7 @@ def _calib_path():
 #: HBM for FLOP gains that scale the wrong way)
 DEFAULT_MAX_MATMUL_DB = 16384
 
-_VALID_MODES = ("scatter", "matmul", "pallas")
+_VALID_MODES = ("scatter", "matmul", "pallas", "native")
 
 
 def _load_table():

@@ -63,7 +63,7 @@ def n_tree_nodes(max_depth):
 
 
 def resolve_hist_config(n_features, n_bins, hist_mode="auto",
-                        hist_block=None):
+                        hist_block=None, allow_native=True):
     """Concrete ``(hist_mode, hist_block)`` for this platform + shape.
 
     ``"auto"`` takes the MEASURED per-platform winner from
@@ -74,10 +74,19 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
     to the shape heuristic (matmul on accelerators at tabular widths).
     Resolution happens OUTSIDE the kernel caches, so recalibrating
     mid-process (the sweep does) takes effect on the next fit.
+
+    ``allow_native=False`` is set by callers that need an IN-PROGRAM
+    (XLA) algorithm — distributed fits sharding the tree axis over the
+    mesh, and ``build_tree_kernel`` itself. A calibrated/explicit
+    ``"native"`` (the host C engine, ``models/native_forest.py``) then
+    re-resolves to the platform shape heuristic instead — NOT blindly
+    to scatter, which would be the wrong engine on a TPU whose host
+    happens to win the local sweep.
     """
     from .hist_calib import DEFAULT_MAX_MATMUL_DB, get_calibration
 
     d, B = n_features, n_bins
+    explicit_native = hist_mode == "native"
     calib = get_calibration(jax.default_backend())
     if hist_mode == "auto":
         if calib is not None:
@@ -87,12 +96,26 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
                         "max_matmul_db", DEFAULT_MAX_MATMUL_DB)):
                 hist_mode = "scatter"
         else:
-            hist_mode = (
-                "matmul"
-                if jax.default_backend() != "cpu"
-                and d * B <= DEFAULT_MAX_MATMUL_DB
-                else "scatter"
+            hist_mode = "_heuristic"
+    if hist_mode == "native" and not allow_native:
+        if explicit_native:
+            # an explicit opt-in must not silently downgrade to the
+            # engine the user opted out of — only 'auto' re-resolves
+            raise ValueError(
+                "hist_mode='native' is the host (LocalBackend) forest "
+                "engine and cannot run inside an XLA program "
+                "(distributed mesh fits, single-tree kernels); use "
+                "'auto' or an XLA mode ('scatter'/'matmul'/'pallas')"
             )
+        hist_mode = "_heuristic"
+    if hist_mode == "_heuristic":
+        hist_mode = (
+            "matmul"
+            if jax.default_backend() != "cpu"
+            and d * B <= (calib or {}).get(
+                "max_matmul_db", DEFAULT_MAX_MATMUL_DB)
+            else "scatter"
+        )
     if hist_block is None:
         hist_block = (calib or {}).get("hist_block") or 8
     return hist_mode, int(hist_block)
@@ -143,7 +166,12 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
     """
     d, B, C, D = n_features, n_bins, channels, max_depth
     K = C - 1 if classification else 1  # leaf output width
-    hist_mode, hist_block = resolve_hist_config(d, B, hist_mode, hist_block)
+    # allow_native=False: the host C engine (models/native_forest.py) is
+    # selected at the FOREST level (forest.py routes around the XLA
+    # kernel); this builder needs an in-program algorithm
+    hist_mode, hist_block = resolve_hist_config(
+        d, B, hist_mode, hist_block, allow_native=False
+    )
     if hist_mode not in ("scatter", "matmul", "pallas"):
         raise ValueError(
             f"hist_mode must be 'auto', 'scatter', 'matmul' or 'pallas'; "

@@ -212,6 +212,94 @@ def native_available():
 
 
 # ---------------------------------------------------------------------------
+# per-level tree histograms (hist_tree.c) — host forest engine
+# ---------------------------------------------------------------------------
+
+def hist_tree_available():
+    return _load_ext("hist_tree", ("-pthread",)) is not None
+
+
+def hist_level(hist, XbT, node_rel, W, cls=None, yv=None, act=None,
+               n_threads=None, force_python=False):
+    """Accumulate (Tb, d, nl, B, C) per-level histograms (zero-fills
+    ``hist`` first; callers pass ``np.empty``).
+
+    ``XbT`` (d, n) uint8 feature-major bins, ``node_rel`` (Tb, n) int32
+    (-1 = sample not at this level), ``W`` (Tb, n) f32 weights, and
+    exactly one of ``cls`` (n,) int32 / ``yv`` (n,) f32 selects the
+    classification / regression channel layout (see hist_tree.c).
+    ``act`` (Tb, d) uint8 skips features no node of that tree sampled
+    this level (their slabs are left zeroed — callers must not read
+    stats from a skipped feature). The numpy fallback is semantically
+    identical (tested).
+    """
+    Tb, d, nl, B, C = hist.shape
+    n = XbT.shape[1]
+    mod = None if force_python else _load_ext("hist_tree", ("-pthread",))
+    if mod is not None:
+        if n_threads is None:
+            n_threads = min(16, os.cpu_count() or 1)
+        mod.hist_level(
+            hist, XbT, node_rel, W,
+            None if cls is None else cls, None if yv is None else yv,
+            None if act is None else act,
+            n, d, Tb, nl, B, C, int(n_threads),
+        )
+        return hist
+    # ---- numpy fallback: one bincount-style scatter per (tree, feature)
+    hist[:] = 0.0
+    flat = hist.reshape(Tb, d, nl * B, C)
+    for t in range(Tb):
+        w = W[t]
+        live = (node_rel[t] >= 0) & (w != 0)
+        if not live.any():
+            continue
+        nr = node_rel[t][live].astype(np.int64)
+        wa = w[live]
+        if cls is not None:
+            ch = np.zeros((live.sum(), C), np.float32)
+            ch[np.arange(len(wa)), cls[live]] = wa
+            ch[:, C - 1] = (wa > 0)
+        else:
+            ya = yv[live]
+            ch = np.stack([wa, wa * ya, wa * ya * ya,
+                           (wa > 0).astype(np.float32)], axis=1)
+        for f in range(d):
+            if act is not None and not act[t, f]:
+                continue
+            seg = nr * B + XbT[f][live]
+            np.add.at(flat[t, f], seg, ch)
+    return hist
+
+
+def best_splits_native(hist, fmask, urand, K, classification,
+                       min_samples_leaf, n_threads=None):
+    """Per-(tree, node) best split from a level histogram via the C
+    kernel, or None when the kernel is unavailable / the channel count
+    exceeds its accumulator cap (callers then run the numpy scoring
+    path). Returns ``(gain, f, t, cnt_l, cnt_r)`` each (Tb, nl)."""
+    mod = _load_ext("hist_tree", ("-pthread",))
+    Tb, d, nl, B, C = hist.shape
+    if mod is None or C > 256 or K > 256:
+        return None
+    if n_threads is None:
+        n_threads = min(16, os.cpu_count() or 1)
+    gain = np.empty((Tb, nl), np.float32)
+    bf = np.empty((Tb, nl), np.int32)
+    bt = np.empty((Tb, nl), np.int32)
+    cl = np.empty((Tb, nl), np.float32)
+    cr = np.empty((Tb, nl), np.float32)
+    mod.best_splits(
+        hist, None if fmask is None else fmask,
+        None if urand is None else urand,
+        gain, bf, bt, cl, cr,
+        Tb, d, nl, B, C, K, int(classification),
+        float(min_samples_leaf), int(n_threads),
+    )
+    return gain, bf, bt, cl, cr
+
+
+# ---------------------------------------------------------------------------
 # multithreaded CSR -> dense f32 (densify.c)
 # ---------------------------------------------------------------------------
 

@@ -128,11 +128,15 @@ def test_rte(clf_data):
 
 def test_forest_on_mesh(clf_data, tpu_backend):
     X, y = clf_data
+    # pin the XLA engine on both sides: this test is about backend
+    # invariance of the device kernel (local 'auto' would pick the
+    # host C engine, whose PRNG streams legitimately differ)
     local = DistRandomForestClassifier(
-        n_estimators=16, max_depth=5, random_state=0
+        n_estimators=16, max_depth=5, random_state=0, hist_mode="scatter"
     ).fit(X, y)
     dist = DistRandomForestClassifier(
-        n_estimators=16, max_depth=5, random_state=0, backend=tpu_backend
+        n_estimators=16, max_depth=5, random_state=0, backend=tpu_backend,
+        hist_mode="scatter",
     ).fit(X, y)
     # same seeds -> identical forests regardless of backend
     np.testing.assert_allclose(

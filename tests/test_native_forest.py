@@ -1,0 +1,272 @@
+"""
+Host (CPU) forest engine tests: C kernels vs numpy fallbacks, native
+engine vs XLA kernel, and the calibration routing that selects it.
+
+The engine replaces the role sklearn's Cython tree builder played for
+the reference (reference skdist/distribute/ensemble.py:106-108); these
+tests are its correctness contract.
+"""
+
+import numpy as np
+import pytest
+
+from skdist_tpu.models.forest import (
+    ExtraTreesClassifier,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+from skdist_tpu.models.native_forest import (
+    _best_splits_numpy,
+    grow_forest_native,
+    native_forest_supported,
+)
+from skdist_tpu.native import best_splits_native, hist_level
+
+
+@pytest.fixture
+def hist_inputs():
+    rng = np.random.RandomState(3)
+    n, d, Tb, nl, B, K = 4000, 8, 3, 4, 16, 4
+    C = K + 1
+    XbT = rng.randint(0, B, size=(d, n)).astype(np.uint8)
+    node_rel = rng.randint(-1, nl, size=(Tb, n)).astype(np.int32)
+    W = (
+        rng.uniform(size=(Tb, n)) * (rng.uniform(size=(Tb, n)) > 0.3)
+    ).astype(np.float32)
+    cls = rng.randint(0, K, size=n).astype(np.int32)
+    yv = rng.normal(size=n).astype(np.float32)
+    return XbT, node_rel, W, cls, yv, (Tb, d, nl, B, C)
+
+
+def test_hist_level_c_matches_numpy(hist_inputs):
+    XbT, node_rel, W, cls, yv, (Tb, d, nl, B, C) = hist_inputs
+    for kw in ({"cls": cls}, {"yv": yv}):
+        Ck = C if "cls" in kw else 4
+        h_c = np.empty((Tb, d, nl, B, Ck), np.float32)
+        hist_level(h_c, XbT, node_rel, W, **kw)
+        h_py = np.empty((Tb, d, nl, B, Ck), np.float32)
+        hist_level(h_py, XbT, node_rel, W, force_python=True, **kw)
+        np.testing.assert_array_equal(h_c, h_py)
+
+
+def test_hist_level_act_mask_skips_features(hist_inputs):
+    XbT, node_rel, W, cls, _, (Tb, d, nl, B, C) = hist_inputs
+    act = np.zeros((Tb, d), np.uint8)
+    act[:, ::2] = 1
+    h = np.empty((Tb, d, nl, B, C), np.float32)
+    hist_level(h, XbT, node_rel, W, cls=cls, act=act)
+    assert np.abs(h[:, 1::2]).max() == 0.0
+    assert np.abs(h[:, ::2]).sum() > 0
+    h_py = np.empty((Tb, d, nl, B, C), np.float32)
+    hist_level(h_py, XbT, node_rel, W, cls=cls, act=act, force_python=True)
+    np.testing.assert_array_equal(h, h_py)
+
+
+@pytest.mark.skipif(
+    not native_forest_supported(32), reason="C hist kernel unavailable"
+)
+def test_best_splits_c_matches_numpy(hist_inputs):
+    """The C split search must agree with the numpy scoring port on
+    choices (exact) and gains (f32-round-off: C accumulates in f64)."""
+    XbT, node_rel, W, cls, yv, (Tb, d, nl, B, C) = hist_inputs
+    K = C - 1
+    rng = np.random.RandomState(5)
+    fmask = rng.randint(0, 2, size=(Tb, d, nl)).astype(np.uint8)
+    fmask[:, 0, :] = 1  # every node keeps at least one feature
+    urand = rng.uniform(size=(Tb, d, nl)).astype(np.float32)
+
+    h = np.empty((Tb, d, nl, B, C), np.float32)
+    hist_level(h, XbT, node_rel, W, cls=cls)
+    hr = np.empty((Tb, d, nl, B, 4), np.float32)
+    hist_level(hr, XbT, node_rel, W, yv=yv)
+
+    cases = [
+        (h, None, None, K, True),
+        (h, fmask, None, K, True),
+        (h, None, urand, K, True),
+        (h, fmask, urand, K, True),
+        (hr, None, None, 1, False),
+        (hr, fmask, urand, 1, False),
+    ]
+    for hist, fm, ur, k, is_cls in cases:
+        res_c = best_splits_native(hist, fm, ur, k, is_cls, 2)
+        assert res_c is not None
+        g1, f1, t1, cl1, cr1 = res_c
+        g2, f2, t2, cl2, cr2 = _best_splits_numpy(hist, fm, ur, k, is_cls, 2)
+        np.testing.assert_array_equal(f1, f2)
+        np.testing.assert_array_equal(t1, t2)
+        np.testing.assert_array_equal(cl1, cl2)
+        np.testing.assert_array_equal(cr1, cr2)
+        valid = g2 > -1e29
+        np.testing.assert_array_equal(g1 > -1e29, valid)
+        np.testing.assert_allclose(
+            g1[valid], g2[valid], rtol=1e-4, atol=1e-4
+        )
+
+
+def test_native_matches_xla_engine_deterministic(clf_data):
+    """With no feature subsampling, no bootstrap, and best-split mode
+    there is no PRNG: the host engine and the XLA kernel must grow the
+    SAME trees (identical structure and leaf values)."""
+    X, y = clf_data
+    kw = dict(n_estimators=6, max_depth=5, bootstrap=False,
+              max_features=None, random_state=0)
+    f_xla = RandomForestClassifier(hist_mode="scatter", **kw).fit(X, y)
+    f_nat = RandomForestClassifier(hist_mode="native", **kw).fit(X, y)
+    np.testing.assert_array_equal(
+        f_xla._trees["feat"], f_nat._trees["feat"]
+    )
+    np.testing.assert_array_equal(f_xla._trees["thr"], f_nat._trees["thr"])
+    np.testing.assert_allclose(
+        f_xla.predict_proba(X), f_nat.predict_proba(X), atol=1e-6
+    )
+
+
+def test_native_quality_vs_sklearn(clf_data):
+    """Full stochastic config (bootstrap + sqrt features): the host
+    engine must hold sklearn-level accuracy."""
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+
+    X, y = clf_data
+    f = RandomForestClassifier(
+        n_estimators=60, max_depth=8, random_state=0, hist_mode="native"
+    ).fit(X, y)
+    sk = SkRF(n_estimators=60, max_depth=8, random_state=0).fit(X, y)
+    acc = (f.predict(X) == y).mean()
+    acc_sk = (sk.predict(X) == y).mean()
+    assert acc >= acc_sk - 0.03, (acc, acc_sk)
+
+
+def test_native_oob_uses_device_bootstrap_draws(clf_data):
+    """OOB regenerates bootstrap masks from stored seeds via the jax
+    PRNG — the native engine must have fitted with those exact draws,
+    or OOB would score in-bag samples. An OOB score far above chance
+    and close to the XLA engine's shows the draws line up."""
+    X, y = clf_data
+    kw = dict(n_estimators=40, max_depth=6, random_state=0, oob_score=True)
+    f_nat = RandomForestClassifier(hist_mode="native", **kw).fit(X, y)
+    f_xla = RandomForestClassifier(hist_mode="scatter", **kw).fit(X, y)
+    assert f_nat.oob_score_ > 0.7
+    assert abs(f_nat.oob_score_ - f_xla.oob_score_) < 0.1
+
+
+def test_native_extratrees_and_regressor(clf_data, reg_data):
+    X, y = clf_data
+    et = ExtraTreesClassifier(
+        n_estimators=40, max_depth=7, random_state=0, hist_mode="native"
+    ).fit(X, y)
+    assert (et.predict(X) == y).mean() > 0.85
+    Xr, yr = reg_data
+    rr = RandomForestRegressor(
+        n_estimators=40, max_depth=7, random_state=0, hist_mode="native"
+    ).fit(Xr, yr)
+    from sklearn.metrics import r2_score
+
+    assert r2_score(yr, rr.predict(Xr)) > 0.6
+
+
+def test_native_sample_weight_and_class_weight(clf_data):
+    """Zero-weighted samples must not influence the native trees (the
+    same masking contract the device kernel honours)."""
+    X, y = clf_data
+    n = len(y)
+    rng = np.random.RandomState(0)
+    X_junk = X.copy()
+    junk = rng.permutation(n)[: n // 3]
+    X_junk[junk] = rng.normal(size=(len(junk), X.shape[1])) * 10
+    y_junk = y.copy()
+    y_junk[junk] = (y[junk] + 1) % len(np.unique(y))
+    sw = np.ones(n, np.float32)
+    sw[junk] = 0.0
+    f = RandomForestClassifier(
+        n_estimators=30, max_depth=6, random_state=0, hist_mode="native"
+    ).fit(X_junk, y_junk, sample_weight=sw)
+    keep = np.setdiff1d(np.arange(n), junk)
+    assert (f.predict(X_junk[keep]) == y_junk[keep]).mean() > 0.85
+
+    fb = RandomForestClassifier(
+        n_estimators=30, max_depth=6, random_state=0, hist_mode="native",
+        class_weight="balanced",
+    ).fit(X, y)
+    assert (fb.predict(X) == y).mean() > 0.85
+
+
+def test_auto_resolves_to_native_on_cpu_calibration():
+    """hist_calib.json's cpu entry (written by the sweep) names the
+    host engine; 'auto' must route LocalBackend fits there, and the
+    distributed / in-XLA resolution must NOT return native."""
+    import jax
+
+    from skdist_tpu.models.hist_calib import get_calibration
+    from skdist_tpu.models.tree import resolve_hist_config
+
+    calib = get_calibration(jax.default_backend())
+    if calib is None or calib["mode"] != "native":
+        pytest.skip("no native calibration for this platform")
+    mode, _ = resolve_hist_config(54, 32, "auto")
+    assert mode == "native"
+    mode_xla, _ = resolve_hist_config(54, 32, "auto", allow_native=False)
+    assert mode_xla in ("scatter", "matmul", "pallas")
+
+
+def test_native_chunking_matches_single_chunk(clf_data):
+    """A tiny tree-chunk budget must produce byte-identical forests
+    (chunking is an orchestration detail, not a semantic one)."""
+    X, y = clf_data
+    from skdist_tpu.models.forest import (
+        _bootstrap_counts_batch,
+    )
+    from skdist_tpu.ops.binning import apply_bins, quantile_bin_edges
+    import jax.numpy as jnp
+
+    edges = quantile_bin_edges(X, 16)
+    Xb = np.asarray(apply_bins(jnp.asarray(X), jnp.asarray(edges)))
+    y_enc = np.unique(y, return_inverse=True)[1].astype(np.int32)
+    seeds = np.arange(10, dtype=np.int32)
+    W = np.asarray(_bootstrap_counts_batch(len(y))(jnp.asarray(seeds)))
+    kw = dict(n_bins=16, max_depth=5, max_features=3,
+              min_samples_split=2, min_samples_leaf=1,
+              min_impurity_decrease=0.0, extra=False, classification=True,
+              n_classes=len(np.unique(y)))
+    big = grow_forest_native(Xb, y_enc, W, seeds, **kw)
+    small = grow_forest_native(
+        Xb, y_enc, W, seeds, budget_bytes=1, **kw
+    )
+    for k in ("feat", "thr", "is_split", "leaf", "gain"):
+        np.testing.assert_array_equal(big[k], small[k])
+
+
+def test_native_n_jobs_minus_one_and_explicit_errors(clf_data):
+    """Review findings: joblib's n_jobs=-1 convention must reach the C
+    kernel as 'all cores' (not clamp to ONE thread), and an explicit
+    hist_mode='native' that cannot be honored must raise rather than
+    silently downgrade to the engine the user opted out of."""
+    X, y = clf_data
+    ref = RandomForestClassifier(
+        n_estimators=10, max_depth=5, random_state=0, hist_mode="native"
+    ).fit(X, y)
+    f = RandomForestClassifier(
+        n_estimators=10, max_depth=5, random_state=0, hist_mode="native",
+        n_jobs=-1,
+    ).fit(X, y)
+    np.testing.assert_array_equal(ref._trees["feat"], f._trees["feat"])
+
+    # (n_bins > 256 — the C kernel's uint8 bin cap — is unreachable:
+    # ops/binning.py rejects it for every engine first)
+
+    # distributed mesh fit shards the tree axis over devices — the
+    # host engine cannot serve it
+    from skdist_tpu.distribute.ensemble import DistRandomForestClassifier
+    from skdist_tpu.parallel import TPUBackend
+
+    with pytest.raises(ValueError, match="native"):
+        DistRandomForestClassifier(
+            n_estimators=4, max_depth=4, hist_mode="native",
+            backend=TPUBackend(),
+        ).fit(X, y)
+
+    # single-tree kernels are XLA programs
+    from skdist_tpu.models.tree import DecisionTreeClassifier
+
+    with pytest.raises(ValueError, match="native"):
+        DecisionTreeClassifier(hist_mode="native").fit(X, y)
