@@ -24,6 +24,11 @@ PORT = int(os.environ.get("MULTIPROC_SMOKE_PORT", "12356"))
 NPROCS = int(os.environ.get("MULTIPROC_SMOKE_NPROCS", "2"))
 LOCAL_DEVICES = int(os.environ.get("MULTIPROC_SMOKE_LOCAL_DEVICES", "2"))
 DATA_AXIS = int(os.environ.get("MULTIPROC_SMOKE_DATA_AXIS", "2"))
+# SUBSET=1: the mesh covers only processes 0..NPROCS-2; the last
+# process never enters batched_map. Guards the chunk-agreement
+# collective being mesh-scoped (a job-global process_allgather would
+# deadlock here waiting on the non-member).
+SUBSET = os.environ.get("MULTIPROC_SMOKE_SUBSET") == "1"
 
 
 def _problem():
@@ -50,8 +55,10 @@ def child(pid):
         coordinator_address=f"localhost:{PORT}", num_processes=NPROCS,
         process_id=pid,
     )
-    mesh = multihost_task_mesh(data_axis_size=DATA_AXIS)
     assert jax.process_count() == NPROCS
+    if SUBSET:
+        return _subset_child(pid)
+    mesh = multihost_task_mesh(data_axis_size=DATA_AXIS)
     n_global = NPROCS * LOCAL_DEVICES
     assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
         "tasks": n_global // DATA_AXIS, "data": DATA_AXIS,
@@ -75,6 +82,40 @@ def child(pid):
     ).fit(X, y)
     print("SCORES", pid, list(np.round(gs.cv_results_["mean_test_score"], 6)),
           flush=True)
+
+
+def _subset_child(pid):
+    """Processes 0..NPROCS-2 run a grid search on a mesh of THEIR
+    devices only; the last process runs no skdist work at all. The fit
+    must complete (mesh-scoped chunk agreement) — then everyone meets
+    at one job-global barrier so the coordinator outlives the fit."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh
+
+    member = pid < NPROCS - 1
+    if member:
+        from skdist_tpu.distribute.search import DistGridSearchCV
+        from skdist_tpu.models import LogisticRegression
+        from skdist_tpu.parallel import TPUBackend
+
+        devs = [
+            d for d in jax.devices() if d.process_index < NPROCS - 1
+        ]
+        mesh = Mesh(np.array(devs).reshape(len(devs), 1),
+                    ("tasks", "data"))
+        X, y = _problem()
+        gs = DistGridSearchCV(
+            LogisticRegression(max_iter=20), {"C": [0.1, 1.0, 10.0]},
+            backend=TPUBackend(mesh=mesh), cv=3, scoring="accuracy",
+        ).fit(X, y)
+        print("SCORES", pid,
+              list(np.round(gs.cv_results_["mean_test_score"], 6)),
+              flush=True)
+    else:
+        print(f"NONMEMBER {pid} idle", flush=True)
+    multihost_utils.sync_global_devices("subset_smoke_done")
 
 
 def single_reference():
@@ -128,14 +169,15 @@ def main():
         ln for out in outs for ln in out.splitlines() if ln.startswith("SCORES")
     ]
     ref_line = [ln for ln in ref.stdout.splitlines() if ln.startswith("SCORES")]
-    if not ok or len(score_lines) != NPROCS or not ref_line:
+    n_expected = NPROCS - 1 if SUBSET else NPROCS
+    if not ok or len(score_lines) != n_expected or not ref_line:
         print("MULTIPROC SMOKE: FAIL")
         sys.exit(1)
     vecs = {ln.split("[", 1)[1] for ln in score_lines}
     vr = ref_line[0].split("[", 1)[1]
     assert vecs == {vr}, (vecs, vr)
-    print(f"MULTIPROC SMOKE: PASS ({NPROCS} processes match the "
-          "single-process run)")
+    print(f"MULTIPROC SMOKE: PASS ({n_expected} fitting processes match "
+          "the single-process run)")
 
 
 if __name__ == "__main__":

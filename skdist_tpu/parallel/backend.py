@@ -214,6 +214,36 @@ class TPUBackend(TaskBackend):
         """Task-axis extent: the number of task slots per round."""
         return self.mesh.shape[self.axis_name]
 
+    def _mesh_min_int(self, value):
+        """Minimum of a per-process host integer across THIS mesh's
+        processes, as a device computation on the mesh: each process
+        feeds its value to its addressable shards of a one-per-device
+        global array, and a replicated ``jnp.min`` reduces it. Only
+        processes owning devices in the mesh participate — the reason
+        this is not ``multihost_utils.process_allgather``, which is a
+        job-global collective and deadlocks for subset meshes."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        shape = mesh.devices.shape
+        unit = tuple(1 for _ in shape)
+        sharding = NamedSharding(mesh, P(*mesh.axis_names))
+        shards = [
+            jax.device_put(np.full(unit, value, np.int64), d)
+            for d in mesh.devices.flat
+            if d.process_index == jax.process_index()
+        ]
+        garr = jax.make_array_from_single_device_arrays(
+            shape, sharding, shards
+        )
+        out = jax.jit(
+            jnp.min, out_shardings=NamedSharding(mesh, P())
+        )(garr)
+        return int(out)
+
     def _free_device_bytes(self):
         """Free HBM on the first mesh device, or None where the backend
         reports no stats (CPU virtual devices return None)."""
@@ -314,14 +344,12 @@ class TPUBackend(TaskBackend):
             # can differ per host; a per-host chunk means mismatched
             # round counts and a deadlocked SPMD collective. Agree on
             # the min across the mesh's processes before the first
-            # dispatch.
-            from jax.experimental import multihost_utils
-
-            chunk = int(
-                np.min(multihost_utils.process_allgather(
-                    np.array([chunk], dtype=np.int64)
-                ))
-            )
+            # dispatch. The agreement is a device computation ON THIS
+            # MESH — not a job-global collective like process_allgather
+            # — so a mesh covering a strict subset of the job's
+            # processes never blocks on processes that own no device in
+            # it (they may be running unrelated work, or nothing).
+            chunk = self._mesh_min_int(chunk)
         # HBM-adaptive rounds: a round that exhausts device memory is
         # halved (device-count aligned) and the run RESUMES from the
         # first unfinished task — completed rounds are kept, not

@@ -25,12 +25,14 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run_smoke(nprocs, local_devices, data_axis):
+def _run_smoke(nprocs, local_devices, data_axis, subset=False):
     env = dict(os.environ)
     env["MULTIPROC_SMOKE_PORT"] = str(_free_port())
     env["MULTIPROC_SMOKE_NPROCS"] = str(nprocs)
     env["MULTIPROC_SMOKE_LOCAL_DEVICES"] = str(local_devices)
     env["MULTIPROC_SMOKE_DATA_AXIS"] = str(data_axis)
+    if subset:
+        env["MULTIPROC_SMOKE_SUBSET"] = "1"
     # the smoke manages its own XLA device-count flags in the children
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
@@ -51,3 +53,12 @@ def test_four_process_cross_host_data_axis():
     'data' axis), and the task axis spans the other process pair —
     multihost_task_mesh proper, beyond single-host degeneration."""
     _run_smoke(nprocs=4, local_devices=1, data_axis=2)
+
+
+def test_subset_mesh_does_not_block_on_non_member_process():
+    """3 coordinator-joined processes; the mesh covers only processes
+    0-1 and process 2 never calls batched_map. The chunk-size
+    agreement must be scoped to the MESH's processes (a job-global
+    process_allgather would deadlock here waiting on process 2 —
+    round-3 advisor finding)."""
+    _run_smoke(nprocs=3, local_devices=1, data_axis=1, subset=True)
