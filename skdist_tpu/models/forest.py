@@ -344,18 +344,20 @@ class _BaseForest(BaseEstimator):
             mode, _ = resolve_hist_config(
                 d, self.n_bins, getattr(self, "hist_mode", "auto")
             )
-            use_native = mode == "native" and self._can_use_native(backend)
-            if (mode == "native" and not use_native
-                    and getattr(self, "hist_mode", "auto") == "native"
-                    and isinstance(backend, LocalBackend)):
-                # explicit opt-in that can't be honored on this host;
-                # the distributed-backend case raises from
-                # resolve_hist_config(allow_native=False) below instead
-                raise ValueError(
-                    "hist_mode='native' requested but the C histogram "
-                    "kernel is unavailable (no working compiler?) or "
-                    f"n_bins={self.n_bins} > 256"
+            # explicit opt-in that can't be honored on this host raises
+            # (shared diagnosis with tree.py); the distributed-backend
+            # case raises from resolve_hist_config(allow_native=False)
+            # inside make_forest_tree_kernel instead
+            from .native_forest import native_supported_or_raise
+
+            use_native = (
+                mode == "native"
+                and isinstance(backend, LocalBackend)
+                and native_supported_or_raise(
+                    self.n_bins,
+                    getattr(self, "hist_mode", "auto") == "native",
                 )
+            )
             if use_native:
                 new_trees = self._fit_native(Xb, y_enc, sw, seeds, d)
             else:
@@ -389,17 +391,6 @@ class _BaseForest(BaseEstimator):
         if self.oob_score:
             self._compute_oob(X, y_enc)
         return self
-
-    def _can_use_native(self, backend):
-        """The host C engine serves single-host fits only: distributed
-        backends shard the tree axis over the device mesh, where the
-        XLA kernel is the engine. ``n_bins`` must fit the C kernel's
-        uint8 bin keying."""
-        from .native_forest import native_forest_supported
-
-        return isinstance(backend, LocalBackend) and native_forest_supported(
-            self.n_bins
-        )
 
     def _fit_native(self, Xb, y_enc, sw, seeds, d):
         """Grow trees with the host engine (models/native_forest.py):

@@ -45,6 +45,35 @@ def native_forest_supported(n_bins):
     return n_bins <= 256 and hist_tree_available()
 
 
+def native_supported_or_raise(n_bins, explicit):
+    """True when the C engine can serve this fit, False when ``auto``
+    should fall back to an XLA engine — and a precise error for an
+    EXPLICIT ``hist_mode='native'`` that cannot be honored on this
+    host (shared by the tree and forest dispatch sites so the
+    diagnosis never drifts between them)."""
+    if native_forest_supported(n_bins):
+        return True
+    if explicit:
+        raise ValueError(
+            "hist_mode='native' requested but the C histogram kernel "
+            "is unavailable (no working compiler?) or n_bins "
+            f"({n_bins}) > 256"
+        )
+    return False
+
+
+def grow_single_tree_native(Xb, y, sw, seed, **config):
+    """One tree via the host engine (a T=1 forest): the single-tree
+    estimators' dispatch (``tree.py::_BaseTree.fit``) — no XLA compile
+    at all, so a cold one-tree fit is milliseconds. Returns the
+    unstacked param dict (without the forest-only ``seed`` entry)."""
+    trees = grow_forest_native(
+        Xb, y, np.asarray(sw, np.float32)[None, :],
+        np.asarray([seed], np.int32), **config,
+    )
+    return {k: np.asarray(v[0]) for k, v in trees.items() if k != "seed"}
+
+
 def _level_rng(seed, level):
     # deterministic per (tree, level); any well-mixed map works — this
     # only needs independence across levels, not device-path parity

@@ -102,9 +102,9 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
             # an explicit opt-in must not silently downgrade to the
             # engine the user opted out of — only 'auto' re-resolves
             raise ValueError(
-                "hist_mode='native' is the host (LocalBackend) forest "
+                "hist_mode='native' is the host (LocalBackend) tree "
                 "engine and cannot run inside an XLA program "
-                "(distributed mesh fits, single-tree kernels); use "
+                "(distributed mesh fits, batched search kernels); use "
                 "'auto' or an XLA mode ('scatter'/'matmul'/'pallas')"
             )
         hist_mode = "_heuristic"
@@ -553,6 +553,48 @@ class _BaseTree(BaseEstimator):
 
     def fit(self, X, y, sample_weight=None):
         data, meta = self._prep_fit_data(X, y, sample_weight)
+        mode, _ = resolve_hist_config(
+            meta["n_features"], self.n_bins, self.hist_mode
+        )
+        if mode == "native":
+            from .native_forest import (
+                grow_single_tree_native,
+                native_supported_or_raise,
+            )
+
+            if native_supported_or_raise(
+                self.n_bins, self.hist_mode == "native"
+            ):
+                # host C engine as a one-tree forest: a single-tree fit
+                # pays NO XLA compile (cold == warm — the compile was
+                # seconds for one tree). Same engine-caveat as forests:
+                # subsample/threshold PRNG streams differ from the
+                # device kernel's.
+                Xb = np.asarray(
+                    apply_bins(jnp.asarray(data["X"]),
+                               jnp.asarray(meta["edges"]))
+                )
+                d = meta["n_features"]
+                params = grow_single_tree_native(
+                    Xb, data["y"], data["sw"], self.random_state or 0,
+                    n_bins=self.n_bins, max_depth=self.max_depth,
+                    max_features=resolve_max_features(
+                        self.max_features, d
+                    ),
+                    min_samples_split=self.min_samples_split,
+                    min_samples_leaf=self.min_samples_leaf,
+                    min_impurity_decrease=self.min_impurity_decrease,
+                    extra=(self.splitter == "random"),
+                    classification=self._classification,
+                    n_classes=meta.get("n_classes", 0) or 1,
+                )
+                params["edges"] = np.asarray(meta["edges"])
+                self._params = params
+                self._meta = meta
+                self.n_features_in_ = d
+                if "classes" in meta:
+                    self.classes_ = meta["classes"]
+                return self
         static = _freeze(self._static_config(meta))
         kernel = get_kernel(type(self), "fit", meta, static)
         aux = {"edges": jnp.asarray(meta["edges"])}

@@ -265,8 +265,21 @@ def test_native_n_jobs_minus_one_and_explicit_errors(clf_data):
             backend=TPUBackend(),
         ).fit(X, y)
 
-    # single-tree kernels are XLA programs
+    # single trees route through the host engine too (as a one-tree
+    # forest — no XLA compile); deterministic configs must match the
+    # XLA kernel exactly
     from skdist_tpu.models.tree import DecisionTreeClassifier
 
-    with pytest.raises(ValueError, match="native"):
-        DecisionTreeClassifier(hist_mode="native").fit(X, y)
+    t_nat = DecisionTreeClassifier(
+        max_depth=5, hist_mode="native"
+    ).fit(X, y)
+    t_xla = DecisionTreeClassifier(
+        max_depth=5, hist_mode="scatter"
+    ).fit(X, y)
+    np.testing.assert_array_equal(
+        t_nat._params["feat"], t_xla._params["feat"]
+    )
+    np.testing.assert_allclose(
+        t_nat.predict_proba(X), t_xla.predict_proba(X), atol=1e-6
+    )
+    assert (t_nat.apply(X) == t_xla.apply(X)).all()
