@@ -123,7 +123,7 @@ def lbfgs_minimize(fun, w0, max_iter=100, tol=1e-4, history=10, max_ls=20):
 
 def sgd_minimize(grad_fn, w0, n_samples, key, max_epochs, batch_size,
                  learning_rate_fn, shuffle=True, loss_fn=None, tol=None,
-                 n_iter_no_change=5):
+                 n_iter_no_change=5, post_step=None, post_state=None):
     """Mini-batch SGD with per-step learning-rate schedule.
 
     ``grad_fn(w, idx) -> grad`` computes the (penalised) gradient on the
@@ -143,14 +143,24 @@ def sgd_minimize(grad_fn, w0, n_samples, key, max_epochs, batch_size,
     ``tol`` of ``-inf`` (the mapping for sklearn's ``tol=None``) never
     triggers and reproduces the fixed-epoch behaviour.
 
+    ``post_step(w, state, lr) -> (w, state)``: stateful per-update
+    transform applied AFTER each gradient step, threaded through the
+    scan from ``post_state`` (an arbitrary pytree; frozen lanes keep
+    it). The truncated-gradient L1 penalty (Tsuruoka et al.'s
+    cumulative penalty, what sklearn's SGD applies) lives here — it is
+    a proximal-style elementwise operation with persistent (u, q)
+    state, not a gradient term.
+
     Returns ``(w, n_epochs_run)``.
     """
     n_batches = -(-n_samples // batch_size)
     padded = n_batches * batch_size
     track = loss_fn is not None and tol is not None
+    if post_step is None:
+        post_state = ()
 
     def epoch(carry, ekey):
-        w, step, best, bad, stopped, n_done = carry
+        w, pstate, step, best, bad, stopped, n_done = carry
         if shuffle:
             perm = jax.random.permutation(ekey, padded) % n_samples
         else:
@@ -158,19 +168,21 @@ def sgd_minimize(grad_fn, w0, n_samples, key, max_epochs, batch_size,
         batches = perm.reshape(n_batches, batch_size)
 
         def one(carry, idx):
-            w, step, acc = carry
+            w, pstate, step, acc = carry
             g = grad_fn(w, idx)
             lr = learning_rate_fn(step)
             w_new = w - lr * g
+            if post_step is not None:
+                w_new, pstate = post_step(w_new, pstate, lr)
             if track:
                 acc = acc + loss_fn(w_new, idx)
-            return (w_new, step + 1, acc), None
+            return (w_new, pstate, step + 1, acc), None
 
-        (w_new, step_new, acc), _ = lax.scan(
-            one, (w, step, jnp.float32(0.0)), batches
+        (w_new, pstate_new, step_new, acc), _ = lax.scan(
+            one, (w, pstate, step, jnp.float32(0.0)), batches
         )
         if not track:
-            return (w_new, step_new, best, bad, stopped,
+            return (w_new, pstate_new, step_new, best, bad, stopped,
                     n_done + 1), None
         loss = acc / n_batches
         improved = loss < best - tol
@@ -178,17 +190,22 @@ def sgd_minimize(grad_fn, w0, n_samples, key, max_epochs, batch_size,
         newly_stopped = bad_new >= n_iter_no_change
         # frozen lanes keep everything; live lanes advance and may stop
         keep = stopped
+
+        def pick(a, b):
+            return jnp.where(keep, a, b)
+
         return (
-            jnp.where(keep, w, w_new),
-            jnp.where(keep, step, step_new),
-            jnp.where(keep, best, jnp.minimum(best, loss)),
-            jnp.where(keep, bad, bad_new),
+            pick(w, w_new),
+            jax.tree_util.tree_map(pick, pstate, pstate_new),
+            pick(step, step_new),
+            pick(best, jnp.minimum(best, loss)),
+            pick(bad, bad_new),
             jnp.logical_or(keep, newly_stopped),
-            jnp.where(keep, n_done, n_done + 1),
+            pick(n_done, n_done + 1),
         ), None
 
     keys = jax.random.split(key, max_epochs)
-    state0 = (w0, jnp.array(0), jnp.float32(jnp.inf), jnp.array(0),
-              jnp.array(False), jnp.array(0))
-    (w, _, _, _, _, n_done), _ = lax.scan(epoch, state0, keys)
+    state0 = (w0, post_state, jnp.array(0), jnp.float32(jnp.inf),
+              jnp.array(0), jnp.array(False), jnp.array(0))
+    (w, _, _, _, _, _, n_done), _ = lax.scan(epoch, state0, keys)
     return w, n_done
