@@ -187,6 +187,33 @@ def make_tabular(n, d, k, seed=0, noise=0.7):
     return X, y
 
 
+def _forest_calib_context():
+    """Committed per-platform forest-engine measurement
+    (models/hist_calib.json, written by build_tools/tpu_tree_sweep.py)
+    as a compact aux field — the BASELINE row-2 story (RF 100 trees)
+    travels in the driver artifact with its own provenance, clearly
+    separate from this run's search measurement."""
+    try:
+        import jax
+
+        from skdist_tpu.models.hist_calib import get_calibration
+
+        calib = get_calibration(jax.default_backend())
+        if not calib or "measured" not in calib:
+            return {}
+        m = calib["measured"]
+        return {"forest_calib": {
+            "engine": calib.get("mode"),
+            "warm_100_trees_s": m.get("winner_100_trees_warm_s"),
+            "cold_100_trees_s": m.get("winner_100_trees_cold_s"),
+            "sklearn_100_trees_s": m.get("sklearn_8core_100_trees_s"),
+            "shape": m.get("shape"),
+            "captured_at": m.get("captured_at"),
+        }}
+    except Exception:
+        return {}
+
+
 def run_bench(platform, quick=False):
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression
@@ -353,6 +380,7 @@ def run_bench(platform, quick=False):
                 basis=f"measured mean n_iter={n_iter_mean:.1f}",
                 platform=platform,
             ),
+            **_forest_calib_context(),
             "captured_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         },
