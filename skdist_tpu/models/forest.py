@@ -477,10 +477,30 @@ class _BaseForest(BaseEstimator):
                 f"This {type(self).__name__} instance is not fitted yet."
             )
 
+    def _native_walk(self, X, mode):
+        """Host C walker (native/hist_tree.c::forest_walk): on a
+        CPU-backed process the predict side, like the native fit,
+        needs no XLA compile at all. Returns None to fall through to
+        the XLA walker (accelerator platforms, C kernel unavailable)."""
+        if jax.default_backend() != "cpu":
+            return None
+        from ..native import forest_walk_native
+        from ..ops.binning import apply_bins_np
+
+        n_jobs = getattr(self, "n_jobs", None)
+        return forest_walk_native(
+            apply_bins_np(X, self._edges), self._trees, self.max_depth,
+            mode=mode,
+            n_threads=None if n_jobs is None or n_jobs < 1 else int(n_jobs),
+        )
+
     def _forest_values(self, X):
         """Mean over trees of per-tree leaf outputs → (n, K_out)."""
         self._check_fitted()
         X = as_dense_f32(X)
+        out = self._native_walk(X, "predict")
+        if out is not None:
+            return out
         fn = _forest_walker(self.max_depth, "predict")
         trees = jax.tree_util.tree_map(jnp.asarray, self._trees)
         Xb = apply_bins(jnp.asarray(X), jnp.asarray(self._edges))
@@ -490,6 +510,9 @@ class _BaseForest(BaseEstimator):
         """(n, n_estimators) leaf ids — sklearn ``forest.apply``."""
         self._check_fitted()
         X = as_dense_f32(X)
+        out = self._native_walk(X, "apply")
+        if out is not None:
+            return out
         fn = _forest_walker(self.max_depth, "apply")
         trees = jax.tree_util.tree_map(jnp.asarray, self._trees)
         Xb = apply_bins(jnp.asarray(X), jnp.asarray(self._edges))

@@ -612,9 +612,30 @@ class _BaseTree(BaseEstimator):
                 f"This {type(self).__name__} instance is not fitted yet."
             )
 
+    def _native_walk(self, X, mode):
+        """Host C walker on the single tree (viewed as a T=1 forest);
+        None falls through to the XLA decision kernel."""
+        if jax.default_backend() != "cpu":
+            return None
+        from ..native import forest_walk_native
+        from ..ops.binning import apply_bins_np
+
+        trees = {
+            k: np.asarray(self._params[k])[None]
+            for k in ("feat", "thr", "is_split", "leaf")
+        }
+        return forest_walk_native(
+            apply_bins_np(X, self._params["edges"]), trees,
+            self.max_depth, mode=mode,
+        )
+
     def _leaf_values(self, X):
         self._check_fitted()
         X = as_dense_f32(X)
+        out = self._native_walk(X, "predict")
+        if out is not None:
+            # match the decision kernel's squeeze for regressors
+            return out[:, 0] if out.shape[1] == 1 else out
         static = _freeze(self._static_config(self._meta))
         kernel = get_kernel(type(self), "decision", self._meta, static)
         params = jax.tree_util.tree_map(jnp.asarray, self._params)
@@ -631,6 +652,9 @@ class _BaseTree(BaseEstimator):
         """Leaf (node) index per sample — sklearn ``tree.apply`` analogue."""
         self._check_fitted()
         X = as_dense_f32(X)
+        out = self._native_walk(X, "apply")
+        if out is not None:
+            return out[:, 0]
         walk = tree_predict_kernel(self.max_depth, return_nodes=True)
         params = jax.tree_util.tree_map(jnp.asarray, self._params)
         Xb = apply_bins(jnp.asarray(X), params["edges"])

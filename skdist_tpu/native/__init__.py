@@ -272,6 +272,45 @@ def hist_level(hist, XbT, node_rel, W, cls=None, yv=None, act=None,
     return hist
 
 
+def forest_walk_native(Xb, trees, max_depth, mode="predict",
+                       n_threads=None):
+    """Predict-side tree traversal via the C kernel, or None when it
+    is unavailable (callers then use the XLA walker).
+
+    ``Xb`` (n, d) uint8 bins, ``trees`` the stacked pytree
+    ``{feat, thr, is_split, leaf}`` (T, N)-shaped. ``mode='predict'``
+    returns the (n, K) mean leaf vector; ``'apply'`` the (n, T) final
+    node ids — matching ``models/forest.py::_forest_walker`` exactly
+    (a node stays put once a non-split node is reached)."""
+    mod = _load_ext("hist_tree", ("-pthread",))
+    if mod is None:
+        return None
+    feat = np.ascontiguousarray(trees["feat"], np.int32)
+    thr = np.ascontiguousarray(trees["thr"], np.int32)
+    sp = np.ascontiguousarray(trees["is_split"], np.uint8)
+    T, N = feat.shape
+    if 2 ** (int(max_depth) + 1) - 1 > N:
+        # a depth the arrays weren't built for (e.g. max_depth mutated
+        # after fit) would walk past the buffers in C; the XLA walker's
+        # clipped indexing degrades gracefully — fall through to it
+        return None
+    n, d = Xb.shape
+    Xb = np.ascontiguousarray(Xb, np.uint8)
+    if n_threads is None:
+        n_threads = min(16, os.cpu_count() or 1)
+    if mode == "predict":
+        leaf = np.ascontiguousarray(trees["leaf"], np.float32)
+        K = leaf.shape[2]
+        out = np.empty((n, K), np.float32)
+        mod.forest_walk(Xb, feat, thr, sp, leaf, out, None,
+                        n, d, T, N, K, int(max_depth), int(n_threads))
+        return out
+    out = np.empty((n, T), np.int32)
+    mod.forest_walk(Xb, feat, thr, sp, None, None, out,
+                    n, d, T, N, 1, int(max_depth), int(n_threads))
+    return out
+
+
 def best_splits_native(hist, fmask, urand, K, classification,
                        min_samples_leaf, n_threads=None):
     """Per-(tree, node) best split from a level histogram via the C

@@ -242,6 +242,57 @@ static void *split_items(void *arg) {
 }
 
 /* ------------------------------------------------------------------ */
+/* forest_walk: predict-side tree traversal                            */
+/* ------------------------------------------------------------------ */
+
+typedef struct {
+    const uint8_t *Xb; /* (n, d) row-major bins */
+    const int32_t *feat, *thr; /* (T, N) */
+    const uint8_t *is_split;   /* (T, N) */
+    const float *leaf;         /* (T, N, K); NULL in apply mode */
+    float *out_mean;           /* (n, K) mean leaf; NULL in apply mode */
+    int32_t *out_nodes;        /* (n, T) final node ids; NULL otherwise */
+    int64_t n, d, T, N, K, D;
+    int64_t s0, s1; /* sample range */
+} WalkJob;
+
+static void *walk_samples(void *arg) {
+    WalkJob *j = (WalkJob *)arg;
+    const int64_t d = j->d, T = j->T, N = j->N, K = j->K, D = j->D;
+    for (int64_t s = j->s0; s < j->s1; s++) {
+        const uint8_t *row = j->Xb + s * d;
+        float *acc = j->out_mean ? j->out_mean + s * K : NULL;
+        if (acc)
+            for (int64_t c = 0; c < K; c++)
+                acc[c] = 0.0f;
+        for (int64_t t = 0; t < T; t++) {
+            const int32_t *feat = j->feat + t * N;
+            const int32_t *thr = j->thr + t * N;
+            const uint8_t *sp = j->is_split + t * N;
+            int32_t node = 0;
+            for (int64_t lvl = 0; lvl < D; lvl++) {
+                if (!sp[node])
+                    break;
+                node = 2 * node + 1 + (row[feat[node]] > thr[node]);
+            }
+            if (acc) {
+                const float *lv = j->leaf + (t * N + node) * K;
+                for (int64_t c = 0; c < K; c++)
+                    acc[c] += lv[c];
+            } else {
+                j->out_nodes[s * T + t] = node;
+            }
+        }
+        if (acc) {
+            const float inv = 1.0f / (float)T;
+            for (int64_t c = 0; c < K; c++)
+                acc[c] *= inv;
+        }
+    }
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
 /* dispatch helpers                                                    */
 /* ------------------------------------------------------------------ */
 
@@ -454,11 +505,103 @@ fail:
     return NULL;
 }
 
+static PyObject *forest_walk(PyObject *self, PyObject *args) {
+    Py_buffer xb_buf, feat_buf, thr_buf, sp_buf;
+    Py_buffer leaf_buf = {0}, mean_buf = {0}, nodes_buf = {0};
+    PyObject *leaf_obj, *mean_obj, *nodes_obj;
+    Py_ssize_t n, d, T, N, K, D, n_threads;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*OOOnnnnnnn", &xb_buf, &feat_buf,
+                          &thr_buf, &sp_buf, &leaf_obj, &mean_obj,
+                          &nodes_obj, &n, &d, &T, &N, &K, &D, &n_threads))
+        return NULL;
+    if (leaf_obj != Py_None &&
+        PyObject_GetBuffer(leaf_obj, &leaf_buf, PyBUF_SIMPLE) < 0)
+        goto fail;
+    if (mean_obj != Py_None &&
+        PyObject_GetBuffer(mean_obj, &mean_buf, PyBUF_WRITABLE) < 0)
+        goto fail;
+    if (nodes_obj != Py_None &&
+        PyObject_GetBuffer(nodes_obj, &nodes_buf, PyBUF_WRITABLE) < 0)
+        goto fail;
+    if ((mean_buf.buf == NULL) == (nodes_buf.buf == NULL) ||
+        (mean_buf.buf != NULL && leaf_buf.buf == NULL)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "need exactly one of out_mean (with leaf) / "
+                        "out_nodes");
+        goto fail;
+    }
+    if (xb_buf.len < (Py_ssize_t)(n * d) ||
+        feat_buf.len < (Py_ssize_t)(T * N * sizeof(int32_t)) ||
+        thr_buf.len < (Py_ssize_t)(T * N * sizeof(int32_t)) ||
+        sp_buf.len < (Py_ssize_t)(T * N) ||
+        (leaf_buf.buf &&
+         leaf_buf.len < (Py_ssize_t)(T * N * K * sizeof(float))) ||
+        (mean_buf.buf &&
+         mean_buf.len < (Py_ssize_t)(n * K * sizeof(float))) ||
+        (nodes_buf.buf &&
+         nodes_buf.len < (Py_ssize_t)(n * T * sizeof(int32_t)))) {
+        PyErr_SetString(PyExc_ValueError, "buffer too small for shape");
+        goto fail;
+    }
+
+    {
+        int nt = clamp_threads(n_threads, n);
+        WalkJob jobs[64];
+        int64_t i0[64], i1[64];
+        int64_t chunk = (n + nt - 1) / nt;
+        for (int k = 0; k < nt; k++) {
+            i0[k] = k * chunk;
+            i1[k] = (k + 1) * chunk < n ? (k + 1) * chunk : n;
+            jobs[k] = (WalkJob){
+                .Xb = (const uint8_t *)xb_buf.buf,
+                .feat = (const int32_t *)feat_buf.buf,
+                .thr = (const int32_t *)thr_buf.buf,
+                .is_split = (const uint8_t *)sp_buf.buf,
+                .leaf = (const float *)leaf_buf.buf,
+                .out_mean = (float *)mean_buf.buf,
+                .out_nodes = (int32_t *)nodes_buf.buf,
+                .n = n, .d = d, .T = T, .N = N, .K = K, .D = D,
+                .s0 = i0[k], .s1 = i1[k],
+            };
+        }
+        Py_BEGIN_ALLOW_THREADS;
+        run_threaded(walk_samples, jobs, sizeof(WalkJob), i0, i1, nt);
+        Py_END_ALLOW_THREADS;
+    }
+
+    if (leaf_buf.buf)
+        PyBuffer_Release(&leaf_buf);
+    if (mean_buf.buf)
+        PyBuffer_Release(&mean_buf);
+    if (nodes_buf.buf)
+        PyBuffer_Release(&nodes_buf);
+    PyBuffer_Release(&xb_buf);
+    PyBuffer_Release(&feat_buf);
+    PyBuffer_Release(&thr_buf);
+    PyBuffer_Release(&sp_buf);
+    Py_RETURN_NONE;
+
+fail:
+    if (leaf_buf.buf)
+        PyBuffer_Release(&leaf_buf);
+    if (mean_buf.buf)
+        PyBuffer_Release(&mean_buf);
+    if (nodes_buf.buf)
+        PyBuffer_Release(&nodes_buf);
+    PyBuffer_Release(&xb_buf);
+    PyBuffer_Release(&feat_buf);
+    PyBuffer_Release(&thr_buf);
+    PyBuffer_Release(&sp_buf);
+    return NULL;
+}
+
 static PyMethodDef Methods[] = {
     {"hist_level", hist_level, METH_VARARGS,
      "accumulate per-level (tree, feature, node, bin, channel) histograms"},
     {"best_splits", best_splits, METH_VARARGS,
      "per-(tree, node) best split from a level histogram"},
+    {"forest_walk", forest_walk, METH_VARARGS,
+     "tree traversal: mean leaf values or final node ids per sample"},
     {NULL, NULL, 0, NULL},
 };
 

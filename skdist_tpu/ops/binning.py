@@ -40,6 +40,26 @@ def quantile_bin_edges(X, n_bins=32):
     return edges
 
 
+def apply_bins_np(X, edges):
+    """Numpy twin of :func:`apply_bins` (bit-identical bin ids —
+    ``searchsorted(e, x, 'right')`` counts edges <= x exactly like the
+    device kernel's ``sum(x >= e)``, and NaN is pinned to bin 0 to
+    match ``NaN >= e`` being all-false where searchsorted would send
+    it top): the host (C) forest engine's fit/predict path bins
+    without touching jax at all."""
+    X = np.asarray(X, np.float32)
+    edges = np.asarray(edges, np.float32)
+    out = np.empty(X.shape, np.int32)
+    for j in range(X.shape[1]):
+        col = X[:, j]
+        idx = np.searchsorted(edges[j], col, side="right")
+        nan = np.isnan(col)
+        if nan.any():
+            idx[nan] = 0
+        out[:, j] = idx
+    return out
+
+
 def apply_bins(X, edges):
     """Discretise X (n, d) with edges (d, B-1) → int32 bins (n, d).
 

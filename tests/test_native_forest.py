@@ -283,3 +283,62 @@ def test_native_n_jobs_minus_one_and_explicit_errors(clf_data):
         t_nat.predict_proba(X), t_xla.predict_proba(X), atol=1e-6
     )
     assert (t_nat.apply(X) == t_xla.apply(X)).all()
+
+
+def test_native_walker_matches_xla_walker(clf_data):
+    """Predict-side parity: the C walker (forest_walk) must agree with
+    the XLA walker on final nodes EXACTLY and on mean leaf values to
+    f32 round-off, for forests and single trees, predict and apply."""
+    import jax
+    import jax.numpy as jnp
+
+    from skdist_tpu.models.forest import _forest_walker
+    from skdist_tpu.models.tree import DecisionTreeClassifier
+    from skdist_tpu.ops.binning import apply_bins, apply_bins_np
+
+    from skdist_tpu.native import forest_walk_native
+
+    X, y = clf_data
+    f = RandomForestClassifier(
+        n_estimators=24, max_depth=6, random_state=0, hist_mode="native"
+    ).fit(X, y)
+    trees = jax.tree_util.tree_map(jnp.asarray, f._trees)
+    Xb = apply_bins(jnp.asarray(X), jnp.asarray(f._edges))
+    # binning twins agree bit-for-bit (incl. NaN pinned to bin 0)
+    np.testing.assert_array_equal(
+        np.asarray(Xb), apply_bins_np(X, f._edges)
+    )
+    Xnan = X[:8].copy()
+    Xnan[0, 0] = np.nan
+    np.testing.assert_array_equal(
+        np.asarray(apply_bins(jnp.asarray(Xnan), jnp.asarray(f._edges))),
+        apply_bins_np(Xnan, f._edges),
+    )
+    # drive the C kernel DIRECTLY (the estimator-level calls only
+    # reach it on a CPU-backed process — this must not pass vacuously)
+    Xb_np = apply_bins_np(X, f._edges)
+    p_c = forest_walk_native(Xb_np, f._trees, 6, mode="predict")
+    if p_c is None:
+        pytest.skip("C walker unavailable")
+    p_xla = np.asarray(_forest_walker(6, "predict")(trees, Xb))
+    np.testing.assert_allclose(p_c, p_xla, atol=1e-5)
+    np.testing.assert_allclose(f.predict_proba(X), p_xla, atol=1e-5)
+    a_xla = np.asarray(_forest_walker(6, "apply")(trees, Xb))
+    np.testing.assert_array_equal(
+        forest_walk_native(Xb_np, f._trees, 6, mode="apply"), a_xla
+    )
+    np.testing.assert_array_equal(f.apply(X), a_xla)
+    # a depth the arrays weren't built for must refuse (memory safety)
+    assert forest_walk_native(Xb_np, f._trees, 12, mode="apply") is None
+
+    t = DecisionTreeClassifier(max_depth=6).fit(X, y)
+    from skdist_tpu.models.tree import tree_predict_kernel
+
+    params = jax.tree_util.tree_map(jnp.asarray, t._params)
+    Xbt = apply_bins(jnp.asarray(X), params["edges"])
+    lv = np.asarray(tree_predict_kernel(6)(params, Xbt))
+    np.testing.assert_allclose(t.predict_proba(X), lv, atol=1e-6)
+    nodes = np.asarray(
+        tree_predict_kernel(6, return_nodes=True)(params, Xbt)
+    )
+    np.testing.assert_array_equal(t.apply(X), nodes)
