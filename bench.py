@@ -206,7 +206,10 @@ def _forest_calib_context():
             "engine": calib.get("mode"),
             "warm_100_trees_s": m.get("winner_100_trees_warm_s"),
             "cold_100_trees_s": m.get("winner_100_trees_cold_s"),
-            "sklearn_100_trees_s": m.get("sklearn_8core_100_trees_s"),
+            "sklearn_100_trees_s": m.get(
+                "sklearn_njobs_all_100_trees_s",
+                m.get("sklearn_8core_100_trees_s"),
+            ),
             "shape": m.get("shape"),
             "captured_at": m.get("captured_at"),
         }}

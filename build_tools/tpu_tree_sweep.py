@@ -157,7 +157,7 @@ def main():
         measured={
             "winner_100_trees_warm_s": full_s,
             "winner_100_trees_cold_s": round(walls[0], 2),
-            "sklearn_8core_100_trees_s": sk_s,
+            "sklearn_njobs_all_100_trees_s": sk_s,
             "ranking_20_trees": {
                 r["config"]: r["warm_s"] for r in ranking
             },
@@ -172,7 +172,7 @@ def main():
         "metric": "forest 100 trees 20k x 54 (warm wall)",
         "value": full_s, "unit": "s",
         "winner": best["config"],
-        "vs_sklearn_8core": round(sk_s / full_s, 2),
+        "vs_sklearn_njobs_all": round(sk_s / full_s, 2),
         "platform": platform,
     }), flush=True)
 

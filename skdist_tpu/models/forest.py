@@ -484,9 +484,15 @@ class _BaseForest(BaseEstimator):
         the XLA walker (accelerator platforms, C kernel unavailable)."""
         if jax.default_backend() != "cpu":
             return None
-        from ..native import forest_walk_native
+        from ..native import forest_walk_native, hist_tree_available
         from ..ops.binning import apply_bins_np
 
+        # availability first (binning a big X only to discard it on a
+        # compiler-less host would tax every predict); the width check
+        # falls through so the XLA path raises its usual loud shape
+        # error instead of the C walker reading past Xb
+        if not hist_tree_available() or X.shape[1] != len(self._edges):
+            return None
         n_jobs = getattr(self, "n_jobs", None)
         return forest_walk_native(
             apply_bins_np(X, self._edges), self._trees, self.max_depth,

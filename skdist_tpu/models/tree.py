@@ -617,16 +617,21 @@ class _BaseTree(BaseEstimator):
         None falls through to the XLA decision kernel."""
         if jax.default_backend() != "cpu":
             return None
-        from ..native import forest_walk_native
+        from ..native import forest_walk_native, hist_tree_available
         from ..ops.binning import apply_bins_np
 
+        # same ordering rationale as the forest's _native_walk:
+        # availability before binning; width mismatch falls through to
+        # the XLA path's loud shape error
+        edges = self._params["edges"]
+        if not hist_tree_available() or X.shape[1] != len(edges):
+            return None
         trees = {
             k: np.asarray(self._params[k])[None]
             for k in ("feat", "thr", "is_split", "leaf")
         }
         return forest_walk_native(
-            apply_bins_np(X, self._params["edges"]), trees,
-            self.max_depth, mode=mode,
+            apply_bins_np(X, edges), trees, self.max_depth, mode=mode,
         )
 
     def _leaf_values(self, X):
