@@ -2,9 +2,10 @@
 Distributed forests on digits (counterpart of the reference's
 examples/ensemble/basic_usage.py).
 
-Sample output (CPU backend):
-    -- RandomForest: 64 trees in 34.52s, holdout f1 0.9583
-    -- ExtraTrees: 64 trees in 54.50s, holdout f1 0.9751
+Sample output (CPU backend; the host C engine — hist_mode='native'
+via calibration — replaced the XLA scatter path's 34.5s / 54.5s walls):
+    -- RandomForest: 64 trees in 2.94s, holdout f1 0.9610
+    -- ExtraTrees: 64 trees in 0.97s, holdout f1 0.9583
     -- RandomTreesEmbedding: (1437, 64) -> (1437, 1008)
     -- pickle round-trip OK
 

@@ -11,9 +11,9 @@ encodes each sample's leaf per tree. A linearly-inseparable problem
 Bayes goes from coin-flip to ~0.97.
 
 Sample output (CPU backend):
-    Naive Bayes -- Transformed: 0.9439
+    Naive Bayes -- Transformed: 0.9472
     Naive Bayes -- Original:    0.4987
-    Extra Trees -- Transformed: 0.9412
+    Extra Trees -- Transformed: 0.9411
     Extra Trees -- Original:    0.9423
 
 Run: python examples/ensemble/tree_embedding.py

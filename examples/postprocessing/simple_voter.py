@@ -10,10 +10,10 @@ voting, with weights de-emphasising the weak naive Bayes member.
 
 Sample output (CPU backend):
     -- logreg alone:        accuracy 0.9472
-    -- forest alone:        accuracy 0.9583
+    -- forest alone:        accuracy 0.9639
     -- gaussian NB alone:   accuracy 0.8333
-    -- hard voter:          accuracy 0.9528
-    -- soft voter (2,2,1):  accuracy 0.9444
+    -- hard voter:          accuracy 0.9583
+    -- soft voter (2,2,1):  accuracy 0.9361
 
 Run: python examples/postprocessing/simple_voter.py
 """

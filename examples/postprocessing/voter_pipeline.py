@@ -4,10 +4,10 @@ the reference's examples/postprocessing/voter_pipeline.py: two grid
 searches + a big ERT voted together, 26x parallel efficiency on a
 32-core cluster).
 
-Sample output (CPU backend):
+Sample output (CPU backend; the ERT leg runs the host C engine):
     -- lr: holdout f1_weighted 0.9610
     -- lr_bal: holdout f1_weighted 0.9610
-    -- ert: holdout f1_weighted 0.9752
+    -- ert: holdout f1_weighted 0.9723
     -- voter: holdout f1_weighted 0.9694
 
 Run: python examples/postprocessing/voter_pipeline.py
