@@ -152,8 +152,15 @@ def main():
     # all measurements done — write the committed table
     os.environ.pop(hist_calib.PATH_ENV, None)
     os.unlink(scratch.name)
+    xla_ranked = [r for r in ranking
+                  if r["mode"] in ("scatter", "matmul", "pallas")]
+    best_xla = (
+        min(xla_ranked, key=lambda r: r["warm_s"]) if xla_ranked else None
+    )
     entry = hist_calib.record_calibration(
         platform, best["mode"], hist_block=best["block"] or 8,
+        xla_mode=best_xla["mode"] if best_xla else None,
+        xla_hist_block=(best_xla["block"] or 8) if best_xla else None,
         measured={
             "winner_100_trees_warm_s": full_s,
             "winner_100_trees_cold_s": round(walls[0], 2),

@@ -74,12 +74,20 @@ def get_calibration(platform):
 
 
 def record_calibration(platform, mode, hist_block=8, measured=None,
-                       source=None):
+                       source=None, xla_mode=None, xla_hist_block=None):
     """Persist a sweep result for ``platform`` (used by
     ``build_tools/tpu_tree_sweep.py``). Merges with existing entries so
-    a CPU sweep does not erase a TPU one."""
+    a CPU sweep does not erase a TPU one.
+
+    ``xla_mode``: the measured best IN-PROGRAM engine — recorded
+    alongside a ``"native"`` winner so callers that need an XLA
+    algorithm (distributed mesh fits) re-resolve to the measured XLA
+    runner-up instead of a shape heuristic."""
     if mode not in _VALID_MODES:
         raise ValueError(f"mode must be one of {_VALID_MODES}; got {mode!r}")
+    if xla_mode is not None and xla_mode not in ("scatter", "matmul",
+                                                 "pallas"):
+        raise ValueError(f"xla_mode must be an XLA engine; got {xla_mode!r}")
     path = _calib_path()
     with _LOCK:
         table = {}
@@ -95,6 +103,10 @@ def record_calibration(platform, mode, hist_block=8, measured=None,
             "measured": measured or {},
             "source": source or "build_tools/tpu_tree_sweep.py",
         }
+        if xla_mode is not None:
+            table[platform]["xla_mode"] = xla_mode
+            if xla_hist_block is not None:
+                table[platform]["xla_hist_block"] = int(xla_hist_block)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(table, f, indent=1, sort_keys=True)

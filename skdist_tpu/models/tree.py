@@ -87,16 +87,10 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
 
     d, B = n_features, n_bins
     explicit_native = hist_mode == "native"
-    calib = get_calibration(jax.default_backend())
+    resolved = hist_mode == "auto"  # every non-explicit path descends
+    calib = get_calibration(jax.default_backend()) or {}
     if hist_mode == "auto":
-        if calib is not None:
-            hist_mode = calib["mode"]
-            if (hist_mode in ("matmul", "pallas")
-                    and d * B > calib.get(
-                        "max_matmul_db", DEFAULT_MAX_MATMUL_DB)):
-                hist_mode = "scatter"
-        else:
-            hist_mode = "_heuristic"
+        hist_mode = calib["mode"] if calib else "_heuristic"
     if hist_mode == "native" and not allow_native:
         if explicit_native:
             # an explicit opt-in must not silently downgrade to the
@@ -107,17 +101,27 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
                 "(distributed mesh fits, batched search kernels); use "
                 "'auto' or an XLA mode ('scatter'/'matmul'/'pallas')"
             )
-        hist_mode = "_heuristic"
+        # prefer the sweep's MEASURED best XLA engine (and its
+        # measured block size) over the shape heuristic
+        xla = calib.get("xla_mode")
+        if xla in ("scatter", "matmul", "pallas"):
+            hist_mode = xla
+            if hist_block is None:
+                hist_block = (
+                    calib.get("xla_hist_block") or calib.get("hist_block")
+                )
+        else:
+            hist_mode = "_heuristic"
     if hist_mode == "_heuristic":
-        hist_mode = (
-            "matmul"
-            if jax.default_backend() != "cpu"
-            and d * B <= (calib or {}).get(
-                "max_matmul_db", DEFAULT_MAX_MATMUL_DB)
-            else "scatter"
-        )
+        hist_mode = "matmul" if jax.default_backend() != "cpu" else "scatter"
+    # single width guard for every RESOLVED path (an explicit
+    # matmul/pallas request is honoured as-is): the one-hot contraction
+    # is (n, d·B)-sized, degrade to scatter above the calibrated bound
+    if (resolved and hist_mode in ("matmul", "pallas")
+            and d * B > calib.get("max_matmul_db", DEFAULT_MAX_MATMUL_DB)):
+        hist_mode = "scatter"
     if hist_block is None:
-        hist_block = (calib or {}).get("hist_block") or 8
+        hist_block = calib.get("hist_block") or 8
     return hist_mode, int(hist_block)
 
 

@@ -342,3 +342,81 @@ def test_native_walker_matches_xla_walker(clf_data):
         tree_predict_kernel(6, return_nodes=True)(params, Xbt)
     )
     np.testing.assert_array_equal(t.apply(X), nodes)
+
+
+@pytest.mark.parametrize("cfg", [
+    dict(max_depth=3, n_bins=8, min_samples_leaf=1, min_samples_split=2),
+    dict(max_depth=7, n_bins=64, min_samples_leaf=1, min_samples_split=2),
+    dict(max_depth=5, n_bins=16, min_samples_leaf=20, min_samples_split=60),
+    dict(max_depth=6, n_bins=32, min_samples_leaf=1, min_samples_split=2,
+         min_impurity_decrease=0.01),
+])
+def test_native_xla_parity_fuzz(cfg):
+    """Deterministic configs (no subsampling/bootstrap) across varied
+    depth/bins/min-rules: host and XLA engines must grow identical
+    trees — classification and regression."""
+    rng = np.random.RandomState(42)
+    X = rng.normal(size=(1500, 9)).astype(np.float32)
+    X[:, 3] = np.round(X[:, 3], 1)  # low-cardinality feature (dup edges)
+    y_cls = (X[:, :4] @ rng.normal(size=4) > 0).astype(int) + (
+        X[:, 4] > 0.5
+    )
+    y_reg = (X[:, :5] @ rng.normal(size=5)).astype(np.float32)
+
+    kw = dict(n_estimators=3, bootstrap=False, max_features=None,
+              random_state=0, **cfg)
+    fc_x = RandomForestClassifier(hist_mode="scatter", **kw).fit(X, y_cls)
+    fc_n = RandomForestClassifier(hist_mode="native", **kw).fit(X, y_cls)
+    np.testing.assert_array_equal(fc_x._trees["feat"], fc_n._trees["feat"])
+    np.testing.assert_array_equal(fc_x._trees["thr"], fc_n._trees["thr"])
+    np.testing.assert_allclose(
+        fc_x.predict_proba(X), fc_n.predict_proba(X), atol=1e-6
+    )
+
+    # regression SSE gains cancel catastrophically in f32; the C
+    # engine's f64 accumulation (deliberately better-conditioned) can
+    # flip near-tie splits vs the XLA kernel, so the regression
+    # contract is statistical equivalence, not identity
+    from sklearn.metrics import r2_score
+
+    fr_x = RandomForestRegressor(hist_mode="scatter", **kw).fit(X, y_reg)
+    fr_n = RandomForestRegressor(hist_mode="native", **kw).fit(X, y_reg)
+    feat_agree = (fr_x._trees["feat"] == fr_n._trees["feat"]).mean()
+    assert feat_agree > 0.9, feat_agree
+    r2_x = r2_score(y_reg, fr_x.predict(X))
+    r2_n = r2_score(y_reg, fr_n.predict(X))
+    assert abs(r2_x - r2_n) < 0.02, (r2_x, r2_n)
+
+
+def test_in_xla_resolution_uses_measured_xla_runner_up(tmp_path,
+                                                       monkeypatch):
+    """When the calibrated winner is 'native' but the caller needs an
+    in-program engine (allow_native=False), resolution must take the
+    sweep's MEASURED best XLA mode — not the shape heuristic — with
+    the matmul width guard still applied."""
+    import json
+
+    import jax
+
+    from skdist_tpu.models import hist_calib
+    from skdist_tpu.models.tree import resolve_hist_config
+
+    table = {jax.default_backend(): {
+        "mode": "native", "hist_block": 8, "max_matmul_db": 16384,
+        "xla_mode": "matmul", "xla_hist_block": 54, "measured": {},
+        "source": "test",
+    }}
+    p = tmp_path / "calib.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv(hist_calib.PATH_ENV, str(p))
+    assert resolve_hist_config(54, 32, "auto") == ("native", 8)
+    # the runner-up's own measured block rides along
+    assert resolve_hist_config(
+        54, 32, "auto", allow_native=False
+    ) == ("matmul", 54)
+    # width guard: d*B over the bound degrades the measured matmul
+    assert resolve_hist_config(
+        4096, 32, "auto", allow_native=False
+    ) == ("scatter", 54)
+    # an EXPLICIT matmul request is honoured even above the bound
+    assert resolve_hist_config(4096, 32, "matmul")[0] == "matmul"
