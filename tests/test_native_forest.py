@@ -420,3 +420,40 @@ def test_in_xla_resolution_uses_measured_xla_runner_up(tmp_path,
     ) == ("scatter", 54)
     # an EXPLICIT matmul request is honoured even above the bound
     assert resolve_hist_config(4096, 32, "matmul")[0] == "matmul"
+
+
+@pytest.mark.skipif(
+    not native_forest_supported(32), reason="C hist kernel unavailable"
+)
+def test_c_kernels_thread_count_invariant(hist_inputs, clf_data):
+    """Threads partition disjoint (tree, feature) / (tree, node) /
+    sample slabs, so results must be BITWISE identical for any thread
+    count — and running with n_threads=4 actually exercises the
+    pthread paths that a 1-core CI host would otherwise never spawn."""
+    XbT, node_rel, W, cls, _, (Tb, d, nl, B, C) = hist_inputs
+    h1 = np.empty((Tb, d, nl, B, C), np.float32)
+    hist_level(h1, XbT, node_rel, W, cls=cls, n_threads=1)
+    h4 = np.empty((Tb, d, nl, B, C), np.float32)
+    hist_level(h4, XbT, node_rel, W, cls=cls, n_threads=4)
+    np.testing.assert_array_equal(h1, h4)
+
+    r1 = best_splits_native(h1, None, None, C - 1, True, 2, n_threads=1)
+    r4 = best_splits_native(h1, None, None, C - 1, True, 2, n_threads=4)
+    if r1 is not None:
+        for a, b in zip(r1, r4):
+            np.testing.assert_array_equal(a, b)
+
+    X, y = clf_data
+    f1 = RandomForestClassifier(
+        n_estimators=8, max_depth=5, random_state=0, hist_mode="native",
+        n_jobs=1,
+    ).fit(X, y)
+    f4 = RandomForestClassifier(
+        n_estimators=8, max_depth=5, random_state=0, hist_mode="native",
+        n_jobs=4,
+    ).fit(X, y)
+    for k in ("feat", "thr", "is_split", "leaf", "gain"):
+        np.testing.assert_array_equal(f1._trees[k], f4._trees[k])
+    np.testing.assert_array_equal(
+        f1.predict_proba(X), f4.predict_proba(X)
+    )
