@@ -440,12 +440,37 @@ class _BaseForest(BaseEstimator):
         bootstrap missed it. The per-tree masks are consumed here and
         stripped from the fitted trees — they index the training rows
         and must not survive into predict/pickle/warm-start."""
-        trees = jax.tree_util.tree_map(jnp.asarray, self._trees)
-        Xb = apply_bins(jnp.asarray(X), jnp.asarray(self._edges))
-        oob_agg = _oob_aggregator(self.max_depth)
-        agg, cnt = jax.device_get(
-            oob_agg(trees, trees["seed"], Xb)
-        )
+        nodes = self._native_walk(X, "apply")
+        if nodes is not None:
+            # host path: per-tree leaf gather + mask, no XLA walker
+            # compile; ONLY the bootstrap-draw regeneration stays on
+            # jax (PRNG parity with the device path is the contract)
+            n, T = nodes.shape
+            leaf = np.asarray(self._trees["leaf"])  # (T, N, K)
+            seeds = np.asarray(self._trees["seed"])
+            num = np.zeros((n, leaf.shape[2]), np.float32)
+            cnt = np.zeros(n, np.float32)
+            # seeds in chunks: the counts matrix stays (16, n)-sized,
+            # honouring the same no-(T, n)-materialisation contract as
+            # _fit_native's weights() callback
+            ch = 16
+            for t0 in range(0, T, ch):
+                counts = np.asarray(_bootstrap_counts_batch(n)(
+                    jnp.asarray(seeds[t0:t0 + ch])
+                ))
+                for i in range(counts.shape[0]):
+                    t = t0 + i
+                    m = counts[i] == 0
+                    num[m] += leaf[t, nodes[m, t]]
+                    cnt += m
+            agg = num / np.maximum(cnt, 1.0)[:, None]
+        else:
+            trees = jax.tree_util.tree_map(jnp.asarray, self._trees)
+            Xb = apply_bins(jnp.asarray(X), jnp.asarray(self._edges))
+            oob_agg = _oob_aggregator(self.max_depth)
+            agg, cnt = jax.device_get(
+                oob_agg(trees, trees["seed"], Xb)
+            )
         covered = np.asarray(cnt) > 0
         if not covered.all():
             import warnings

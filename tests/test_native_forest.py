@@ -457,3 +457,30 @@ def test_c_kernels_thread_count_invariant(hist_inputs, clf_data):
     np.testing.assert_array_equal(
         f1.predict_proba(X), f4.predict_proba(X)
     )
+
+
+def test_native_oob_aggregation_matches_xla(clf_data):
+    """The host OOB aggregation (native walker nodes + numpy per-tree
+    gather) must reproduce the XLA _oob_aggregator on the same trees to
+    f32 round-off — same bootstrap draws, same masks, same means."""
+    import jax
+    import jax.numpy as jnp
+
+    from skdist_tpu.models.forest import _oob_aggregator
+    from skdist_tpu.ops.binning import apply_bins
+
+    X, y = clf_data
+    f = RandomForestClassifier(
+        n_estimators=30, max_depth=6, random_state=0, oob_score=True,
+        hist_mode="native",
+    ).fit(X, y)
+    if f._native_walk(X, "apply") is None:
+        pytest.skip("host OOB branch unavailable on this backend")
+    trees = jax.tree_util.tree_map(jnp.asarray, f._trees)
+    Xb = apply_bins(jnp.asarray(X), jnp.asarray(f._edges))
+    agg_x, cnt_x = jax.device_get(
+        _oob_aggregator(6)(trees, trees["seed"], Xb)
+    )
+    np.testing.assert_allclose(
+        f.oob_decision_function_, agg_x, atol=1e-5
+    )
