@@ -168,8 +168,11 @@ run_bench_step() {
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     echo "[tpu_watch] tunnel answering at $(date -u +%H:%M:%S); capturing to $LOGDIR"
-    run_step tree_sweep 1500 python build_tools/tpu_tree_sweep.py || continue
+    # headline bench FIRST: a short window (round-2's lasted ~35 min)
+    # must land the round's full-size TPU line before anything else
+    # gets to burn the window
     run_bench_step || continue
+    run_step tree_sweep 1500 python build_tools/tpu_tree_sweep.py || continue
     run_step baseline_suite 2400 python benchmarks/run_all.py --ref || continue
     run_step bf16_check 1800 python build_tools/tpu_bf16_check.py || continue
     sleep 180
