@@ -167,6 +167,12 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
       heuristic: matmul on accelerators for tabular widths, scatter
       otherwise. ``hist_block=None`` likewise takes the calibrated
       scatter block size.
+
+    A fifth engine, ``"native"`` (the host C kernels of
+    ``models/native_forest.py``), lives OUTSIDE this builder: estimator
+    ``fit`` paths route to it before building an XLA kernel, and a
+    calibrated ``"native"`` re-resolves here to the sweep's measured
+    XLA runner-up (``resolve_hist_config(allow_native=False)``).
     """
     d, B, C, D = n_features, n_bins, channels, max_depth
     K = C - 1 if classification else 1  # leaf output width
