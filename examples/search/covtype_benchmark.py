@@ -31,6 +31,10 @@ sklearn's Cython engine on the same cores):
     skdist_tpu RF 100 trees            6.3  fit 0.7300
     sklearn RF 100 trees (-1)          7.1  fit 0.7375
 
+At full covtype scale the forest margin grows (matched data, 80k
+train): native 18.6s vs sklearn 34.8s per 100 trees — 1.9x — with
+holdout f1 within 0.005 (0.6693 vs 0.6739).
+
 Run: python examples/search/covtype_benchmark.py [--rows 100000] [--head-to-head]
 """
 
