@@ -702,12 +702,13 @@ class SGDClassifier(_LinearClassifierBase):
     _static_names = (
         "max_iter", "fit_intercept", "class_weight", "loss", "penalty",
         "learning_rate", "batch_size", "random_state",
+        "n_iter_no_change",
     )
 
     def __init__(self, loss="hinge", penalty="l2", alpha=1e-4, l1_ratio=0.15,
                  max_iter=20, tol=1e-3, fit_intercept=True, eta0=0.01,
                  learning_rate="optimal", class_weight=None, random_state=0,
-                 batch_size=64):
+                 batch_size=64, n_iter_no_change=5):
         self.loss = loss
         self.penalty = penalty
         self.alpha = alpha
@@ -720,6 +721,7 @@ class SGDClassifier(_LinearClassifierBase):
         self.class_weight = class_weight
         self.random_state = random_state
         self.batch_size = batch_size
+        self.n_iter_no_change = n_iter_no_change
 
     @classmethod
     def _build_fit_kernel(cls, meta, static):
@@ -730,6 +732,13 @@ class SGDClassifier(_LinearClassifierBase):
         loss_name, penalty = st["loss"], st["penalty"]
         lr_kind = st["learning_rate"]
         max_iter, batch_size = st["max_iter"], st["batch_size"]
+        n_iter_no_change = int(st["n_iter_no_change"])
+        if n_iter_no_change < 1:
+            # sklearn raises for this too; silently freezing after the
+            # first epoch (bad_new=0 >= 0) would under-train the model
+            raise ValueError(
+                f"n_iter_no_change must be >= 1; got {n_iter_no_change}"
+            )
         seed = st["random_state"] or 0
         class_weight, cw_arr = st["class_weight"], meta.get("cw_arr")
         n_out = 1 if k <= 2 else k
@@ -856,6 +865,7 @@ class SGDClassifier(_LinearClassifierBase):
                 W, n_epochs = sgd_minimize(
                     grad_fn, W0, n, key, max_iter, batch_size,
                     lr_fn, loss_fn=loss_fn, tol=tol,
+                    n_iter_no_change=n_iter_no_change,
                     post_step=post_step,
                     post_state=(jnp.float32(0.0), jnp.zeros_like(W0)),
                 )
@@ -863,6 +873,7 @@ class SGDClassifier(_LinearClassifierBase):
                 W, n_epochs = sgd_minimize(
                     grad_fn, W0, n, key, max_iter, batch_size, lr_fn,
                     loss_fn=loss_fn, tol=tol,
+                    n_iter_no_change=n_iter_no_change,
                 )
             W = W.reshape(p, n_out)
             if n_out == 1:

@@ -294,3 +294,33 @@ def test_sgd_l1_truncation_yields_exact_zeros():
     junk_zero = (W[d_info:] == 0).mean()
     info_zero = (W[:d_info] == 0).mean()
     assert junk_zero > info_zero, (junk_zero, info_zero)
+
+
+def test_sgd_n_iter_no_change_param():
+    """sklearn-parity surface: a larger patience must never stop
+    EARLIER, and patience=1 stops at or before the default's epoch."""
+    from skdist_tpu.models import SGDClassifier
+
+    rng = np.random.RandomState(2)
+    X = rng.normal(size=(3000, 12)).astype(np.float32)
+    y = (X[:, :4] @ rng.normal(size=(4, 3))).argmax(1)
+    kw = dict(loss="log_loss", alpha=1e-4, max_iter=150, tol=1e-3,
+              random_state=0)
+    it_patient = int(
+        SGDClassifier(n_iter_no_change=10, **kw).fit(X, y).n_iter_
+    )
+    it_default = int(SGDClassifier(**kw).fit(X, y).n_iter_)
+    it_impatient = int(
+        SGDClassifier(n_iter_no_change=1, **kw).fit(X, y).n_iter_
+    )
+    assert it_impatient <= it_default <= it_patient
+    assert it_impatient < 150
+
+
+def test_sgd_n_iter_no_change_validation():
+    from skdist_tpu.models import SGDClassifier
+
+    X = np.zeros((10, 2), np.float32)
+    y = np.array([0, 1] * 5)
+    with pytest.raises(ValueError, match="n_iter_no_change"):
+        SGDClassifier(n_iter_no_change=0, max_iter=5).fit(X, y)
