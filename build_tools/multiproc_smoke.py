@@ -83,6 +83,26 @@ def child(pid):
     print("SCORES", pid, list(np.round(gs.cv_results_["mean_test_score"], 6)),
           flush=True)
 
+    # forest leg: per-task outputs here are PYTREES OF TREES (not
+    # scalar scores), so collect() exercises the cross-process gather
+    # of large structured leaves; every process must reassemble the
+    # same forest
+    from skdist_tpu.distribute.ensemble import DistRandomForestClassifier
+
+    f = DistRandomForestClassifier(
+        n_estimators=4, max_depth=4, n_bins=8, random_state=0,
+        backend=TPUBackend(mesh=mesh), hist_mode="scatter",
+    ).fit(X, y)
+    proba = f.predict_proba(X)
+    print("FOREST", pid, [
+        int(np.asarray(f._trees["feat"]).sum()),
+        int(np.asarray(f._trees["thr"]).sum()),
+        # column-0 mean discriminates (rows sum to 1, so the GLOBAL
+        # mean would be a constant 1/k for every possible forest)
+        round(float(proba[:, 0].mean()), 6),
+        round(float((f.predict(X) == y).mean()), 6),
+    ], flush=True)
+
 
 def _subset_child(pid):
     """Processes 0..NPROCS-2 run a grid search on a mesh of THEIR
@@ -176,6 +196,17 @@ def main():
     vecs = {ln.split("[", 1)[1] for ln in score_lines}
     vr = ref_line[0].split("[", 1)[1]
     assert vecs == {vr}, (vecs, vr)
+    if not SUBSET:
+        # every process must have reassembled the SAME forest from the
+        # cross-process gather of fitted-tree pytrees
+        forest_lines = [
+            ln for out in outs for ln in out.splitlines()
+            if ln.startswith("FOREST")
+        ]
+        fvecs = {ln.split("[", 1)[1] for ln in forest_lines}
+        if len(forest_lines) != NPROCS or len(fvecs) != 1:
+            print("MULTIPROC SMOKE: FAIL (forest gather)")
+            sys.exit(1)
     print(f"MULTIPROC SMOKE: PASS ({n_expected} fitting processes match "
           "the single-process run)")
 
