@@ -9,6 +9,11 @@ The reference's concrete classes are diamond-inheritance shims that add
 Dist* classes add ``backend``/``partitions`` to the skdist_tpu forest
 kernels and route the tree axis through ``backend.batched_map``, so
 trees shard over the TPU mesh in rounds instead of Spark executors.
+With the default LocalBackend (the ``sc=None`` analogue) on a platform
+whose calibration names it, fits run the host C engine instead
+(``models/native_forest.py`` — measured faster than sklearn's Cython
+trees, ``models/hist_calib.json``); both engines produce the same
+stacked-tree artifact, so predict/OOB/pickle are engine-agnostic.
 Post-fit, the backend handle is stripped so the artifact pickles clean
 (the reference's ``del self.sc``, ensemble.py:335).
 """
