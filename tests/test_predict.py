@@ -242,3 +242,29 @@ def test_device_csr_budget_checked_before_pack(clf_data, tpu_backend,
     assert max(packed_rows) < Xs.shape[0]          # full matrix never packed
     assert all(r * m * 8 <= budget // 2 for r in packed_rows)
     np.testing.assert_allclose(out, expected, atol=1e-6)
+
+
+def test_batch_predict_and_udf_with_forest(clf_data, tpu_backend):
+    """Forest models ride batch_predict's host-chunk path (no device
+    proba kernel) — on CPU that is the native C walker — and the
+    pandas-UDF wrapper; outputs must match direct predict exactly."""
+    from skdist_tpu.models.forest import RandomForestClassifier
+
+    X, y = clf_data
+    model = RandomForestClassifier(
+        n_estimators=12, max_depth=5, random_state=0
+    ).fit(X, y)
+    direct = model.predict_proba(X)
+
+    out = batch_predict(model, X, method="predict_proba",
+                        backend=tpu_backend, batch_size=64)
+    np.testing.assert_allclose(out, direct, atol=1e-6)
+    preds = batch_predict(model, X, method="predict", batch_size=100)
+    assert (preds == model.predict(X)).all()
+
+    udf = get_prediction_udf(model, method="predict_proba",
+                             feature_type="numpy")
+    cols = [pd.Series(X[:, j]) for j in range(X.shape[1])]
+    proba_rows = udf(*cols)
+    np.testing.assert_allclose(np.stack(proba_rows.values), direct,
+                               atol=1e-6)
