@@ -378,6 +378,14 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
             span_rows = (
                 self._mask_span_rows(n) if use_masks else int(live.size)
             )
+            if span_rows < int(live.size):
+                # keep one round shape across the per-span dispatches
+                # below (round-4 advisor: a shrunken tail round_size
+                # meant an extra XLA compile for the final span): the
+                # round never exceeds the memory-bounded span, and the
+                # span is sized as a multiple of the round
+                round_size = min(round_size, span_rows)
+                span_rows -= span_rows % round_size
             spans = [
                 (lo, min(lo + span_rows, int(live.size)))
                 for lo in range(0, int(live.size), span_rows)
@@ -390,9 +398,8 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
                         Y, live[lo:hi]
                     )
                 parts.append(backend.batched_map(
-                    kernel, task_args, shared,
-                    round_size=min(round_size, hi - lo),
-                    shared_specs=specs,
+                    kernel, task_args, shared, round_size=round_size,
+                    shared_specs=specs, pad_to_round=len(spans) > 1,
                 ))
             stacked = parts[0] if len(parts) == 1 else (
                 jax.tree_util.tree_map(

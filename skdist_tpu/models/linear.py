@@ -688,7 +688,12 @@ class SGDClassifier(_LinearClassifierBase):
     weights while the scan runs on), so a whole randomized search still
     compiles to one program; ``n_iter_`` reports the real per-task
     epoch count. ``tol=None`` maps to ``-inf`` and reproduces the
-    fixed-``max_iter`` run.
+    fixed-``max_iter`` run. One deliberate divergence: the tracked
+    epoch loss is evaluated on each batch *after* its gradient step
+    (sklearn accumulates the pre-update loss during the step), so
+    ``n_iter_`` can differ from sklearn by an epoch or two at the same
+    ``tol`` — the post-update loss is what one fused scan step can
+    compute without a second forward pass per batch.
 
     L1 / elastic-net apply sklearn's truncated-gradient cumulative
     penalty (Tsuruoka et al.) as a stateful post-step — weights are

@@ -194,7 +194,28 @@ def grow_forest_native(Xb, y, W, seeds, *, n_bins, max_depth, max_features,
     msl, mss = int(min_samples_leaf), int(min_samples_split)
     mid = float(min_impurity_decrease)
     cls = np.ascontiguousarray(y, np.int32) if classification else None
+    if cls is not None and cls.size:
+        # the C kernel indexes histograms by class with no bounds
+        # check (native/hist_tree.c hist_level) — raw labels or an
+        # understated n_classes would corrupt heap memory, so the
+        # range is validated host-side before the buffer is handed off
+        lo, hi = int(cls.min()), int(cls.max())
+        if lo < 0 or hi >= K:
+            raise ValueError(
+                f"grow_forest_native expects encoded class indices in "
+                f"[0, {K - 1}] (n_classes={K}); got range [{lo}, {hi}]"
+            )
     yv = None if classification else np.ascontiguousarray(y, np.float32)
+    if n:
+        # same defense for bin values: the C kernel's histogram index
+        # (node*B + bin)*C has no bounds check either, and the uint8
+        # casts below would silently truncate wider values
+        bmin, bmax = int(np.min(Xb)), int(np.max(Xb))
+        if bmin < 0 or bmax >= B:
+            raise ValueError(
+                f"grow_forest_native expects binned features in "
+                f"[0, {B - 1}] (n_bins={B}); got range [{bmin}, {bmax}]"
+            )
     XbT = np.ascontiguousarray(np.asarray(Xb).T, np.uint8)
     Xb = np.ascontiguousarray(Xb, np.uint8)
     if not callable(W):

@@ -298,20 +298,23 @@ static void *walk_samples(void *arg) {
 
 static int run_threaded(void *(*fn)(void *), void *jobs, size_t job_size,
                         int64_t *item0s, int64_t *item1s, int nt) {
+    /* pthread_t is opaque (a struct on some platforms), so thread
+     * liveness is tracked in a separate flag array rather than by
+     * sentinel-zeroing the handles. */
     pthread_t tids[64];
+    char started[64] = {0};
     for (int k = 0; k < nt; k++) {
         char *job = (char *)jobs + k * job_size;
-        if (item0s[k] >= item1s[k]) {
-            tids[k] = 0;
+        if (item0s[k] >= item1s[k])
             continue;
-        }
         if (k == nt - 1 || pthread_create(&tids[k], NULL, fn, job) != 0) {
-            tids[k] = 0;
             fn(job); /* last chunk (or spawn failure) runs inline */
+        } else {
+            started[k] = 1;
         }
     }
     for (int k = 0; k < nt; k++)
-        if (tids[k])
+        if (started[k])
             pthread_join(tids[k], NULL);
     return 0;
 }

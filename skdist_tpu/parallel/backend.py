@@ -83,7 +83,8 @@ class TaskBackend:
         raise NotImplementedError
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None, shared_specs=None, return_timings=False):
+                    round_size=None, shared_specs=None, return_timings=False,
+                    pad_to_round=False):
         raise NotImplementedError
 
     # fitted estimators must never hold a live backend; give pickle a
@@ -124,17 +125,25 @@ class LocalBackend(TaskBackend):
             return list(pool.map(fn, tasks))
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None, shared_specs=None, return_timings=False):
+                    round_size=None, shared_specs=None, return_timings=False,
+                    pad_to_round=False):
         """Run the stacked kernel on the host's default JAX device.
 
         Same compiled program as the TPU path minus the mesh sharding, so
         local and distributed results agree bit-for-bit per device type.
         ``round_size`` bounds tasks per compiled round (memory knob),
-        exactly as on the device backend.
+        exactly as on the device backend. ``pad_to_round`` keeps the
+        round shape AT ``round_size`` even when fewer tasks remain
+        (padding duplicates the last task; outputs are sliced off in
+        ``_run_in_rounds``) — for callers issuing several dispatches
+        that must reuse one compiled shape.
         """
         fn = _jit_vmapped(kernel, static_args)
         n_tasks = _leading_dim(task_args)
-        chunk = min(n_tasks, round_size or n_tasks)
+        if pad_to_round and round_size:
+            chunk = round_size
+        else:
+            chunk = min(n_tasks, round_size or n_tasks)
         timings = [] if return_timings else None
         try:
             out = _run_in_rounds(
@@ -271,7 +280,8 @@ class TPUBackend(TaskBackend):
         return _BroadcastHandle(value)
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
-                    round_size=None, shared_specs=None, return_timings=False):
+                    round_size=None, shared_specs=None, return_timings=False,
+                    pad_to_round=False):
         """Stack → shard → compile once → run in rounds → gather.
 
         ``task_args``: pytree whose leaves have a leading axis of length
@@ -280,8 +290,13 @@ class TPUBackend(TaskBackend):
         ``shared_specs`` (a pytree matching ``shared_args`` with specs
         at row-sharded leaves and None for replicated; only meaningful
         with a 'data' mesh axis). ``round_size`` (per-call, falls back
-        to the backend default) bounds tasks per round. Returns host
-        numpy, leading axis n_tasks.
+        to the backend default) bounds tasks per round.
+        ``pad_to_round`` keeps the round shape AT ``round_size`` even
+        when fewer tasks remain (``_run_in_rounds`` pads by duplicating
+        the last task and slices its outputs off) — for callers issuing
+        several dispatches that must reuse one compiled shape; the
+        proactive/reactive HBM shrinking below still wins over it.
+        Returns host numpy, leading axis n_tasks.
         """
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -289,7 +304,7 @@ class TPUBackend(TaskBackend):
         n_tasks = _leading_dim(task_args)
         d = self.n_devices
         round_size = round_size or self.round_size or n_tasks
-        chunk = min(n_tasks, round_size)
+        chunk = round_size if pad_to_round else min(n_tasks, round_size)
         chunk = int(math.ceil(chunk / d) * d)
 
         task_sharding = NamedSharding(self.mesh, P(self.axis_name))

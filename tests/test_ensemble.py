@@ -198,10 +198,11 @@ def test_oob_with_warm_start(clf_data):
     """OOB masks regenerate from stored seeds, so warm-started trees
     participate and nothing O(n) is persisted (regression)."""
     X, y = clf_data
-    rf = DistRandomForestClassifier(
-        n_estimators=10, max_depth=5, random_state=0, oob_score=True,
-        warm_start=True,
-    ).fit(X, y)
+    with pytest.warns(UserWarning, match="in-bag for every tree"):
+        rf = DistRandomForestClassifier(
+            n_estimators=10, max_depth=5, random_state=0, oob_score=True,
+            warm_start=True,
+        ).fit(X, y)
     first = rf.oob_score_
     rf.n_estimators = 20
     rf.fit(X, y)

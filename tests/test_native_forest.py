@@ -236,6 +236,32 @@ def test_native_chunking_matches_single_chunk(clf_data):
         np.testing.assert_array_equal(big[k], small[k])
 
 
+def test_grow_forest_rejects_out_of_range_labels():
+    """Raw (unencoded) labels or an understated n_classes must raise
+    host-side — the C histogram kernel has no bounds check and would
+    silently corrupt heap memory (round-4 advisor)."""
+    rng = np.random.RandomState(0)
+    Xb = rng.randint(0, 8, size=(40, 3)).astype(np.uint8)
+    W = np.ones((2, 40), np.float32)
+    kw = dict(
+        n_bins=8, max_depth=3, max_features=3, min_samples_split=2,
+        min_samples_leaf=1, min_impurity_decrease=0.0, extra=False,
+        classification=True, n_classes=3,
+    )
+    for bad_y in (
+        rng.choice([1, 2, 3], size=40),   # understated n_classes
+        rng.choice([-1, 0, 1], size=40),  # negative label
+    ):
+        with pytest.raises(ValueError, match="encoded class indices"):
+            grow_forest_native(Xb, bad_y, W, seeds=[0, 1], **kw)
+    # bin values outside [0, n_bins) hit the same unchecked C index
+    y_ok = rng.choice([0, 1, 2], size=40)
+    bad_Xb = Xb.astype(np.int32)
+    bad_Xb[3, 1] = 8  # == n_bins
+    with pytest.raises(ValueError, match="binned features"):
+        grow_forest_native(bad_Xb, y_ok, W, seeds=[0, 1], **kw)
+
+
 def test_native_n_jobs_minus_one_and_explicit_errors(clf_data):
     """Review findings: joblib's n_jobs=-1 convention must reach the C
     kernel as 'all cores' (not clamp to ONE thread), and an explicit

@@ -142,6 +142,52 @@ def test_simple_voter_soft(clf_data):
     assert "a" in voter.named_estimators
 
 
+def test_simple_voter_weighted_hard_and_drop():
+    """The vectorized one-hot vote must honor weights exactly (a 2.0
+    weight outvotes two 0.9 weights), break ties toward the lowest
+    class index, and exclude dropped members from both the vote and
+    the weight vector."""
+
+    class Stub:
+        def __init__(self, preds):
+            self._p = np.asarray(preds)
+
+        def predict(self, X):
+            return self._p[: len(X)]
+
+    X = np.zeros((4, 2))
+    classes = np.array([0, 1, 2])
+    a = Stub([1, 1, 0, 2])
+    b = Stub([2, 1, 1, 0])
+    c = Stub([2, 0, 1, 0])
+    voter = SimpleVoter(
+        [("a", a), ("b", b), ("c", c)], classes,
+        voting="hard", weights=[2.0, 0.9, 0.9],
+    )
+    # row 0: class1 w=2.0 vs class2 w=1.8 -> 1; row 1: 1,1,0 -> 1
+    # row 2: 0 w=2.0 vs 1 w=1.8 -> 0; row 3: 2 w=2.0 vs 0 w=1.8 -> 2
+    np.testing.assert_array_equal(voter.predict(X), [1, 1, 0, 2])
+    # unweighted tie (one vote each) resolves to the lowest class index
+    tie = SimpleVoter([("a", a), ("b", b)], classes, voting="hard")
+    np.testing.assert_array_equal(tie.predict(X), [1, 1, 0, 0])
+    # dropped member is excluded from vote and weight alignment
+    dropped = SimpleVoter(
+        [("a", a), ("b", "drop"), ("c", c)], classes,
+        voting="hard", weights=[1.0, 100.0, 3.0],
+    )
+    assert len(dropped.estimators_) == 2
+    np.testing.assert_array_equal(dropped.predict(X), [2, 0, 1, 0])
+    # the implementation must stay vectorized: predict must not fall
+    # back to a per-row apply_along_axis loop
+    from unittest import mock
+
+    with mock.patch(
+        "numpy.apply_along_axis",
+        side_effect=AssertionError("per-row vote loop"),
+    ):
+        np.testing.assert_array_equal(voter.predict(X), [1, 1, 0, 2])
+
+
 def test_truncated_svd_recovers_low_rank():
     """The guardrail's named remedy (models/linear.py:106) must exist
     and work: on an exactly rank-k matrix the randomized SVD recovers
