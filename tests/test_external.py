@@ -1,0 +1,131 @@
+"""External-estimator tier that EXECUTES in CI (round-4 VERDICT task 6).
+
+The xgboost tier (tests/test_xgboost.py, mirroring reference
+``skdist/tests/test_spark.py:165-187``) permanently skips in the baked
+environment. This file drives the same contract — an arbitrary
+third-party sklearn-API estimator with no skdist_tpu batched contract,
+fanned out through ``backend.run_tasks`` with fit_params passed through
+per fold — using an estimator that IS installed: sklearn's
+HistGradientBoostingClassifier, extended xgboost-style with an
+``eval_set`` fit param so the non-row-aligned passthrough executes
+every run.
+"""
+
+import numpy as np
+import pytest
+from sklearn.ensemble import HistGradientBoostingClassifier
+
+from skdist_tpu.distribute.search import (
+    DistGridSearchCV,
+    DistRandomizedSearchCV,
+)
+from skdist_tpu.parallel import TPUBackend
+
+
+class EvalSetHGB(HistGradientBoostingClassifier):
+    """Third-party-style estimator: xgboost's fit signature shape
+    (``eval_set`` + row-aligned ``sample_weight``) on top of an
+    installed library. Records what fit actually received so the test
+    can assert the per-fold slicer's behavior."""
+
+    received = []  # class-level: fits may run on worker threads
+
+    def fit(self, X, y, sample_weight=None, eval_set=None):
+        EvalSetHGB.received.append({
+            "n_rows": len(X),
+            "sw_len": None if sample_weight is None else len(sample_weight),
+            "eval_set": eval_set,
+        })
+        if eval_set is not None:
+            # consume it like xgboost would: score against the holdout
+            Xe, ye = eval_set[0]
+            assert len(Xe) == len(ye)
+        return super().fit(X, y, sample_weight=sample_weight)
+
+
+@pytest.fixture
+def data():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(240, 8)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    return X, y
+
+
+def test_external_estimator_fit_params_passthrough(data):
+    """Row-aligned sample_weight must be sliced to each fold's rows;
+    the non-row-aligned eval_set (list of tuples) must arrive at every
+    fit untouched (reference ``_index_param_value`` semantics)."""
+    X, y = data
+    X_hold = X[:30] + 0.1
+    y_hold = y[:30]
+    sw = np.ones(len(y))
+
+    EvalSetHGB.received = []
+    clf = DistRandomizedSearchCV(
+        EvalSetHGB(max_iter=20, random_state=0),
+        {"max_depth": [2, 3]}, cv=3, n_iter=2, random_state=0,
+    )
+    clf.fit(X, y, sample_weight=sw, eval_set=[(X_hold, y_hold)])
+
+    # 2 candidates x 3 folds + 1 refit
+    fold_fits = [r for r in EvalSetHGB.received if r["n_rows"] < len(y)]
+    assert len(fold_fits) == 6
+    refits = [r for r in EvalSetHGB.received if r["n_rows"] == len(y)]
+    assert len(refits) == 1
+    for r in fold_fits:
+        # sliced with the fold, not full-length, not dropped
+        assert r["sw_len"] == r["n_rows"]
+        # non-row-aligned param untouched: same object shapes through
+        es = r["eval_set"]
+        assert isinstance(es, list) and len(es) == 1
+        assert es[0][0] is X_hold and es[0][1] is y_hold
+    assert hasattr(clf, "best_score_")
+    assert clf.score(X, y) > 0.9
+
+
+def test_external_estimator_rides_device_backend_host_path(data):
+    """A device backend must still fan external estimators out through
+    its generic host ``run_tasks`` leg (like pyspark running a python
+    closure), and agree with the local backend's scores."""
+    X, y = data
+    grid = {"max_depth": [2, 3]}
+    EvalSetHGB.received = []
+    local = DistGridSearchCV(
+        EvalSetHGB(max_iter=20, random_state=0), grid, cv=3, refit=False,
+    ).fit(X, y, eval_set=[(X[:10], y[:10])])
+    dev = DistGridSearchCV(
+        EvalSetHGB(max_iter=20, random_state=0), grid, cv=3, refit=False,
+        backend=TPUBackend(),
+    ).fit(X, y, eval_set=[(X[:10], y[:10])])
+    np.testing.assert_allclose(
+        local.cv_results_["mean_test_score"],
+        dev.cv_results_["mean_test_score"],
+    )
+    # every fit saw the eval_set: the device backend did not strip
+    # fit_params on its host leg
+    assert all(r["eval_set"] is not None for r in EvalSetHGB.received)
+
+
+def test_external_estimator_error_score_contract(data):
+    """A third-party estimator that raises on one candidate must ride
+    the error_score contract, not abort the search (reference
+    search.py fit-failure semantics)."""
+    X, y = data
+
+    class Flaky(EvalSetHGB):
+        def fit(self, X, y, sample_weight=None, eval_set=None):
+            if self.max_depth == 3:
+                raise ValueError("boom")
+            return super().fit(
+                X, y, sample_weight=sample_weight, eval_set=eval_set
+            )
+
+    from skdist_tpu.distribute.search import FitFailedWarning
+
+    with pytest.warns(FitFailedWarning, match="Estimator fit failed"):
+        clf = DistGridSearchCV(
+            Flaky(max_iter=20, random_state=0),
+            {"max_depth": [2, 3]}, cv=3, error_score=0.0, refit=False,
+        ).fit(X, y)
+    scores = np.asarray(clf.cv_results_["mean_test_score"])
+    assert (scores == 0.0).sum() == 1 and (scores > 0.5).sum() == 1
