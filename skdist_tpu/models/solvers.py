@@ -94,6 +94,17 @@ def lbfgs_minimize(fun, w0, max_iter=100, tol=1e-4, history=10, max_ls=20):
         # safeguard: fall back to steepest descent if d isn't a descent dir
         descent = jnp.dot(g, d) < 0
         d = jnp.where(descent, d, -g)
+        # a raw -g direction (first iteration, or the fallback above)
+        # has arbitrary scale: on unscaled data |g| can be ~1e6, and
+        # max_ls backtracking halvings from t=1 cannot reach a usable
+        # step — the line search "stalls" and the solver would stop
+        # after one iteration. Normalise those directions so the unit
+        # backtracking grid covers them; curvature-scaled directions
+        # (k > 0 via two_loop's gamma) are already well-sized.
+        raw_scale = jnp.logical_or(~descent, k == 0)
+        d = jnp.where(
+            raw_scale, d / (jnp.linalg.norm(d) + _EPS), d
+        )
         t, f_new, ok = line_search(w, f, g, d)
         w_new = w + t * d
         f_new2, g_new = value_and_grad(w_new)

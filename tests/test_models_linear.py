@@ -324,3 +324,110 @@ def test_sgd_n_iter_no_change_validation():
     y = np.array([0, 1] * 5)
     with pytest.raises(ValueError, match="n_iter_no_change"):
         SGDClassifier(n_iter_no_change=0, max_iter=5).fit(X, y)
+
+
+def test_lbfgs_progresses_on_unscaled_features():
+    """Unscaled features (|g| ~ 1e5 at w0) must not stall the line
+    search on iteration 1 (round-5 fix: raw -g directions are
+    normalised so the backtracking grid can reach a usable step).
+    Regression: breast-cancer-like scales previously returned an
+    effectively-unfit model with n_iter_ == 1 for every C."""
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.RandomState(0)
+    n, d = 300, 12
+    scales = 10.0 ** rng.uniform(0, 3.5, size=d)
+    X = (rng.rand(n, d) * scales).astype(np.float32)
+    w = rng.normal(size=d) / scales
+    y = ((X @ w + 0.3 * rng.normal(size=n)) > np.median(X @ w)).astype(int)
+
+    m = LogisticRegression(C=1.0, max_iter=300).fit(X, y)
+    assert int(np.max(np.asarray(m.n_iter_))) > 1
+    auc = roc_auc_score(y, m.predict_proba(X)[:, 1])
+    # stalled-at-iteration-1 scored ~0.5 here; full convergence on
+    # these scales takes thousands of iterations — the bar is real
+    # progress, not the converged optimum
+    assert auc > 0.8, f"solver failed to learn on unscaled data: {auc}"
+
+
+def test_host_engine_matches_xla_at_optimum(clf_data):
+    """The f64 host engine (scipy L-BFGS-B) and the XLA kernel minimise
+    the IDENTICAL objective, so at tight tolerance they agree at the
+    optimum — engine selection is an execution detail, like the forest
+    engines (models/host_linear.py)."""
+    X, y = clf_data
+    kw = dict(C=1.0, max_iter=2000, tol=1e-7)
+    h = LogisticRegression(engine="host", **kw).fit(X, y)
+    x = LogisticRegression(engine="xla", **kw).fit(X, y)
+    np.testing.assert_allclose(h.coef_, x.coef_, atol=5e-3)
+    np.testing.assert_allclose(h.intercept_, x.intercept_, atol=5e-3)
+    assert (h.predict(X) == x.predict(X)).all()
+    np.testing.assert_allclose(
+        h.predict_proba(X), x.predict_proba(X), atol=1e-3
+    )
+    # binary column form agrees too
+    yb = (y > 0).astype(int)
+    hb = LogisticRegression(engine="host", **kw).fit(X, yb)
+    xb = LogisticRegression(engine="xla", **kw).fit(X, yb)
+    np.testing.assert_allclose(hb.coef_, xb.coef_, atol=5e-3)
+    # class_weight paths agree as well ('balanced' + dict)
+    for cw in ("balanced", {0: 2.0, 1: 1.0, 2: 0.5}):
+        hw = LogisticRegression(engine="host", class_weight=cw, **kw).fit(X, y)
+        xw = LogisticRegression(engine="xla", class_weight=cw, **kw).fit(X, y)
+        np.testing.assert_allclose(hw.coef_, xw.coef_, atol=5e-3)
+    # LinearSVC's squared-hinge host engine agrees the same way
+    # (looser coef band: squared hinge is only C1, so the two solvers
+    # stop ~1e-2 apart around the hinge kinks; decisions still match)
+    hs = LinearSVC(engine="host", **kw).fit(X, y)
+    xs = LinearSVC(engine="xla", **kw).fit(X, y)
+    np.testing.assert_allclose(hs.coef_, xs.coef_, atol=2e-2)
+    assert (hs.predict(X) == xs.predict(X)).all()
+
+
+def test_engine_auto_routes_local_search_to_host(clf_data, monkeypatch):
+    """On a CPU platform, engine='auto' (the default) must route BOTH
+    the direct fit and the backend=None search through the host engine
+    (the reference's sc=None == sklearn analogue, VERDICT r4 task 3);
+    engine='xla' must pin the compiled path."""
+    import skdist_tpu.models.host_linear as hl
+    from skdist_tpu.distribute.search import DistGridSearchCV
+
+    X, y = clf_data
+    calls = []
+    real = hl.logreg_host_fit
+
+    def spy(*a, **k):
+        calls.append(k.get("w0") is not None)
+        return real(*a, **k)
+
+    monkeypatch.setattr(hl, "logreg_host_fit", spy)
+    LogisticRegression(max_iter=20).fit(X, y)
+    assert len(calls) == 1, "auto fit did not use the host engine on cpu"
+
+    calls.clear()
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=200, tol=1e-6),
+        {"C": [0.1, 1.0]}, cv=3,
+    ).fit(X, y)
+    # 2 candidates x 3 folds + 1 refit, all through the host engine
+    assert len(calls) == 7
+    # the warm C-path runner chained inits: within each fold the
+    # second candidate warm-starts from the first one's optimum
+    assert sum(calls) == 3, calls
+    # warm starting is an init detail of a convex problem: scores
+    # match the pinned-XLA cold path at solver tolerance
+    cold = DistGridSearchCV(
+        LogisticRegression(max_iter=200, tol=1e-6, engine="xla"),
+        {"C": [0.1, 1.0]}, cv=3,
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        np.asarray(gs.cv_results_["mean_test_score"], dtype=float),
+        np.asarray(cold.cv_results_["mean_test_score"], dtype=float),
+        atol=1e-4,
+    )
+
+    calls.clear()
+    LogisticRegression(max_iter=20, engine="xla").fit(X, y)
+    assert not calls, "engine='xla' must not call the host engine"
+    with pytest.raises(ValueError, match="engine"):
+        LogisticRegression(engine="fast")
