@@ -310,6 +310,18 @@ def _run_config_child(idx, args, budget_left):
     return status
 
 
+def _quality_tail(data_dir):
+    """Quality-parity table vs BASELINE.md (builtin digits /
+    breast-cancer rows always; covtype / 20news rows when ``data_dir``
+    holds them, clean skip otherwise)."""
+    import quality_parity
+    from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
+
+    probe_platform_or_cpu()  # wedged tunnel -> CPU, never a hang
+    quality_parity.run_rows(data_dir)
+    quality_parity.print_table()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0,
@@ -320,7 +332,20 @@ def main():
                     help="also time the sklearn/joblib engine")
     ap.add_argument("--as-child", action="store_true",
                     help=argparse.SUPPRESS)  # internal: in-process run
+    ap.add_argument("--data-dir", default=None,
+                    help="real-dataset hook (VERDICT r4 task 5): an "
+                         "sklearn data_home holding covtype/20news; "
+                         "runs benchmarks/quality_parity.py after the "
+                         "configs so the suite ends with a quality "
+                         "table vs BASELINE.md (clean skip per row "
+                         "when data is absent)")
+    ap.add_argument("--quality", action="store_true",
+                    help="run ONLY the quality-parity table")
     args = ap.parse_args()
+
+    if args.quality:
+        _quality_tail(args.data_dir)
+        return
 
     from skdist_tpu.utils.tpu_probe import probe_platform_or_cpu
 
@@ -356,6 +381,9 @@ def main():
                 print("[run_all] tunnel not answering; stopping",
                       file=sys.stderr)
                 break
+    if args.data_dir:
+        # real-data quality tail: ends the suite with the parity table
+        _quality_tail(args.data_dir)
 
 
 if __name__ == "__main__":

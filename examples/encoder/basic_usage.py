@@ -30,6 +30,30 @@ from skdist_tpu.distribute.search import DistGridSearchCV
 from skdist_tpu.models import LogisticRegression
 
 
+def load_20news_frame(data_dir):
+    """REAL 20newsgroups when a local sklearn cache exists (reference
+    protocol, ``encoder/basic_usage.py:41-56``: first 1000 docs,
+    headers/footers/quotes stripped) — makes the reference's encoder
+    quality triple (0.3795 / 0.4671 / 0.4503 best CV f1) directly
+    comparable. Returns None when the cache is absent."""
+    try:
+        from sklearn.datasets import fetch_20newsgroups
+
+        ds = fetch_20newsgroups(
+            data_home=data_dir, shuffle=True, random_state=1,
+            remove=("headers", "footers", "quotes"),
+            download_if_missing=False,
+        )
+    except OSError as exc:
+        print(f"-- 20newsgroups not found under {data_dir} ({exc}); "
+              "using synthetic frame")
+        return None
+    df = pd.DataFrame({"text": ds["data"]})[:1000]
+    print(f"-- REAL 20newsgroups from {data_dir} "
+          "(quality comparable to BASELINE row 9)")
+    return df, ds["target"][:1000]
+
+
 def make_frame(n=600, seed=0):
     rng = np.random.RandomState(seed)
     topics = {
@@ -49,15 +73,36 @@ def make_frame(n=600, seed=0):
     }), y
 
 
+def _cli_value(flag, default=None):
+    """Value following ``flag`` in argv, or ``default`` (also when the
+    flag is last with its value forgotten). Duplicated across examples
+    by design — each example stays a self-contained script."""
+    if flag in sys.argv:
+        i = sys.argv.index(flag) + 1
+        if i < len(sys.argv):
+            return sys.argv[i]
+    return default
+
+
 def main():
-    df, y = make_frame()
+    data_dir = _cli_value("--data-dir", os.environ.get("SKDIST_DATA_DIR"))
+    real = load_20news_frame(data_dir) if data_dir else None
+    df, y = real if real is not None else make_frame()
+    # real data runs the FULL reference protocol (cv=5, converged
+    # fits) so the printed triple is comparable to BASELINE row 9;
+    # the synthetic demo keeps the fast settings
+    cv, max_iter = (5, 100) if real is not None else (3, 50)
     for size in ("small", "medium", "large"):
         enc = Encoderizer(size=size)
-        X_t = enc.fit_transform(df, y)
+        # the reference protocol fits the encoder UNSUPERVISED
+        # (`encoder/basic_usage.py:57-58`); the synthetic demo passes
+        # y to exercise the supervised plumbing too
+        X_t = (enc.fit_transform(df) if real is not None
+               else enc.fit_transform(df, y))
         X_dense = np.asarray(X_t.todense(), dtype=np.float32)
         gs = DistGridSearchCV(
-            LogisticRegression(max_iter=50), {"C": [0.1, 1.0, 10.0]},
-            cv=3, scoring="f1_weighted",
+            LogisticRegression(max_iter=max_iter), {"C": [0.1, 1.0, 10.0]},
+            cv=cv, scoring="f1_weighted",
         ).fit(X_dense, y)
         print(f"-- size={size}: {X_t.shape[1]} features from "
               f"{len(enc.step_names)} steps, best CV f1 {gs.best_score_:.4f}")
