@@ -294,7 +294,12 @@ def run_bench(platform, quick=False):
             response_method="predict_proba",
         )
 
-    parity_est = LogisticRegression(max_iter=200, tol=1e-6)
+    # engine='xla' everywhere in this block: the readout certifies
+    # BATCHED-vs-GENERIC *path* parity on one engine. Without the pin,
+    # a cpu-platform generic leg (and the floor fits) would resolve to
+    # the f64 host engine and the floors would no longer measure the
+    # f32 summation-order sensitivity the comparison is judged against.
+    parity_est = LogisticRegression(max_iter=200, tol=1e-6, engine="xla")
     sub_grid = {"C": [0.01, 0.1, 1.0]}
     b = DistGridSearchCV(
         parity_est, sub_grid, backend=TPUBackend(reuse_broadcast=True), cv=5,
@@ -314,12 +319,12 @@ def run_bench(platform, quick=False):
     def _permuted_floor(C):
         n_tr = int(0.8 * len(y))
         perm = np.random.RandomState(3).permutation(n_tr)
-        fa = LogisticRegression(C=C, max_iter=200, tol=1e-6).fit(
-            X[:n_tr], y[:n_tr]
-        )
-        fb = LogisticRegression(C=C, max_iter=200, tol=1e-6).fit(
-            X[:n_tr][perm], y[:n_tr][perm]
-        )
+        fa = LogisticRegression(
+            C=C, max_iter=200, tol=1e-6, engine="xla"
+        ).fit(X[:n_tr], y[:n_tr])
+        fb = LogisticRegression(
+            C=C, max_iter=200, tol=1e-6, engine="xla"
+        ).fit(X[:n_tr][perm], y[:n_tr][perm])
         return float(np.abs(
             log_loss(y[n_tr:], fa.predict_proba(X[n_tr:]))
             - log_loss(y[n_tr:], fb.predict_proba(X[n_tr:]))
@@ -328,7 +333,9 @@ def run_bench(platform, quick=False):
     floor_well = _permuted_floor(1.0)
 
     # ill-conditioned extreme of the real grid (C=100) + its floor
-    ill_est = LogisticRegression(C=100.0, max_iter=200, tol=1e-6)
+    ill_est = LogisticRegression(
+        C=100.0, max_iter=200, tol=1e-6, engine="xla"
+    )
     bi = DistGridSearchCV(
         ill_est, {"C": [100.0]}, backend=TPUBackend(reuse_broadcast=True), cv=5,
         scoring="neg_log_loss",

@@ -29,7 +29,11 @@ from ..metrics import (
     check_multimetric_scoring,
     device_scorer_compatible,
 )
-from ..parallel import parse_partitions, resolve_backend
+from ..parallel import (
+    parse_partitions,
+    prefers_host_engine,
+    resolve_backend,
+)
 from ..utils.validation import check_estimator_backend, check_is_fitted
 from .search import _fit_and_score, _resolve_device_scoring
 
@@ -189,6 +193,12 @@ class DistFeatureEliminator(BaseEstimator):
     def _try_batched(self, backend, X, y, splits, features_to_remove):
         est = self.estimator
         if not hasattr(type(est), "_build_fit_kernel"):
+            return None
+        if prefers_host_engine(backend, est):
+            # the estimator resolves to its f64 host engine on this
+            # host backend: the generic per-task path below runs that
+            # engine, instead of the XLA-CPU batched program (shared
+            # gate with search/eliminate — round-5 review)
             return None
         scorer_specs = _resolve_device_scoring(est, self.scoring)
         if scorer_specs is None:

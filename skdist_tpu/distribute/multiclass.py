@@ -34,7 +34,11 @@ import warnings
 import numpy as np
 
 from ..base import BaseEstimator, ClassifierMixin, clone, strip_runtime
-from ..parallel import parse_partitions, resolve_backend
+from ..parallel import (
+    parse_partitions,
+    prefers_host_engine,
+    resolve_backend,
+)
 from ..utils.validation import check_estimator_backend, check_is_fitted, safe_split
 
 __all__ = ["DistOneVsRestClassifier", "DistOneVsOneClassifier"]
@@ -295,6 +299,12 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
     def _try_batched(self, backend, X, Y):
         est = self.estimator
         if not hasattr(type(est), "_build_fit_kernel"):
+            return None
+        if prefers_host_engine(backend, est):
+            # the estimator resolves to its f64 host engine on this
+            # host backend: the generic per-task path below runs that
+            # engine, instead of the XLA-CPU batched program (shared
+            # gate with search/eliminate — round-5 review)
             return None
         # dict class_weight is keyed by original labels, which do not
         # map onto the {0,1} binary sub-problems -> generic path
@@ -596,6 +606,12 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
     def _try_batched(self, backend, X, y):
         est = self.estimator
         if not hasattr(type(est), "_build_fit_kernel"):
+            return None
+        if prefers_host_engine(backend, est):
+            # the estimator resolves to its f64 host engine on this
+            # host backend: the generic per-task path below runs that
+            # engine, instead of the XLA-CPU batched program (shared
+            # gate with search/eliminate — round-5 review)
             return None
         # dict class_weight is keyed by original labels, which do not
         # map onto the {0,1} binary sub-problems -> generic path

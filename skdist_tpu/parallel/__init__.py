@@ -11,6 +11,7 @@ from .backend import (
     TaskBackend,
     get_value,
     parse_partitions,
+    prefers_host_engine,
     resolve_backend,
     row_sharded_specs,
 )
@@ -21,6 +22,7 @@ __all__ = [
     "TPUBackend",
     "resolve_backend",
     "parse_partitions",
+    "prefers_host_engine",
     "get_value",
     "row_sharded_specs",
 ]

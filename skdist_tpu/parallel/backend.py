@@ -32,6 +32,29 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 
+def prefers_host_engine(backend, estimator):
+    """True when a batched dispatch should yield to the host fan-out
+    because the estimator resolves to its f64 BLAS host engine on this
+    backend (``engine='auto'`` on a CPU platform, or ``engine='host'``).
+
+    Consulted by EVERY batched-path gate (search, multiclass,
+    eliminate) so one estimator never silently runs two different
+    numerical engines depending on which meta-estimator wraps it
+    (round-5 review). An EXPLICIT ``engine='host'`` pin wins even over
+    a device backend (the fan-out then rides the backend's generic
+    host ``run_tasks`` leg — ignoring the pin would select candidates
+    with one engine and refit the winner with another); ``'auto'`` on
+    a device backend always chooses the batched mesh program."""
+    resolve = getattr(estimator, "_resolve_host_engine", None)
+    if resolve is None:
+        return False
+    if getattr(estimator, "engine", None) == "host":
+        return True
+    if getattr(backend, "is_device_backend", False):
+        return False
+    return bool(resolve())
+
+
 def parse_partitions(partitions, n_tasks):
     """Resolve a partition policy to a device-round size.
 

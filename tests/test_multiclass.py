@@ -176,9 +176,12 @@ def test_keep_mask_spans_bound_host_memory(clf_data, monkeypatch):
     n = len(y)
 
     def fit_ovr():
+        # engine='xla' pins the BATCHED path this test exercises (the
+        # default 'auto' resolves to the host engine on cpu, which
+        # fans out per class without the spanned mask machinery)
         return DistOneVsRestClassifier(
-            LogisticRegression(max_iter=50), max_negatives=0.5,
-            random_state=0,
+            LogisticRegression(max_iter=50, engine="xla"),
+            max_negatives=0.5, random_state=0,
         ).fit(X, y)
 
     expected = fit_ovr()
