@@ -81,6 +81,7 @@ def main():
 
     configs = [
         ("matmul", None),
+        ("matmul_sib", None),
         ("pallas", None),
         ("scatter", 8),
         ("scatter", 16),
@@ -153,7 +154,8 @@ def main():
     os.environ.pop(hist_calib.PATH_ENV, None)
     os.unlink(scratch.name)
     xla_ranked = [r for r in ranking
-                  if r["mode"] in ("scatter", "matmul", "pallas")]
+                  if r["mode"] in ("scatter", "matmul", "matmul_sib",
+                                   "pallas")]
     best_xla = (
         min(xla_ranked, key=lambda r: r["warm_s"]) if xla_ranked else None
     )

@@ -52,7 +52,11 @@ def logreg_host_fit(X, y_idx, sw, *, C, tol, max_iter, fit_intercept,
                     n_classes, history, class_weight, cw_arr, w0=None):
     """Fit one logistic regression on host; returns the same params
     pytree the XLA fit kernel yields (``{"W", "n_iter"}``, f32) plus
-    the f64 optimum for warm-starting the next fit along a C path.
+    the f64 optimum for warm-starting the next fit along a C path —
+    or None in its place when the solver stopped on ``max_iter``
+    rather than ``tol``: an unconverged endpoint is init-dependent,
+    and seeding the chain with it would make CV scores depend on
+    which other C values share the grid (round-5 review).
 
     Objective identical to ``LogisticRegression._build_fit_kernel``:
     binary uses the single-column softplus form, multinomial the
@@ -101,7 +105,7 @@ def logreg_host_fit(X, y_idx, sw, *, C, tol, max_iter, fit_intercept,
         )
         params = {"W": res.x.astype(np.float32),
                   "n_iter": np.int32(res.nit)}
-        return params, res.x
+        return params, (res.x if res.status == 0 else None)
 
     onehot_rows = np.arange(n)
 
@@ -128,7 +132,7 @@ def logreg_host_fit(X, y_idx, sw, *, C, tol, max_iter, fit_intercept,
     )
     params = {"W": res.x.reshape(p, k).astype(np.float32),
               "n_iter": np.int32(res.nit)}
-    return params, res.x
+    return params, (res.x if res.status == 0 else None)
 
 
 def svc_host_fit(X, y_idx, sw, *, C, tol, max_iter, fit_intercept,
@@ -171,14 +175,14 @@ def svc_host_fit(X, y_idx, sw, *, C, tol, max_iter, fit_intercept,
                      "gtol": float(tol), "ftol": 1e-12},
         )
         return ({"W": res.x.astype(np.float32),
-                 "n_iter": np.int32(res.nit)}, res.x)
+                 "n_iter": np.int32(res.nit)},
+                res.x if res.status == 0 else None)
 
-    onehot_rows = np.arange(n)
+    Ypm = np.full((n, k), -1.0)
+    Ypm[np.arange(n), y_idx] = 1.0
 
     def fun(wflat):
         W = wflat.reshape(p, k)
-        Ypm = np.full((n, k), -1.0)
-        Ypm[onehot_rows, y_idx] = 1.0
         margin = np.maximum(0.0, 1.0 - Ypm * (Xa @ W))
         val = 0.5 * float(np.sum(W[:d] * W[:d])) \
             + Cf * float(np.dot(sw, (margin * margin).sum(axis=1)))
@@ -193,4 +197,5 @@ def svc_host_fit(X, y_idx, sw, *, C, tol, max_iter, fit_intercept,
                  "gtol": float(tol), "ftol": 1e-12},
     )
     return ({"W": res.x.reshape(p, k).astype(np.float32),
-             "n_iter": np.int32(res.nit)}, res.x)
+             "n_iter": np.int32(res.nit)},
+            res.x if res.status == 0 else None)

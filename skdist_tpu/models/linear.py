@@ -568,6 +568,10 @@ class LogisticRegression(_LinearClassifierBase):
         if md not in (None, "float32", "bfloat16"):
             # re-validated here because set_params bypasses __init__
             raise ValueError("matmul_dtype must be None/'float32'/'bfloat16'")
+        if st.get("engine", "auto") not in ("auto", "host", "xla"):
+            # same guard: a typo'd engine set via set_params must not
+            # silently route to the batched device path
+            raise ValueError("engine must be 'auto', 'host' or 'xla'")
         bf16 = md == "bfloat16"
 
         def kernel(X, y_idx, sw, hyper, aux=None):
@@ -738,6 +742,11 @@ class LinearSVC(_LinearClassifierBase):
         max_iter, hist = st["max_iter"], st["history"]
         class_weight, cw_arr = st["class_weight"], meta.get("cw_arr")
         binary = k <= 2
+
+        if st.get("engine", "auto") not in ("auto", "host", "xla"):
+            # re-validated because set_params bypasses __init__ (same
+            # guard convention as LogisticRegression's matmul_dtype)
+            raise ValueError("engine must be 'auto', 'host' or 'xla'")
 
         def kernel(X, y_idx, sw, hyper, aux=None):
             C = hyper["C"]

@@ -104,7 +104,7 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
         # prefer the sweep's MEASURED best XLA engine (and its
         # measured block size) over the shape heuristic
         xla = calib.get("xla_mode")
-        if xla in ("scatter", "matmul", "pallas"):
+        if xla in ("scatter", "matmul", "matmul_sib", "pallas"):
             hist_mode = xla
             if hist_block is None:
                 hist_block = (
@@ -117,7 +117,7 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
     # single width guard for every RESOLVED path (an explicit
     # matmul/pallas request is honoured as-is): the one-hot contraction
     # is (n, d·B)-sized, degrade to scatter above the calibrated bound
-    if (resolved and hist_mode in ("matmul", "pallas")
+    if (resolved and hist_mode in ("matmul", "matmul_sib", "pallas")
             and d * B > calib.get("max_matmul_db", DEFAULT_MAX_MATMUL_DB)):
         hist_mode = "scatter"
     if hist_block is None:
@@ -159,6 +159,20 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
       (n, nl·C) is ever materialised in HBM. Off-TPU it runs through
       the Pallas interpreter (correct but slow; tests only). The
       compiled path assumes ``n_bins >= 8`` (TPU sublane tiling).
+    - ``"matmul_sib"``: the matmul engine with sibling subtraction
+      (LightGBM's classic halving): below the root, only LEFT-child
+      histograms are computed by matmul — each right child is its
+      parent's (previous level's) histogram minus the left sibling,
+      zeroed for children of non-split parents. Halves the dominant
+      per-level contraction FLOPs. Exactness: with integer effective
+      weights (the default — bootstrap counts × unit sample_weight)
+      every histogram entry below 2^24 is exact in f32, so the
+      subtraction is bitwise-identical to direct summation (measured:
+      identical trees on tie-heavy fuzz data); fractional
+      class/sample weights can round and flip near-tie splits (the
+      same flip class as the xla-vs-native near-ties, NOTES round-4
+      fuzz), so the mode stays an on-chip sweep candidate rather than
+      a silent default.
     - ``"auto"``: the MEASURED per-platform winner from
       ``models/hist_calib.json`` (written by the on-chip sweep,
       ``build_tools/tpu_tree_sweep.py``), with a width guard — matmul /
@@ -182,10 +196,10 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
     hist_mode, hist_block = resolve_hist_config(
         d, B, hist_mode, hist_block, allow_native=False
     )
-    if hist_mode not in ("scatter", "matmul", "pallas"):
+    if hist_mode not in ("scatter", "matmul", "matmul_sib", "pallas"):
         raise ValueError(
-            f"hist_mode must be 'auto', 'scatter', 'matmul' or 'pallas'; "
-            f"got {hist_mode!r}"
+            f"hist_mode must be 'auto', 'scatter', 'matmul', "
+            f"'matmul_sib' or 'pallas'; got {hist_mode!r}"
         )
     if hist_mode == "pallas" and B < 8:
         raise ValueError(
@@ -237,7 +251,7 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
 
         # level-invariant histogram inputs, hoisted out of the unrolled
         # level loop
-        if hist_mode == "matmul":
+        if hist_mode in ("matmul", "matmul_sib"):
             # (n, d·B) one-hot of the binned features — the left matmul
             # factor for every level
             Xoh = jax.nn.one_hot(Xb, B, dtype=Ych.dtype).reshape(n, d * B)
@@ -257,13 +271,41 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
             XbT_blocks = XbT.reshape(n_blocks, fb, -1)
             Ych_tiled = jnp.tile(Ych, (fb, 1))  # (fb*n, C)
 
+        prev_hist = prev_split = None  # matmul_sib level-to-level carry
         for level in range(D):
             start = 2**level - 1
             nl = 2**level
             rel = node_id - start
             at_level = (node_id >= start) & (node_id < start + nl)
 
-            if hist_mode == "matmul":
+            if hist_mode == "matmul_sib" and level > 0:
+                # ---- sibling subtraction: matmul ONLY the left
+                # children (parent-slot one-hot masked to left-going
+                # samples, half the contraction width), then derive
+                # each right child as parent minus left sibling —
+                # children of unsplit parents are zeroed (their "right
+                # = parent - 0" would otherwise resurrect the parent's
+                # samples)
+                nh = nl // 2
+                left = at_level & (rel % 2 == 0)
+                parent_oh = jax.nn.one_hot(
+                    jnp.clip(rel // 2, 0, nh - 1), nh, dtype=Ych.dtype
+                ) * left[:, None].astype(Ych.dtype)
+                NW = (parent_oh[:, :, None] * Ych[:, None, :]).reshape(
+                    n, nh * C
+                )
+                hist_left = lax.dot_general(
+                    Xoh, NW, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).reshape(d, B, nh, C).transpose(0, 2, 1, 3)
+                split_mask = prev_split.astype(jnp.float32)[
+                    None, :, None, None
+                ]
+                hist_right = (prev_hist - hist_left) * split_mask
+                hist = jnp.stack(
+                    [hist_left, hist_right], axis=2
+                ).reshape(d, nl, B, C)
+            elif hist_mode in ("matmul", "matmul_sib"):
                 # ---- histogram as one MXU matmul per level:
                 # (d·B, n) @ (n, nl·C) with samples not at this level
                 # zeroed by the node one-hot
@@ -360,6 +402,8 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
             thr = thr.at[idx].set(best_t)
             is_split = is_split.at[idx].set(do_split)
             gain_rec = gain_rec.at[idx].set(jnp.where(do_split, best_gain, 0.0))
+            if hist_mode == "matmul_sib":
+                prev_hist, prev_split = hist, do_split
 
             # ---- route samples
             f_s = best_f[jnp.clip(rel, 0, nl - 1)]

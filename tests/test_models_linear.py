@@ -431,3 +431,31 @@ def test_engine_auto_routes_local_search_to_host(clf_data, monkeypatch):
     assert not calls, "engine='xla' must not call the host engine"
     with pytest.raises(ValueError, match="engine"):
         LogisticRegression(engine="fast")
+
+
+def test_explicit_host_engine_wins_over_device_backend(clf_data,
+                                                       monkeypatch,
+                                                       tpu_backend):
+    """engine='host' is an explicit pin: even under a device backend
+    the search must run every fit (selection AND refit) through the
+    host engine — selecting candidates with one engine and refitting
+    the winner with another silently mixes numerics (round-5 review)."""
+    import skdist_tpu.models.host_linear as hl
+    from skdist_tpu.distribute.search import DistGridSearchCV
+
+    X, y = clf_data
+    calls = []
+    real = hl.logreg_host_fit
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(hl, "logreg_host_fit", spy)
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=30, engine="host"),
+        {"C": [0.1, 1.0]}, cv=3, backend=tpu_backend,
+    ).fit(X, y)
+    # 2 candidates x 3 folds + refit, none through the XLA batched path
+    assert len(calls) == 7
+    assert gs.best_score_ > 0.9
