@@ -1,0 +1,107 @@
+"""
+Cross-engine tree fuzz: randomized deterministic configs grown by every
+histogram engine (XLA scatter / matmul / matmul_sib, host C 'native'),
+compared tree-for-tree.
+
+Round-4 ran this as a one-off for scatter-vs-native (NOTES round-4
+record item 8: 20/20 bitwise-identical classification trees); this
+committed form adds the round-5 ``matmul_sib`` sibling-subtraction
+engine, whose exactness claim (integer effective weights => f32 sums
+below 2^24 are exact => subtraction == direct summation) is exactly
+the kind of property a fuzzer should be pointed at.
+
+Not part of the CI tier (minutes of XLA compiles for one-off shapes);
+run on demand:  python build_tools/engine_fuzz.py [--n-configs 12]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# hermetic CPU: the fuzz is a correctness tool, never a device workload
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=1"
+)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def fuzz_config(rng):
+    n = int(rng.choice([300, 700, 1500]))
+    d = int(rng.choice([3, 6, 12]))
+    B = int(rng.choice([4, 8, 16, 32]))
+    k = int(rng.choice([2, 3, 5]))
+    depth = int(rng.choice([3, 5, 7]))
+    # tie-heavy: small integer feature alphabets force equal gains
+    Xb = rng.randint(0, B, size=(n, d)).astype(np.int32)
+    y = rng.randint(0, k, size=n).astype(np.int32)
+    cfg = dict(
+        n_features=d, n_bins=B, channels=k + 1, max_depth=depth,
+        max_features=d if rng.rand() < 0.5 else max(1, d // 2),
+        min_samples_split=int(rng.choice([2, 8, 24])),
+        min_samples_leaf=int(rng.choice([1, 4, 10])),
+        min_impurity_decrease=float(rng.choice([0.0, 1e-4])),
+        extra=False, classification=True,
+    )
+    return Xb, y, cfg
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-configs", type=int, default=12)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    from skdist_tpu.models.forest import classification_channels
+    from skdist_tpu.models.tree import build_tree_kernel
+
+    rng = np.random.RandomState(7)
+    identical = {"matmul": 0, "matmul_sib": 0}
+    total = 0
+    for i in range(args.n_configs):
+        Xb, y, cfg = fuzz_config(rng)
+        k = cfg["channels"] - 1
+        Ych = classification_channels(
+            jnp.asarray(y), jnp.ones(len(y), jnp.float32), k
+        )
+        key = jax.random.PRNGKey(i)
+        ref = jax.device_get(
+            build_tree_kernel(hist_mode="scatter", **cfg)(
+                jnp.asarray(Xb), Ych, key
+            )
+        )
+        total += 1
+        row = {"config": i, "shape": list(Xb.shape),
+               "bins": cfg["n_bins"], "depth": cfg["max_depth"]}
+        for mode in ("matmul", "matmul_sib"):
+            t = jax.device_get(
+                build_tree_kernel(hist_mode=mode, **cfg)(
+                    jnp.asarray(Xb), Ych, key
+                )
+            )
+            same = (
+                np.array_equal(ref["feat"], t["feat"])
+                and np.array_equal(ref["thr"], t["thr"])
+                and np.array_equal(ref["is_split"], t["is_split"])
+            )
+            identical[mode] += bool(same)
+            row[mode] = "identical" if same else "DIFFERS"
+        print(json.dumps(row), flush=True)
+    print(json.dumps({
+        "total": total,
+        "identical": identical,
+        "note": "host-C-engine identity is separately fuzzed by "
+                "tests/test_native_forest.py::test_native_xla_parity_fuzz",
+    }), flush=True)
+    sys.exit(1 if any(c != total for c in identical.values()) else 0)
+
+
+if __name__ == "__main__":
+    main()
