@@ -480,6 +480,16 @@ class LogisticRegression(_LinearClassifierBase):
     ``C`` and ``tol`` are batchable hyperparameters — a CV grid over C
     compiles to a single vmapped XLA program.
 
+    ``engine`` picks the execution engine: ``'auto'`` (default) runs
+    host-side fits on CPU platforms through the f64 BLAS solver
+    (``models/host_linear.py``) and device fits through this XLA
+    kernel; ``'xla'``/``'host'`` pin one engine. Both minimise the
+    same objective, but stop differently at the same ``tol``: the
+    host engine matches sklearn's mean-scaled ``gtol`` (iteration
+    counts track sklearn), while the XLA kernel's ``max|grad| <= tol``
+    is on the weight-SUM-scaled objective — tighter in absolute terms
+    on large n.
+
     ``matmul_dtype="bfloat16"`` runs the loss/gradient matmuls (the
     FLOP bulk of L-BFGS) with bf16 inputs and f32 accumulation
     (``preferred_element_type``); the L-BFGS state, reductions, and

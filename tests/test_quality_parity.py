@@ -1,0 +1,47 @@
+"""CI smoke for the quality-parity harness (round-5 VERDICT task 5):
+the builtin rows must run and stay at/near the reference's published
+numbers, and the fetched rows must skip cleanly in a zero-egress
+environment instead of erroring."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "benchmarks"))
+
+import quality_parity as qp
+
+
+@pytest.fixture(autouse=True)
+def fresh_rows():
+    qp.ROWS.clear()
+    yield
+    qp.ROWS.clear()
+
+
+def test_digits_rows_at_or_near_reference():
+    qp.run_digits()
+    rows = {r["row"]: r for r in qp.ROWS}
+    ovr = rows["digits OvR weighted F1"]
+    ovo = rows["digits OvO weighted F1"]
+    # QUALITY_r05.jsonl capture: 0.9641 / 0.9805 vs 0.9589 / 0.9805.
+    # Band allows engine-level drift, not regressions.
+    assert ovr["ours"] >= ovr["reference"] - 0.01
+    assert ovo["ours"] >= ovo["reference"] - 0.01
+
+
+def test_breast_cancer_row_near_reference():
+    qp.run_breast_cancer()
+    (row,) = qp.ROWS
+    # capture: 0.9932 vs 0.99253 (host engine, converged)
+    assert row["ours"] >= row["reference"] - 0.005
+
+
+def test_fetched_rows_skip_cleanly(tmp_path):
+    qp.run_covtype(str(tmp_path))
+    qp.run_encoder_20news(str(tmp_path))
+    assert len(qp.ROWS) == 2
+    assert all(r["note"].startswith("skipped") for r in qp.ROWS)
+    # the table renders with skipped rows present
+    qp.print_table()
