@@ -212,11 +212,17 @@ def config_4_forest(scale, ref):
 
     mode, _blk = resolve_hist_config(28, 32)
     out["hist_mode"] = mode
-    if mode in ("matmul", "pallas"):
+    if mode in ("matmul", "matmul_sib", "pallas"):
         # binary classification: channels = 2 classes + count = 3; the
         # one-hot contraction operands are exact at default (1-pass)
         # matmul precision, so peak is the full bf16 number
         flops = forest_tree_flops(n, 28, 32, 3, 8) * 256
+        if mode == "matmul_sib":
+            # sibling subtraction executes the root level in full and
+            # half of every deeper level's contraction: the MFU basis
+            # counts FLOPs actually run, not the full-level model
+            D = 8
+            flops *= (1.0 + (2.0**D - 2.0) / 2.0) / (2.0**D - 1.0)
         out.update(mfu_fields(flops / warm / 1e12, passes=1,
                               basis=f"hist_mode={mode}, depth 8",
                               platform=platform))
