@@ -344,6 +344,16 @@ class _LinearModelBase(BaseEstimator):
 
     _host_fit = None  # subclasses with a host engine override
 
+    def __getstate__(self):
+        """Fitted artifacts pickle WITHOUT the warm-start scratch: the
+        f64 optimum (`_w_opt64`) exists only to seed the next fit in a
+        C path during a live search, and would otherwise triple a big
+        model's pickle next to its f32 coefficients."""
+        state = self.__dict__.copy()
+        state.pop("_w_opt64", None)
+        state.pop("_warm_w0", None)
+        return state
+
     def _static_config(self, meta):
         return {k: getattr(self, k) for k in self._static_names}
 

@@ -155,9 +155,10 @@ def test_batched_map_halves_round_on_oom(tpu_backend, monkeypatch):
 
     monkeypatch.setattr(backend_mod, "_jit_vmapped", fussy_jit)
     tasks = {"x": np.arange(32, dtype=np.float32)}
-    out = tpu_backend.batched_map(
-        lambda shared, t: {"y": t["x"] * 2.0}, tasks
-    )
+    with pytest.warns(UserWarning, match="exhausted device memory"):
+        out = tpu_backend.batched_map(
+            lambda shared, t: {"y": t["x"] * 2.0}, tasks
+        )
     np.testing.assert_allclose(out["y"], np.arange(32) * 2.0)
     assert max(seen_chunks) > 8          # the too-big round was tried
     assert seen_chunks[-1] <= 8          # and halved until it fit
@@ -190,10 +191,11 @@ def test_batched_map_oom_resumes_from_completed_rounds(tpu_backend,
 
     monkeypatch.setattr(backend_mod, "_jit_vmapped", fussy_jit)
     tasks = {"x": np.arange(32, dtype=np.float32)}
-    out, timings = tpu_backend.batched_map(
-        lambda shared, t: {"y": t["x"] * 2.0}, tasks, round_size=16,
-        return_timings=True,
-    )
+    with pytest.warns(UserWarning, match="exhausted device memory"):
+        out, timings = tpu_backend.batched_map(
+            lambda shared, t: {"y": t["x"] * 2.0}, tasks, round_size=16,
+            return_timings=True,
+        )
     np.testing.assert_allclose(out["y"], np.arange(32) * 2.0)
     # tasks 0-15 ran once at chunk 16 and were never re-dispatched
     assert calls[0] == (16, 0.0)
@@ -229,9 +231,10 @@ def test_batched_map_oom_in_gather_keeps_prefix_contiguous(tpu_backend,
 
     monkeypatch.setattr(backend_mod, "_gather_host", fussy_gather)
     tasks = {"x": np.arange(64, dtype=np.float32)}
-    out = tpu_backend.batched_map(
-        lambda shared, t: {"y": t["x"] * 2.0}, tasks, round_size=16,
-    )
+    with pytest.warns(UserWarning, match="exhausted device memory"):
+        out = tpu_backend.batched_map(
+            lambda shared, t: {"y": t["x"] * 2.0}, tasks, round_size=16,
+        )
     assert blown, "the simulated gather failure never fired"
     # every task's output at its own position — the buggy drain put
     # round 3's outputs at round 2's task offsets

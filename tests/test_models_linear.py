@@ -130,6 +130,8 @@ def test_estimators_pickle(clf_data):
         est.fit(X, y)
         loaded = pickle.loads(pickle.dumps(est))
         assert (loaded.predict(X) == est.predict(X)).all()
+        # warm-start scratch (f64 optimum) must not ship in artifacts
+        assert not hasattr(loaded, "_w_opt64")
 
 
 def test_class_weight_partial_dict(binary_data):
