@@ -32,7 +32,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
 
-def fuzz_config(rng):
+def fuzz_config(rng, classification, extra):
     n = int(rng.choice([300, 700, 1500]))
     d = int(rng.choice([3, 6, 12]))
     B = int(rng.choice([4, 8, 16, 32]))
@@ -40,14 +40,19 @@ def fuzz_config(rng):
     depth = int(rng.choice([3, 5, 7]))
     # tie-heavy: small integer feature alphabets force equal gains
     Xb = rng.randint(0, B, size=(n, d)).astype(np.int32)
-    y = rng.randint(0, k, size=n).astype(np.int32)
+    if classification:
+        y = rng.randint(0, k, size=n).astype(np.int32)
+        channels = k + 1
+    else:
+        y = rng.normal(size=n).astype(np.float32)
+        channels = 4
     cfg = dict(
-        n_features=d, n_bins=B, channels=k + 1, max_depth=depth,
+        n_features=d, n_bins=B, channels=channels, max_depth=depth,
         max_features=d if rng.rand() < 0.5 else max(1, d // 2),
         min_samples_split=int(rng.choice([2, 8, 24])),
         min_samples_leaf=int(rng.choice([1, 4, 10])),
         min_impurity_decrease=float(rng.choice([0.0, 1e-4])),
-        extra=False, classification=True,
+        extra=extra, classification=classification,
     )
     return Xb, y, cfg
 
@@ -59,27 +64,48 @@ def main():
 
     import jax.numpy as jnp
 
-    from skdist_tpu.models.forest import classification_channels
+    from skdist_tpu.models.forest import (
+        classification_channels,
+        regression_channels,
+    )
     from skdist_tpu.models.tree import build_tree_kernel
 
     rng = np.random.RandomState(7)
-    identical = {"matmul": 0, "matmul_sib": 0}
-    total = 0
+    # classification + integer weights: sibling subtraction is exact
+    # (f32 sums below 2^24), so identity is REQUIRED. Regression
+    # channels are fractional (w·y, w·y²), so f32 rounding can flip
+    # near-ties — identity is measured, and feature-level agreement
+    # must stay high (the native-vs-xla fuzz's 87-100% band).
+    stats = {
+        True: {"matmul": 0, "matmul_sib": 0, "total": 0},
+        False: {"matmul": 0, "matmul_sib": 0, "total": 0,
+                "feat_agree_min": 1.0},
+    }
     for i in range(args.n_configs):
-        Xb, y, cfg = fuzz_config(rng)
-        k = cfg["channels"] - 1
-        Ych = classification_channels(
-            jnp.asarray(y), jnp.ones(len(y), jnp.float32), k
-        )
+        classification = i % 3 != 2  # 2/3 classification, 1/3 regression
+        extra = i % 4 == 3
+        Xb, y, cfg = fuzz_config(rng, classification, extra)
+        if classification:
+            Ych = classification_channels(
+                jnp.asarray(y), jnp.ones(len(y), jnp.float32),
+                cfg["channels"] - 1,
+            )
+        else:
+            Ych = regression_channels(
+                jnp.asarray(y), jnp.ones(len(y), jnp.float32)
+            )
         key = jax.random.PRNGKey(i)
         ref = jax.device_get(
             build_tree_kernel(hist_mode="scatter", **cfg)(
                 jnp.asarray(Xb), Ych, key
             )
         )
-        total += 1
+        s = stats[classification]
+        s["total"] += 1
         row = {"config": i, "shape": list(Xb.shape),
-               "bins": cfg["n_bins"], "depth": cfg["max_depth"]}
+               "task": "clf" if classification else "reg",
+               "extra": extra, "bins": cfg["n_bins"],
+               "depth": cfg["max_depth"]}
         for mode in ("matmul", "matmul_sib"):
             t = jax.device_get(
                 build_tree_kernel(hist_mode=mode, **cfg)(
@@ -91,16 +117,24 @@ def main():
                 and np.array_equal(ref["thr"], t["thr"])
                 and np.array_equal(ref["is_split"], t["is_split"])
             )
-            identical[mode] += bool(same)
-            row[mode] = "identical" if same else "DIFFERS"
+            s[mode] += bool(same)
+            agree = float(np.mean(ref["feat"] == t["feat"]))
+            row[mode] = "identical" if same else (
+                f"near-tie flips (feat agreement {agree:.2f})"
+            )
+            if not classification:
+                s["feat_agree_min"] = min(s["feat_agree_min"], agree)
         print(json.dumps(row), flush=True)
-    print(json.dumps({
-        "total": total,
-        "identical": identical,
+    print(json.dumps({"summary": {
+        "classification": stats[True], "regression": stats[False],
         "note": "host-C-engine identity is separately fuzzed by "
                 "tests/test_native_forest.py::test_native_xla_parity_fuzz",
-    }), flush=True)
-    sys.exit(1 if any(c != total for c in identical.values()) else 0)
+    }}), flush=True)
+    clf = stats[True]
+    ok = (clf["matmul"] == clf["total"]
+          and clf["matmul_sib"] == clf["total"]
+          and stats[False]["feat_agree_min"] >= 0.85)
+    sys.exit(0 if ok else 1)
 
 
 if __name__ == "__main__":
