@@ -142,6 +142,28 @@ def test_simple_voter_soft(clf_data):
     assert "a" in voter.named_estimators
 
 
+def test_simple_voter_string_labels():
+    """String class labels round-trip through the vote (reference
+    ``test_postprocessing.py::test_predict_strings``): the encoded
+    one-hot tally must inverse-transform back to the original dtype."""
+    from skdist_tpu.models import LogisticRegression, RidgeClassifier
+
+    rng = np.random.RandomState(0)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.5, size=(40, 5)) for c in (-2.0, 2.0)
+    ]).astype(np.float32)
+    y = np.repeat(["pizza", "tacos"], 40)
+    m1 = LogisticRegression(max_iter=100).fit(X, y)
+    m2 = RidgeClassifier().fit(X, y)
+    hard = SimpleVoter([("a", m1), ("b", m2)], classes=m1.classes_,
+                       voting="hard")
+    preds = hard.predict(X)
+    assert preds.dtype == y.dtype and (preds == y).mean() == 1.0
+    soft = SimpleVoter([("a", m1), ("b", m1)], classes=m1.classes_,
+                       voting="soft")
+    assert (soft.predict(X) == y).mean() == 1.0
+
+
 def test_simple_voter_weighted_hard_and_drop():
     """The vectorized one-hot vote must honor weights exactly (a 2.0
     weight outvotes two 0.9 weights), break ties toward the lowest
