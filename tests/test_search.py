@@ -536,3 +536,27 @@ def test_transform_inverse_transform_delegation():
     Xt = gs.transform(X)
     assert Xt.shape == (100, gs.best_params_["n_components"])
     assert gs.inverse_transform(Xt).shape == X.shape
+
+
+def test_warm_c_path_continuous_distribution(clf_data):
+    """Randomized search with a continuous C distribution rides the
+    warm C-path runner (every candidate differs only in C within its
+    tol bucket) and must score identically to the pinned-XLA cold run
+    at converged settings."""
+    from scipy.stats import loguniform
+
+    X, y = clf_data
+    space = {"C": loguniform(1e-3, 1e3), "tol": [1e-4, 1e-6]}
+    warm = DistRandomizedSearchCV(
+        LogisticRegression(max_iter=300, tol=1e-6), space,
+        n_iter=8, cv=3, random_state=0,
+    ).fit(X, y)
+    cold = DistRandomizedSearchCV(
+        LogisticRegression(max_iter=300, tol=1e-6, engine="xla"), space,
+        n_iter=8, cv=3, random_state=0,
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        np.asarray(warm.cv_results_["mean_test_score"], dtype=float),
+        np.asarray(cold.cv_results_["mean_test_score"], dtype=float),
+        atol=1e-4,
+    )
