@@ -264,8 +264,13 @@ def run_bench(platform, quick=False):
     n_tr = int(0.8 * n_rows)
     iter_probe = []
     for C in (0.001, 1.0, 100.0):
-        m = LogisticRegression(C=C, max_iter=30, tol=1e-4).fit(
-            X[:n_tr], y[:n_tr])
+        # engine='xla': the FLOP basis must count the iterations of
+        # the SAME solver the measured batched path runs — on a CPU
+        # platform 'auto' would probe the host engine, whose
+        # mean-scaled stopping runs fewer iterations at the same tol
+        m = LogisticRegression(
+            C=C, max_iter=30, tol=1e-4, engine="xla"
+        ).fit(X[:n_tr], y[:n_tr])
         iter_probe.append(float(np.max(np.asarray(m.n_iter_))))
     n_iter_mean = float(np.mean(iter_probe))
     flops_per_fit = lbfgs_fit_flops(n_tr, d_feat, k_cls, n_iter_mean)
