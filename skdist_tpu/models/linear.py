@@ -487,8 +487,10 @@ class LogisticRegression(_LinearClassifierBase):
     sklearn-compatible surface; objective matches sklearn
     (``Σ s·ce + 0.5/C·‖w‖²``, intercept unpenalised) so coefficient and
     score parity with the reference stack holds to solver tolerance.
-    ``C`` and ``tol`` are batchable hyperparameters — a CV grid over C
-    compiles to a single vmapped XLA program.
+    ``penalty=None`` drops the ridge term entirely (sklearn's C=inf
+    convention; ``C`` is then ignored). ``C`` and ``tol`` are batchable
+    hyperparameters — a CV grid over C compiles to a single vmapped
+    XLA program; ``penalty`` is compile-shaping (candidates bucket).
 
     ``engine`` picks the execution engine: ``'auto'`` (default) runs
     host-side fits on CPU platforms through the f64 BLAS solver
@@ -517,7 +519,7 @@ class LogisticRegression(_LinearClassifierBase):
     _hyper_names = ("C", "tol")
     _static_names = (
         "max_iter", "fit_intercept", "class_weight", "history",
-        "matmul_dtype", "engine",
+        "matmul_dtype", "engine", "penalty",
     )
 
     def __init__(self, C=1.0, tol=1e-4, max_iter=100, fit_intercept=True,
@@ -562,10 +564,21 @@ class LogisticRegression(_LinearClassifierBase):
         w0 = getattr(self, "_warm_w0", None)
         if w0 is not None and np.shape(w0) != (n_w,):
             w0 = None
+        # penalty=None maps to C=inf (inv_C=0), sklearn's convention;
+        # re-validated because set_params bypasses __init__ — both
+        # engines must reject an unsupported penalty identically
+        if self.penalty not in ("l2", None, "none"):
+            raise ValueError(
+                "LogisticRegression supports penalty='l2' (or None)"
+            )
+        C_eff = (
+            np.inf if self.penalty in (None, "none")
+            else hyper_float(self.C)
+        )
         params, w_opt = logreg_host_fit(
             np.asarray(data["X"]), np.asarray(data["y"]),
             np.asarray(data["sw"]),
-            C=hyper_float(self.C), tol=hyper_float(self.tol),
+            C=C_eff, tol=hyper_float(self.tol),
             max_iter=self.max_iter, fit_intercept=self.fit_intercept,
             n_classes=k, history=self.history,
             class_weight=self.class_weight, cw_arr=meta.get("cw_arr"),
@@ -592,6 +605,12 @@ class LogisticRegression(_LinearClassifierBase):
             # same guard: a typo'd engine set via set_params must not
             # silently route to the batched device path
             raise ValueError("engine must be 'auto', 'host' or 'xla'")
+        penalty = st.get("penalty", "l2")
+        if penalty not in ("l2", None, "none"):
+            raise ValueError(
+                "LogisticRegression supports penalty='l2' (or None)"
+            )
+        unpenalized = penalty in (None, "none")
         bf16 = md == "bfloat16"
 
         def kernel(X, y_idx, sw, hyper, aux=None):
@@ -624,6 +643,8 @@ class LogisticRegression(_LinearClassifierBase):
                 def loss(w):
                     z = matvec(w)
                     ce = jnp.sum(sw * (jax.nn.softplus(z) - ypm * z))
+                    if unpenalized:  # penalty=None: sklearn's C=inf
+                        return ce
                     reg = 0.5 / C * jnp.dot(w[:d], w[:d])
                     return ce + reg
 
@@ -639,6 +660,8 @@ class LogisticRegression(_LinearClassifierBase):
                 logits = matvec(W)
                 lse = jax.nn.logsumexp(logits, axis=1)
                 ce = jnp.sum(sw * (lse - jnp.sum(onehot * logits, axis=1)))
+                if unpenalized:  # penalty=None: sklearn's C=inf
+                    return ce
                 reg = 0.5 / C * jnp.sum(W[:d] * W[:d])
                 return ce + reg
 

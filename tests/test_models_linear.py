@@ -461,3 +461,32 @@ def test_explicit_host_engine_wins_over_device_backend(clf_data,
     # 2 candidates x 3 folds + refit, none through the XLA batched path
     assert len(calls) == 7
     assert gs.best_score_ > 0.9
+
+
+def test_penalty_none_actually_unpenalized(clf_data):
+    """penalty=None must drop the ridge term in BOTH engines (sklearn's
+    C=inf convention) — previously it silently regularised with C."""
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    kw = dict(max_iter=500, tol=1e-6)
+    for engine in ("host", "xla"):
+        unpen = LogisticRegression(
+            penalty=None, C=0.01, engine=engine, **kw
+        ).fit(X, y)
+        pen = LogisticRegression(C=0.01, engine=engine, **kw).fit(X, y)
+        # a strongly-penalised fit must differ from the unpenalised one
+        assert np.abs(unpen.coef_ - pen.coef_).max() > 0.5, engine
+        sk = SkLR(C=np.inf, max_iter=2000).fit(X, y)
+        assert (unpen.predict(X) == sk.predict(X)).mean() >= 0.99, engine
+
+
+def test_host_engine_rejects_bad_penalty_like_xla(clf_data):
+    """set_params bypasses __init__: both engines must reject an
+    unsupported penalty identically, not silently fit L2."""
+    X, y = clf_data
+    for engine in ("host", "xla"):
+        est = LogisticRegression(max_iter=20, engine=engine)
+        est.set_params(penalty="l1")
+        with pytest.raises(ValueError, match="penalty"):
+            est.fit(X, y)
