@@ -210,7 +210,8 @@ class DistFeatureEliminator(BaseEstimator):
             ):
                 return None
         from ..models.linear import as_dense_f32, _freeze, extract_aux
-        from .search import _cached_cv_kernel
+        from ..parallel import structural_key
+        from .search import _cached_cv_kernel, _cv_kernel_key
         import jax.numpy as jnp
 
         try:
@@ -232,8 +233,10 @@ class DistFeatureEliminator(BaseEstimator):
 
         data, meta = est._prep_fit_data(X_arr, y, None)
         static = _freeze(est._static_config(meta))
+        base_key = _cv_kernel_key(type(est), meta, static, scorer_specs,
+                                  False)
         base_kernel = _cached_cv_kernel(
-            type(est), meta, static, scorer_specs, False
+            type(est), meta, static, scorer_specs, False, key=base_key
         )
         from ..models.linear import hyper_float
 
@@ -273,6 +276,10 @@ class DistFeatureEliminator(BaseEstimator):
                 "X": 0, "y": 0, "sw": 0,
                 "train_masks": 1, "test_masks": 1,
             }),
+            # the closure is rebuilt per fit but is fully determined by
+            # the base cv kernel it wraps; the structural key lets the
+            # jit/AOT caches see through the fresh closure identity
+            cache_key=structural_key("eliminate", type(est), base_key),
         )
         return np.asarray(
             scores["test_score"], dtype=np.float64

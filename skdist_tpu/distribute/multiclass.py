@@ -371,8 +371,16 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         }
         estimators = [None] * n_classes
         if live.size:
-            from ..parallel import row_sharded_specs
+            from ..models.linear import _meta_signature
+            from ..parallel import row_sharded_specs, structural_key
 
+            # the per-fit closure is fully determined by (estimator
+            # class, static config, meta signature, masking choice) —
+            # the structural key lets repeated OvR fits reuse one
+            # traced/compiled program despite the fresh closure
+            kernel_key = structural_key(
+                "ovr", type(est), static, _meta_signature(meta), use_masks
+            )
             specs = row_sharded_specs(
                 backend, shared, {"X": 0, "Y": 0, "sw": 0}
             )
@@ -410,6 +418,7 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
                 parts.append(backend.batched_map(
                     kernel, task_args, shared, round_size=round_size,
                     shared_specs=specs, pad_to_round=len(spans) > 1,
+                    cache_key=kernel_key,
                 ))
             stacked = parts[0] if len(parts) == 1 else (
                 jax.tree_util.tree_map(
@@ -661,13 +670,17 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
             "i": np.asarray([p[0] for p in self.pairs_], dtype=np.int32),
             "j": np.asarray([p[1] for p in self.pairs_], dtype=np.int32),
         }
-        from ..parallel import row_sharded_specs
+        from ..models.linear import _meta_signature
+        from ..parallel import row_sharded_specs, structural_key
 
         stacked = backend.batched_map(
             kernel, task_args, shared,
             round_size=parse_partitions(self.partitions, len(self.pairs_)),
             shared_specs=row_sharded_specs(
                 backend, shared, {"X": 0, "y": 0}
+            ),
+            cache_key=structural_key(
+                "ovo", type(est), static, _meta_signature(meta)
             ),
         )
         self.estimators_ = [

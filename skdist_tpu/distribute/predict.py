@@ -169,10 +169,8 @@ def _try_device_predict_sparse(model, X, method, backend, batch_size):
 
     which = "proba" if method == "predict_proba" else "decision"
     try:
-        kernel = get_kernel(
-            type(model), which, model._meta,
-            _freeze(model._static_config(model._meta)),
-        )
+        static = _freeze(model._static_config(model._meta))
+        kernel = get_kernel(type(model), which, model._meta, static)
     except AttributeError:
         return None
 
@@ -219,8 +217,18 @@ def _try_device_predict_sparse(model, X, method, backend, batch_size):
         ].add(task["val"])
         return {"out": kernel(shared["params"], dense)}
 
+    from ..models.linear import _meta_signature
+    from ..parallel import structural_key
+
     out = backend.batched_map(
-        block_kernel, {"idx": idx, "val": val}, {"params": params}
+        block_kernel, {"idx": idx, "val": val}, {"params": params},
+        # the closure bakes in the dense block shape (block, d) on top
+        # of the memoised decision/proba kernel — all of it in the key,
+        # so repeated sparse predicts share one traced program
+        cache_key=structural_key(
+            "predict_sparse", type(model), which, static,
+            _meta_signature(model._meta), block, d,
+        ),
     )["out"]
     out = out.reshape(-1, *out.shape[2:])[:n]
     return _postprocess_predict(model, out, method)
@@ -271,10 +279,8 @@ def _try_device_predict(model, X, method, backend, batch_size):
 
     which = "proba" if method == "predict_proba" else "decision"
     try:
-        kernel = get_kernel(
-            type(model), which, model._meta,
-            _freeze(model._static_config(model._meta)),
-        )
+        static = _freeze(model._static_config(model._meta))
+        kernel = get_kernel(type(model), which, model._meta, static)
     except AttributeError:
         return None
 
@@ -295,8 +301,15 @@ def _try_device_predict(model, X, method, backend, batch_size):
     def block_kernel(shared, task):
         return {"out": kernel(shared["params"], task["X"])}
 
+    from ..models.linear import _meta_signature
+    from ..parallel import structural_key
+
     out = backend.batched_map(
-        block_kernel, {"X": blocks}, {"params": params}
+        block_kernel, {"X": blocks}, {"params": params},
+        cache_key=structural_key(
+            "predict", type(model), which, static,
+            _meta_signature(model._meta),
+        ),
     )["out"]
     out = out.reshape(-1, *out.shape[2:])[:n]
 

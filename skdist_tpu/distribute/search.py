@@ -210,28 +210,45 @@ _CV_SAMPLE_AXES = {
 }
 
 
-_CV_KERNEL_CACHE = {}
-
-
-def _cached_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
-    """Cache cv kernels on their semantic key so repeated searches reuse
-    both the closure and (via the backend's jit cache) the compiled XLA
-    program."""
+def _cv_kernel_key(est_cls, meta, static, scorer_specs, return_train_score):
+    """Structural compile-cache key of one CV kernel: estimator class
+    qualname + static config + scorer names/kinds + meta signature
+    (``parallel.compile_cache.structural_key``). Shared by the kernel
+    memo below and by the ``cache_key`` handed to ``batched_map``, so
+    the closure, its traced jit entry, and its AOT executables all key
+    on the same stable semantics — in this process and (through the
+    on-disk XLA cache) across processes."""
     from ..models.linear import _meta_signature
+    from ..parallel import structural_key
 
-    sig = (
-        est_cls,
-        static,
-        tuple(scorer_specs),
-        return_train_score,
+    return structural_key(
+        "cv", est_cls, static,
+        # scorer kernels are module-level objects; their NAMES are the
+        # stable cross-process identity
+        tuple((out, metric, kind) for out, metric, _k, kind in scorer_specs),
+        bool(return_train_score),
         _meta_signature(meta),
     )
-    fn = _CV_KERNEL_CACHE.get(sig)
-    if fn is None:
-        fn = _build_cv_kernel(est_cls, meta, static, scorer_specs,
-                              return_train_score)
-        _CV_KERNEL_CACHE[sig] = fn
-    return fn
+
+
+def _cached_cv_kernel(est_cls, meta, static, scorer_specs,
+                      return_train_score, key=None):
+    """Cache cv kernels on their structural key so repeated searches
+    reuse both the closure and (via the backend's jit cache) the
+    compiled XLA program. ``key``: the precomputed
+    :func:`_cv_kernel_key` when the caller also needs it for
+    ``batched_map``'s ``cache_key`` — one computation, one source of
+    truth for both tiers."""
+    from ..parallel import compile_cache
+
+    if key is None:
+        key = _cv_kernel_key(est_cls, meta, static, scorer_specs,
+                             return_train_score)
+    return compile_cache.kernel_memo(
+        key,
+        lambda: _build_cv_kernel(est_cls, meta, static, scorer_specs,
+                                 return_train_score),
+    )
 
 
 def _build_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
@@ -461,10 +478,14 @@ class DistBaseSearchCV(BaseEstimator):
         whole grid costs little more than its hardest fit (round-4
         VERDICT task 3). Init-independence is what makes this safe:
         a tol-converged optimum is the same from any start, so scores
-        match cold fits to solver tolerance — and the engine refuses
-        to seed the chain from a fit that stopped on ``max_iter``
-        (it returns no optimum), so cap-limited candidates are fit
-        cold and stay reproducible outside the grid. Per-task
+        match cold fits to solver tolerance. Cap-limited candidates
+        are fit cold twice over: the engine refuses to seed the chain
+        from a fit that stopped on ``max_iter`` (it returns no
+        optimum), AND a warm-seeded fit that itself stops on the cap
+        is REFIT cold before its score is recorded — a capped
+        trajectory depends on its seed, so recording the warm run
+        would make the score depend on which other C values share the
+        grid (ADVICE r05 #1). Per-task
         semantics (slicing, scorers, error_score)
         are exactly ``_fit_and_score``'s — the same function runs each
         task, only construction and ordering differ."""
@@ -496,25 +517,39 @@ class DistBaseSearchCV(BaseEstimator):
             for s, (train, test) in enumerate(splits)
         ]
 
+        def fit_one(i, train, test, w0):
+            est = clone(estimator)
+            if candidate_params[i]:
+                est.set_params(**candidate_params[i])
+            if w0 is not None:
+                est._warm_w0 = w0
+            r = _fit_and_score(
+                estimator, X, y, scorers, train, test, None,
+                fit_params=fit_params,
+                error_score=self.error_score,
+                return_train_score=self.return_train_score,
+                est_instance=est, return_estimator=True,
+            )
+            fitted = r.pop("estimator", None)
+            return r, getattr(fitted, "_w_opt64", None)
+
         def run_chain(chain):
             idxs, train, test, s = chain
             results = []
             w_prev = None
             for i in idxs:
-                est = clone(estimator)
-                if candidate_params[i]:
-                    est.set_params(**candidate_params[i])
-                if w_prev is not None:
-                    est._warm_w0 = w_prev
-                r = _fit_and_score(
-                    estimator, X, y, scorers, train, test, None,
-                    fit_params=fit_params,
-                    error_score=self.error_score,
-                    return_train_score=self.return_train_score,
-                    est_instance=est, return_estimator=True,
-                )
-                fitted = r.pop("estimator", None)
-                w_prev = getattr(fitted, "_w_opt64", None)
+                r, w_opt = fit_one(i, train, test, w_prev)
+                if w_prev is not None and w_opt is None:
+                    # the warm-seeded fit stopped on max_iter (the
+                    # engine returned no converged optimum): its
+                    # trajectory — and therefore its recorded score —
+                    # depends on the seed, i.e. on which OTHER C values
+                    # happen to share the grid. Refit this candidate
+                    # cold so every recorded result is grid-independent
+                    # and reproducible outside the search (ADVICE r05
+                    # #1); the chain already restarts cold from here.
+                    r, w_opt = fit_one(i, train, test, None)
+                w_prev = w_opt
                 results.append((i, r))
             return results
 
@@ -531,6 +566,15 @@ class DistBaseSearchCV(BaseEstimator):
                      sample_weight=None):
         """Attempt the batched device path; None → fall back to generic."""
         if not hasattr(type(estimator), "_build_fit_kernel"):
+            return None
+        if any("engine" in cand for cand in candidate_params):
+            # a searchable 'engine' must be HONOURED per candidate, and
+            # the batched path compiles one engine for the whole bucket
+            # — prefers_host_engine inspects only the base estimator, so
+            # a {'engine': ['host', 'xla']} grid would silently run the
+            # host bucket through the XLA kernel (ADVICE r05 #2). The
+            # generic path clones + set_params per task, so each fit
+            # resolves its own engine correctly.
             return None
         if prefers_host_engine(backend, estimator):
             # a host backend whose estimator resolves to the f64 BLAS
@@ -597,8 +641,12 @@ class DistBaseSearchCV(BaseEstimator):
                 # (raise vs numeric substitute) applies per task
                 return None
             static = _freeze(bucket_est._static_config(meta))
-            kernel = _cached_cv_kernel(
+            kernel_key = _cv_kernel_key(
                 est_cls, meta, static, scorer_specs, self.return_train_score
+            )
+            kernel = _cached_cv_kernel(
+                est_cls, meta, static, scorer_specs,
+                self.return_train_score, key=kernel_key,
             )
             # all leaves stay host-staged: batched_map performs the one
             # sharded placement (through the reuse-broadcast cache when
@@ -636,7 +684,7 @@ class DistBaseSearchCV(BaseEstimator):
                 shared_specs=row_sharded_specs(
                     backend, shared, _CV_SAMPLE_AXES
                 ),
-                return_timings=True,
+                return_timings=True, cache_key=kernel_key,
             )
             # per-task fit_time = its round's measured wall / tasks in
             # that round (fit+score run fused in one kernel, so the

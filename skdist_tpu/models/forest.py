@@ -119,7 +119,8 @@ def _oob_aggregator(max_depth):
 def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
                             min_samples_split, min_samples_leaf,
                             min_impurity_decrease, extra, classification,
-                            bootstrap, hist_mode="auto", hist_block=None):
+                            bootstrap, hist_mode="auto", hist_block=None,
+                            fractional_weights=False):
     """One-tree task kernel for ``backend.batched_map``: the task is a
     scalar PRNG seed (mirroring the reference's per-tree random states,
     ensemble.py:278). The seed is stored with the tree so OOB masks
@@ -136,7 +137,8 @@ def make_forest_tree_kernel(d, n_bins, channels, max_depth, max_features,
     # allow_native=False: this kernel IS the XLA path — forest.fit
     # routes native-mode fits to the host engine before reaching here
     hist_mode, hist_block = resolve_hist_config(
-        d, n_bins, hist_mode, hist_block, allow_native=False
+        d, n_bins, hist_mode, hist_block, allow_native=False,
+        fractional_weights=fractional_weights,
     )
     return _forest_kernel_cached(
         d, n_bins, channels, max_depth, max_features, min_samples_split,
@@ -177,6 +179,18 @@ def _forest_kernel_cached(d, n_bins, channels, max_depth, max_features,
         tree["seed"] = task["seed"]
         return tree
 
+    # structural compile-cache key: the closure is fully determined by
+    # this memo's own (fully-resolved) argument tuple; the batched_map
+    # call site passes it so the jit/AOT caches survive an lru_cache
+    # eviction of the closure itself
+    from ..parallel import structural_key
+
+    kernel.cache_key = structural_key(
+        "forest_tree", "tree_kernel", d, n_bins, channels, max_depth,
+        max_features, min_samples_split, min_samples_leaf,
+        min_impurity_decrease, extra, classification, bootstrap,
+        hist_mode, hist_block,
+    )
     return kernel
 
 
@@ -371,6 +385,12 @@ class _BaseForest(BaseEstimator):
                     extra=self._extra, classification=self._classification,
                     bootstrap=self.bootstrap,
                     hist_mode=getattr(self, "hist_mode", "auto"),
+                    # sw already folds class_weight in, so one integral
+                    # check covers both fractional sources; only a
+                    # calibrated matmul_sib 'auto' pick consults this
+                    fractional_weights=bool(
+                        np.any(np.asarray(sw) != np.rint(sw))
+                    ),
                 )
                 shared = {
                     "Xb": Xb,  # host-staged: batched_map places (and can
@@ -378,7 +398,8 @@ class _BaseForest(BaseEstimator):
                     "sw": np.asarray(sw),
                 }
                 new_trees = backend.batched_map(
-                    kernel, {"seed": seeds}, shared, round_size=round_size
+                    kernel, {"seed": seeds}, shared, round_size=round_size,
+                    cache_key=kernel.cache_key,
                 )
             if prev is not None:
                 self._trees = jax.tree_util.tree_map(

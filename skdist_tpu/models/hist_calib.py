@@ -43,7 +43,12 @@ def _calib_path():
 #: HBM for FLOP gains that scale the wrong way)
 DEFAULT_MAX_MATMUL_DB = 16384
 
-_VALID_MODES = ("scatter", "matmul", "pallas", "native")
+#: matmul_sib is a legal CALIBRATED mode: build_tools/tpu_tree_sweep.py
+#: measures it as a candidate (recording a matmul_sib winner used to
+#: crash record_calibration), and resolve_hist_config gates the 'auto'
+#: pick to integer-effective-weight fits (fractional weights degrade to
+#: plain matmul — see models/tree.py)
+_VALID_MODES = ("scatter", "matmul", "matmul_sib", "pallas", "native")
 
 
 def _load_table():
@@ -86,7 +91,7 @@ def record_calibration(platform, mode, hist_block=8, measured=None,
     if mode not in _VALID_MODES:
         raise ValueError(f"mode must be one of {_VALID_MODES}; got {mode!r}")
     if xla_mode is not None and xla_mode not in ("scatter", "matmul",
-                                                 "pallas"):
+                                                 "matmul_sib", "pallas"):
         raise ValueError(f"xla_mode must be an XLA engine; got {xla_mode!r}")
     path = _calib_path()
     with _LOCK:

@@ -246,9 +246,6 @@ def maybe_exact_matmuls(cls, fn):
     return exact_matmuls(fn) if getattr(cls, "_exact_matmuls", True) else fn
 
 
-_KERNEL_CACHE = {}
-
-
 def _meta_signature(meta):
     cw = meta.get("cw_arr")
     return (
@@ -262,21 +259,28 @@ def _meta_signature(meta):
 def get_kernel(cls, which, meta, static):
     """Fetch a (possibly jitted) kernel from the process-wide cache.
 
-    Kernel builders return fresh closures; caching on the *semantic* key
-    (class, static config, meta signature) keeps jax.jit's own cache hot
-    across estimator instances — without this every `.fit()` would
-    recompile.
+    Kernel builders return fresh closures; caching on the *structural*
+    key (class qualname, static config, meta signature — see
+    ``parallel.compile_cache.structural_key``) keeps jax.jit's own
+    cache hot across estimator instances — without this every `.fit()`
+    would recompile. The same memo records hit/miss counters for
+    benchmark/test observability.
     """
-    sig = (cls, which, static, _meta_signature(meta))
-    fn = _KERNEL_CACHE.get(sig)
-    if fn is None:
+    from ..parallel import compile_cache
+
+    sig = compile_cache.structural_key(
+        f"kernel:{which}", cls, static, _meta_signature(meta)
+    )
+
+    def build():
         fn = maybe_exact_matmuls(
             cls, getattr(cls, f"_build_{which}_kernel")(meta, static)
         )
         if which == "fit":
             fn = jax.jit(fn)
-        _KERNEL_CACHE[sig] = fn
-    return fn
+        return fn
+
+    return compile_cache.kernel_memo(sig, build)
 
 
 class _LinearModelBase(BaseEstimator):
@@ -729,6 +733,7 @@ class LinearSVC(_LinearClassifierBase):
     _hyper_names = ("C", "tol")
     _static_names = (
         "max_iter", "fit_intercept", "class_weight", "history", "engine",
+        "loss",
     )
 
     def __init__(self, C=1.0, tol=1e-4, max_iter=1000, fit_intercept=True,
@@ -756,6 +761,11 @@ class LinearSVC(_LinearClassifierBase):
         squared-hinge objective; ``models/host_linear.py``)."""
         from .host_linear import svc_host_fit
 
+        # re-validated because set_params bypasses __init__: a
+        # set_params(loss='hinge') must fail loudly on BOTH engines
+        # instead of silently fitting squared hinge (ADVICE r05 #3)
+        if self.loss != "squared_hinge":
+            raise ValueError("LinearSVC supports loss='squared_hinge'")
         data, meta = self._prep_fit_data(X, y, sample_weight)
         k = meta["n_classes"]
         p = meta["n_features"] + (1 if self.fit_intercept else 0)
@@ -790,6 +800,10 @@ class LinearSVC(_LinearClassifierBase):
             # re-validated because set_params bypasses __init__ (same
             # guard convention as LogisticRegression's matmul_dtype)
             raise ValueError("engine must be 'auto', 'host' or 'xla'")
+        if st.get("loss", "squared_hinge") != "squared_hinge":
+            # same convention for loss: set_params(loss='hinge') must
+            # not silently fit squared hinge (ADVICE r05 #3)
+            raise ValueError("LinearSVC supports loss='squared_hinge'")
 
         def kernel(X, y_idx, sw, hyper, aux=None):
             C = hyper["C"]

@@ -63,7 +63,8 @@ def n_tree_nodes(max_depth):
 
 
 def resolve_hist_config(n_features, n_bins, hist_mode="auto",
-                        hist_block=None, allow_native=True):
+                        hist_block=None, allow_native=True,
+                        fractional_weights=False):
     """Concrete ``(hist_mode, hist_block)`` for this platform + shape.
 
     ``"auto"`` takes the MEASURED per-platform winner from
@@ -82,6 +83,15 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
     re-resolves to the platform shape heuristic instead — NOT blindly
     to scatter, which would be the wrong engine on a TPU whose host
     happens to win the local sweep.
+
+    ``fractional_weights=True`` declares that the fit's effective
+    per-sample weights are NOT integers (class_weight, non-integral
+    sample_weight): a calibrated ``matmul_sib`` pick under ``"auto"``
+    then degrades to plain ``matmul`` — sibling subtraction is exact
+    only when histogram entries are exact in f32 (integer counts), and
+    fractional weights can round and flip near-tie splits. An EXPLICIT
+    ``hist_mode='matmul_sib'`` is honoured as-is (the user owns the
+    trade).
     """
     from .hist_calib import DEFAULT_MAX_MATMUL_DB, get_calibration
 
@@ -114,6 +124,11 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
             hist_mode = "_heuristic"
     if hist_mode == "_heuristic":
         hist_mode = "matmul" if jax.default_backend() != "cpu" else "scatter"
+    if resolved and hist_mode == "matmul_sib" and fractional_weights:
+        # calibrated auto default only for integer-effective-weight
+        # fits (ADVICE r05 #4): the sweep measures speed, not the
+        # f32 rounding of fractional-weight sibling subtraction
+        hist_mode = "matmul"
     # single width guard for every RESOLVED path (an explicit
     # matmul/pallas request is honoured as-is): the one-hot contraction
     # is (n, d·B)-sized, degrade to scatter above the calibrated bound
@@ -128,7 +143,8 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
 def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
                       min_samples_split, min_samples_leaf,
                       min_impurity_decrease, extra, classification,
-                      hist_block=None, hist_mode="auto"):
+                      hist_block=None, hist_mode="auto",
+                      fractional_weights=False):
     """Returns ``kernel(Xb, Ych, key) -> tree`` growing one tree.
 
     - ``Xb`` (n, d) int32 binned features
@@ -171,8 +187,13 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
       identical trees on tie-heavy fuzz data); fractional
       class/sample weights can round and flip near-tie splits (the
       same flip class as the xla-vs-native near-ties, NOTES round-4
-      fuzz), so the mode stays an on-chip sweep candidate rather than
-      a silent default.
+      fuzz). The sweep may therefore calibrate it as the ``"auto"``
+      default, but ``resolve_hist_config`` honours that calibration
+      ONLY for integer-effective-weight fits — callers declaring
+      ``fractional_weights=True`` (class_weight / non-integral
+      sample_weight) degrade the calibrated pick to plain
+      ``"matmul"``; an explicit ``hist_mode='matmul_sib'`` is always
+      honoured.
     - ``"auto"``: the MEASURED per-platform winner from
       ``models/hist_calib.json`` (written by the on-chip sweep,
       ``build_tools/tpu_tree_sweep.py``), with a width guard — matmul /
@@ -194,7 +215,8 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
     # selected at the FOREST level (forest.py routes around the XLA
     # kernel); this builder needs an in-program algorithm
     hist_mode, hist_block = resolve_hist_config(
-        d, B, hist_mode, hist_block, allow_native=False
+        d, B, hist_mode, hist_block, allow_native=False,
+        fractional_weights=fractional_weights,
     )
     if hist_mode not in ("scatter", "matmul", "matmul_sib", "pallas"):
         raise ValueError(
@@ -533,7 +555,14 @@ class _BaseTree(BaseEstimator):
         X = as_dense_f32(X)
         sw = prepare_sample_weight(sample_weight, X.shape[0])
         edges = quantile_bin_edges(X, self.n_bins)
-        meta = {"n_features": X.shape[1], "edges": edges}
+        # CV fold masks are 0/1, so integral sw stays integral under
+        # the batched search's mask composition — prep time is the one
+        # place the weights' integral-ness is decidable for the gate
+        # resolve_hist_config applies to a calibrated matmul_sib
+        meta = {
+            "n_features": X.shape[1], "edges": edges,
+            "fractional_weights": bool(np.any(sw != np.rint(sw))),
+        }
         if self._classification:
             y_idx, classes = encode_labels(y)
             meta.update(classes=classes, n_classes=len(classes))
@@ -552,6 +581,9 @@ class _BaseTree(BaseEstimator):
         cfg = {k: getattr(self, k) for k in self._static_names}
         cfg["_n_classes"] = meta.get("n_classes", 0)
         cfg["_n_features"] = meta["n_features"]
+        # rides the static config so the kernel caches key on it and
+        # _build_fit_kernel can apply the matmul_sib weight gate
+        cfg["_fractional_weights"] = meta.get("fractional_weights", False)
         return cfg
 
     @classmethod
@@ -571,6 +603,7 @@ class _BaseTree(BaseEstimator):
             extra=(st["splitter"] == "random"),
             classification=classification,
             hist_mode=st.get("hist_mode", "auto"),
+            fractional_weights=st.get("_fractional_weights", False),
         )
         seed = st["random_state"] or 0
 

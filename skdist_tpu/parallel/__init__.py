@@ -5,6 +5,7 @@ every reference estimator is built on (reference ``search.py:411-437``,
 ``multiclass.py:316-331``, ``ensemble.py:304-322``).
 """
 
+from . import compile_cache
 from .backend import (
     LocalBackend,
     TPUBackend,
@@ -15,6 +16,7 @@ from .backend import (
     resolve_backend,
     row_sharded_specs,
 )
+from .compile_cache import enable_disk_cache, structural_key
 
 __all__ = [
     "TaskBackend",
@@ -25,4 +27,7 @@ __all__ = [
     "prefers_host_engine",
     "get_value",
     "row_sharded_specs",
+    "compile_cache",
+    "enable_disk_cache",
+    "structural_key",
 ]

@@ -31,6 +31,12 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from . import compile_cache
+
+
+def _env_flag(name):
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes")
+
 
 def prefers_host_engine(backend, estimator):
     """True when a batched dispatch should yield to the host fan-out
@@ -102,12 +108,17 @@ class TaskBackend:
     def broadcast(self, value):
         return _BroadcastHandle(value)
 
+    #: scheduler stats of the most recent batched_map call (mode,
+    #: rounds, dispatch_s, gather_wait_s) — benchmark / diagnostic
+    #: observability for the pipelined round scheduler
+    last_round_stats = None
+
     def run_tasks(self, fn, tasks, verbose=0):
         raise NotImplementedError
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
                     round_size=None, shared_specs=None, return_timings=False,
-                    pad_to_round=False):
+                    pad_to_round=False, cache_key=None):
         raise NotImplementedError
 
     # fitted estimators must never hold a live backend; give pickle a
@@ -128,8 +139,13 @@ class LocalBackend(TaskBackend):
     reason the reference broadcasts instead of shipping X per task.
     """
 
-    def __init__(self, n_jobs=None):
+    def __init__(self, n_jobs=None, sync_rounds=None):
         self.n_jobs = n_jobs
+        self.sync_rounds = (
+            _env_flag("SKDIST_SYNC_ROUNDS") if sync_rounds is None
+            else bool(sync_rounds)
+        )
+        compile_cache.maybe_enable_from_env()
 
     def _effective_jobs(self, n_tasks):
         n_jobs = self.n_jobs
@@ -149,7 +165,7 @@ class LocalBackend(TaskBackend):
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
                     round_size=None, shared_specs=None, return_timings=False,
-                    pad_to_round=False):
+                    pad_to_round=False, cache_key=None):
         """Run the stacked kernel on the host's default JAX device.
 
         Same compiled program as the TPU path minus the mesh sharding, so
@@ -159,18 +175,26 @@ class LocalBackend(TaskBackend):
         round shape AT ``round_size`` even when fewer tasks remain
         (padding duplicates the last task; outputs are sliced off in
         ``_run_in_rounds``) — for callers issuing several dispatches
-        that must reuse one compiled shape.
+        that must reuse one compiled shape. ``cache_key`` is the
+        caller's structural compile-cache key (see
+        ``parallel.compile_cache``): per-call kernel closures with the
+        same key share one traced/compiled program.
         """
-        fn = _jit_vmapped(kernel, static_args)
+        # no donation on the host path: task slices arrive as numpy
+        # (uncommitted), which jit cannot donate — requesting it would
+        # only emit unusable-donation noise
+        fn = _jit_vmapped(kernel, static_args, None, None, cache_key, False)
         n_tasks = _leading_dim(task_args)
         if pad_to_round and round_size:
             chunk = round_size
         else:
             chunk = min(n_tasks, round_size or n_tasks)
         timings = [] if return_timings else None
+        stats = self.last_round_stats = {}
         try:
             out = _run_in_rounds(
-                fn, task_args, shared_args, n_tasks, chunk, timings=timings
+                fn, task_args, shared_args, n_tasks, chunk, timings=timings,
+                pipeline=not self.sync_rounds, stats=stats,
             )
         except _RoundsExhausted as oom:
             # no adaptive retry on host memory; surface the real error
@@ -192,7 +216,8 @@ class TPUBackend(TaskBackend):
 
     def __init__(self, devices=None, axis_name="tasks", round_size=None,
                  n_jobs=None, data_axis_size=1, mesh=None,
-                 reuse_broadcast=False):
+                 reuse_broadcast=False, compile_cache_dir=None,
+                 sync_rounds=None, donate_tasks=True):
         """``data_axis_size`` > 1 builds a 2D ('tasks', 'data') mesh:
         that many devices cooperate on each task with row-sharded shared
         data (GSPMD inserts the psum of gram/gradient partials over
@@ -209,6 +234,19 @@ class TPUBackend(TaskBackend):
         contract: mutating a host array after it was broadcast is user
         error (the cached device copy would go stale; reference Spark
         broadcasts behave identically). Off by default.
+
+        ``compile_cache_dir`` points JAX's persistent on-disk
+        compilation cache at a directory (see ``parallel.compile_cache``)
+        so repeated service processes skip XLA compilation entirely;
+        the ``SKDIST_COMPILE_CACHE_DIR`` environment variable is the
+        no-code equivalent. ``sync_rounds=True`` (or env
+        ``SKDIST_SYNC_ROUNDS=1``) forces the round loop synchronous —
+        one round dispatched, gathered, then the next — for debugging;
+        the default pipelines rounds (gather of round k overlaps the
+        dispatch/compute of round k+1). ``donate_tasks=False`` disables
+        donation of per-round task-axis input buffers (donation
+        reclaims one round's task-argument HBM for outputs/temps and is
+        safe because every round places a fresh slice).
         """
         import jax
         from jax.sharding import Mesh
@@ -216,6 +254,16 @@ class TPUBackend(TaskBackend):
         self.round_size = round_size
         self.n_jobs = n_jobs
         self.reuse_broadcast = reuse_broadcast
+        self.compile_cache_dir = (
+            compile_cache.enable_disk_cache(compile_cache_dir)
+            if compile_cache_dir
+            else compile_cache.maybe_enable_from_env()
+        )
+        self.sync_rounds = (
+            _env_flag("SKDIST_SYNC_ROUNDS") if sync_rounds is None
+            else bool(sync_rounds)
+        )
+        self.donate_tasks = bool(donate_tasks)
         if mesh is not None:
             self.mesh = mesh
             self.devices = list(mesh.devices.flat)
@@ -299,12 +347,14 @@ class TPUBackend(TaskBackend):
         leaves = jax.tree_util.tree_leaves(value)
         if leaves and all(hasattr(x, "shape") for x in leaves):
             replicated = NamedSharding(self.mesh, P())
-            value = jax.device_put(value, replicated)
+            value = jax.tree_util.tree_map(
+                lambda a: _put_mesh_scoped(a, replicated), value
+            )
         return _BroadcastHandle(value)
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
                     round_size=None, shared_specs=None, return_timings=False,
-                    pad_to_round=False):
+                    pad_to_round=False, cache_key=None):
         """Stack → shard → compile once → run in rounds → gather.
 
         ``task_args``: pytree whose leaves have a leading axis of length
@@ -319,6 +369,9 @@ class TPUBackend(TaskBackend):
         the last task and slices its outputs off) — for callers issuing
         several dispatches that must reuse one compiled shape; the
         proactive/reactive HBM shrinking below still wins over it.
+        ``cache_key`` is the caller's structural compile-cache key (see
+        ``parallel.compile_cache``): per-call kernel closures with the
+        same key share one traced/compiled program across fits.
         Returns host numpy, leading axis n_tasks.
         """
         import jax
@@ -354,11 +407,22 @@ class TPUBackend(TaskBackend):
                 shared_args,
             )
         else:
-            shared_args = jax.device_put(shared_args, shared_shardings)
+            # shardings form a PREFIX tree of shared_args (one sharding
+            # per top-level entry; entries may be sub-trees)
+            shared_args = jax.tree_util.tree_map(
+                lambda sh, sub: jax.tree_util.tree_map(
+                    lambda a: _put_mesh_scoped(a, sh), sub
+                ),
+                shared_shardings, shared_args,
+                is_leaf=lambda x: isinstance(x, NamedSharding),
+            )
         fn = _jit_vmapped(
-            kernel, static_args, task_sharding, shared_shardings
+            kernel, static_args, task_sharding, shared_shardings,
+            cache_key, self.donate_tasks,
         )
-        put = lambda t: jax.device_put(t, task_sharding)
+        put = lambda t: jax.tree_util.tree_map(
+            lambda a: _put_mesh_scoped(a, task_sharding), t
+        )
         # Proactive round sizing (NOTES gap 5 closed): where the device
         # reports memory stats, AOT-compile the round program and shrink
         # the first round to fit BEFORE dispatch — a device OOM costs a
@@ -395,6 +459,7 @@ class TPUBackend(TaskBackend):
         # `partitions` by hand, automated; a new chunk size is a new
         # shape, so jax recompiles transparently.
         timings = [] if return_timings else None
+        stats = self.last_round_stats = {}
         rounds_out = []
         offset = 0
         while offset < n_tasks:
@@ -406,6 +471,7 @@ class TPUBackend(TaskBackend):
                 rounds_out.extend(_run_in_rounds(
                     exec_fn, sub, shared_args, n_tasks - offset, chunk,
                     put=put, timings=timings, concat=False,
+                    pipeline=not self.sync_rounds, stats=stats,
                 ))
                 break
             except _RoundsExhausted as oom:
@@ -454,15 +520,52 @@ _BCAST_MIN_BYTES = 1 << 20  # caching tiny arrays is pure overhead
 _BCAST_HITS = 0  # diagnostics + test observability
 
 
+def _put_mesh_scoped(x, sharding):
+    """``device_put`` that never joins a JOB-GLOBAL collective.
+
+    ``jax.device_put`` of a host value to a sharding that is not fully
+    addressable (a mesh spanning processes) runs
+    ``multihost_utils.assert_equal`` — a collective over EVERY process
+    in the job. For a mesh covering a strict subset of the job's
+    processes that deadlocks (or crashes the transport) against
+    non-members that never join — the exact failure class
+    ``_mesh_min_int`` exists to avoid for chunk agreement. Instead,
+    each process places its OWN addressable shards and assembles the
+    global array (collective-free); the SPMD contract that every
+    participating process passes the same host value is assumed, as it
+    already is for the round loop itself. Fully-addressable shardings
+    (single-process) take the plain fast path.
+    """
+    import jax
+
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    if getattr(x, "is_fully_addressable", True) is False:
+        # already a global (multi-process) array: jax reshards it on
+        # device without consulting a host value, so there is no
+        # equality collective to avoid — and np.asarray on it would
+        # raise rather than fetch non-addressable shards
+        return jax.device_put(x, sharding)
+    # host value (or a local device array, at the price of one D2H
+    # copy): assemble from this process's shards
+    x = np.asarray(x)
+    shards = [
+        jax.device_put(x[idx], d)
+        for d, idx in
+        sharding.addressable_devices_indices_map(x.shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(
+        x.shape, sharding, shards
+    )
+
+
 def _cached_device_put(leaf, sharding, enabled):
     import weakref
-
-    import jax
 
     global _BCAST_HITS
     if not enabled or not isinstance(leaf, np.ndarray) \
             or leaf.nbytes < _BCAST_MIN_BYTES:
-        return jax.device_put(leaf, sharding)
+        return _put_mesh_scoped(leaf, sharding)
     key = (id(leaf), sharding)
     ent = _BCAST_CACHE.get(key)
     if ent is not None:
@@ -473,7 +576,7 @@ def _cached_device_put(leaf, sharding, enabled):
                 _BCAST_CACHE[key] = ent
             return dev
         _BCAST_CACHE.pop(key, None)  # id() recycled; never serve stale
-    dev = jax.device_put(leaf, sharding)
+    dev = _put_mesh_scoped(leaf, sharding)
     _BCAST_CACHE[key] = (
         weakref.ref(leaf, lambda _ref: _BCAST_CACHE.pop(key, None)),
         dev,
@@ -503,26 +606,49 @@ def _gather_host(tree):
     """collect(): device outputs → host numpy.
 
     Single-process: plain ``device_get``. Multi-process SPMD: outputs
-    sharded over a mesh that spans processes are not fully addressable,
-    so each leaf is assembled with ``process_allgather`` (a collective
-    — safe because the round loop is replicated SPMD, every process
-    gathers the same leaves in the same order). This is the DCN leg of
-    the reference's ``collect()``: per-host shards ride the allgather,
-    and every host ends with the full result, which is what the
-    driver-side cv_results_ assembly expects.
+    sharded over a mesh that spans processes are not fully
+    addressable; each leaf is replicated BY A COLLECTIVE ON ITS OWN
+    MESH (a jit identity with replicated out_shardings — the allgather
+    rides ICI/DCN among the mesh's processes only) and then read from
+    a local replica. NOT ``process_allgather``, which is a job-global
+    collective: for a mesh covering a strict subset of the job's
+    processes it would block on (or crash against) processes that own
+    no device in the mesh — the same deadlock class the chunk
+    agreement (``_mesh_min_int``) and placement (``_put_mesh_scoped``)
+    avoid. Safe because the round loop is replicated SPMD across the
+    mesh's processes: every member gathers the same leaves in the same
+    order. This is the DCN leg of the reference's ``collect()``: every
+    host ends with the full result, which is what the driver-side
+    cv_results_ assembly expects.
     """
     import jax
 
     if jax.process_count() == 1:
         return jax.device_get(tree)
-    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     def one(x):
         if getattr(x, "is_fully_addressable", True):
             return jax.device_get(x)
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        replicate = _jit_replicate(NamedSharding(x.sharding.mesh, P()))
+        return np.asarray(replicate(x).addressable_data(0))
 
     return jax.tree_util.tree_map(one, tree)
+
+
+_REPLICATE_CACHE = {}
+
+
+def _jit_replicate(replicated_sharding):
+    """Identity jit with replicated out_shardings, memoised per
+    sharding — the mesh-scoped allgather used by ``_gather_host``."""
+    import jax
+
+    fn = _REPLICATE_CACHE.get(replicated_sharding)
+    if fn is None:
+        fn = jax.jit(lambda v: v, out_shardings=replicated_sharding)
+        _REPLICATE_CACHE[replicated_sharding] = fn
+    return fn
 
 
 def _concat_rounds(outs):
@@ -538,31 +664,68 @@ def _concat_rounds(outs):
 _MAX_ROUNDS_IN_FLIGHT = 2
 
 
+def _start_host_copy(dev_out):
+    """Best-effort async D2H on a dispatched round's outputs: the copy
+    enqueues behind the round's compute on the device stream while the
+    host moves on to slicing/placing/dispatching the NEXT round — by the
+    time the blocking gather reaches these arrays the bytes are already
+    (or nearly) on host. Non-addressable leaves (multi-process meshes)
+    are skipped; they take ``_gather_host``'s allgather leg. Errors are
+    swallowed: a poisoned async computation re-surfaces at the blocking
+    gather, where the OOM-resume machinery handles it."""
+    import jax
+
+    try:
+        for leaf in jax.tree_util.tree_leaves(dev_out):
+            if getattr(leaf, "is_fully_addressable", True):
+                leaf.copy_to_host_async()
+    except Exception:
+        pass
+
+
 def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
-                   timings=None, concat=True):
+                   timings=None, concat=True, pipeline=True, stats=None):
     """Shared round loop: slice task axis, pad the tail round to the
     fixed chunk shape (padding duplicates the last task; its outputs are
     sliced off), run, gather to host numpy, concatenate (or return the
     per-round list with ``concat=False``).
 
-    Dispatch depth is BOUNDED at :data:`_MAX_ROUNDS_IN_FLIGHT`: JAX
-    dispatch is asynchronous, so keeping one round in flight behind the
-    executing one still overlaps round i+1's host-side slicing and
-    transfer with round i's device compute — while guaranteeing that at
-    most two rounds' task args + outputs are device-resident at once.
-    (Dispatching ALL rounds up front made the aggregate footprint grow
-    with the round count, which defeated the proactive HBM sizing in
-    exactly the shrunk-chunk case it exists for — round-2 advisor.)
+    ``pipeline=True`` (the default) double-buffers the rounds: dispatch
+    depth is BOUNDED at :data:`_MAX_ROUNDS_IN_FLIGHT`, and each
+    dispatched round's outputs immediately start an async D2H copy
+    (:func:`_start_host_copy`), so round k's gather rides the device
+    stream behind round k+1's dispatch instead of serialising after it.
+    The bound guarantees at most two rounds' task args + outputs are
+    device-resident at once. (Dispatching ALL rounds up front made the
+    aggregate footprint grow with the round count, which defeated the
+    proactive HBM sizing in exactly the shrunk-chunk case it exists for
+    — round-2 advisor.) ``pipeline=False`` (the backends'
+    ``sync_rounds`` debug flag) forces one round at a time: dispatch,
+    block on its gather, then dispatch the next. Both modes execute the
+    same compiled program on the same inputs, so gathered outputs are
+    bitwise identical.
 
     ``timings``: optional list; appends ``(round_wall_s, n_tasks_kept)``
     per round — measured gather-to-gather so the walls are
     non-overlapping and sum to the call's total despite pipelining.
+
+    ``stats``: optional dict; accumulates scheduler observability —
+    ``rounds``, ``dispatch_s`` (host time spent slicing/placing/
+    enqueueing), ``gather_wait_s`` (host time BLOCKED on device
+    results; with pipelining this is the unoverlapped remainder),
+    ``mode``.
 
     A RESOURCE_EXHAUSTED failure raises :class:`_RoundsExhausted`
     carrying the successfully gathered rounds.
     """
     import jax
 
+    depth = _MAX_ROUNDS_IN_FLIGHT if pipeline else 1
+    if stats is not None:
+        stats["mode"] = "pipelined" if pipeline else "synchronous"
+        stats.setdefault("rounds", 0)
+        stats.setdefault("dispatch_s", 0.0)
+        stats.setdefault("gather_wait_s", 0.0)
     t_prev = time.perf_counter() if timings is not None else None
     outs = []
     consumed = 0
@@ -576,7 +739,10 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
         nonlocal t_prev, consumed, in_gather
         dev_out, keep, pad = pending.pop(0)
         in_gather = True
+        t_g = time.perf_counter() if stats is not None else None
         out = _gather_host(dev_out)
+        if stats is not None:
+            stats["gather_wait_s"] += time.perf_counter() - t_g
         in_gather = False
         if timings is not None:
             now = time.perf_counter()
@@ -589,6 +755,12 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
 
     try:
         for start in range(0, n_tasks, chunk):
+            if not pipeline:
+                # strict synchronous debug mode: the previous round is
+                # fully on host before ANY host work for the next starts
+                while pending:
+                    _gather_oldest()
+            t_d = time.perf_counter() if stats is not None else None
             stop = min(start + chunk, n_tasks)
             sl = jax.tree_util.tree_map(lambda a: a[start:stop], task_args)
             pad = chunk - (stop - start)
@@ -601,9 +773,22 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
                 )
             if put is not None:
                 sl = put(sl)
-            while len(pending) >= _MAX_ROUNDS_IN_FLIGHT:
+            if stats is not None:
+                # pause the dispatch clock over the blocked gather below
+                # — its wall belongs to gather_wait_s alone, and the
+                # dispatch_s / gather_wait_s split is what bench's
+                # `overlap` aux reports
+                stats["dispatch_s"] += time.perf_counter() - t_d
+            while len(pending) >= depth:
                 _gather_oldest()
-            pending.append((fn(shared_args, sl), stop - start, pad))
+            t_d = time.perf_counter() if stats is not None else None
+            dev_out = fn(shared_args, sl)
+            pending.append((dev_out, stop - start, pad))
+            if stats is not None:
+                stats["rounds"] += 1
+                stats["dispatch_s"] += time.perf_counter() - t_d
+            if pipeline:
+                _start_host_copy(dev_out)
         while pending:
             _gather_oldest()
     except Exception as exc:
@@ -645,18 +830,11 @@ def _leading_dim(task_args):
     return leaves[0].shape[0]
 
 
-#: AOT executables keyed by (jit fn, shared shape sig, chunk) — the jit
-#: fn itself is memoised in _JIT_CACHE, so this composes to the same
-#: lifetime jit's own compilation cache would have had
-_AOT_CACHE = {}
-
-
-def _shape_sig(tree):
-    import jax
-
-    return tuple(
-        (tuple(l.shape), str(l.dtype)) for l in jax.tree_util.tree_leaves(tree)
-    )
+#: AOT executables live in compile_cache (keyed by (jit fn, shared
+#: shape sig, chunk) — the jit fn itself is memoised structurally, so
+#: this composes to the same lifetime jit's own cache would have had,
+#: plus hit/miss counters and the on-disk write-through)
+_shape_sig = compile_cache.shape_sig
 
 
 def _aot_exec_fn(fn, shared_args, task_args, chunk, d, free_bytes,
@@ -683,18 +861,9 @@ def _aot_exec_fn(fn, shared_args, task_args, chunk, d, free_bytes,
     shared_sig = _shape_sig(shared_args)
 
     def _compiled_for(n_chunk, task_like):
-        key = (fn, shared_sig, n_chunk)
-        comp = _AOT_CACHE.get(key)
-        if comp is None:
-            structs = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(
-                    (n_chunk,) + tuple(a.shape[1:]), a.dtype
-                ),
-                task_like,
-            )
-            comp = fn.lower(shared_args, structs).compile()
-            _AOT_CACHE[key] = comp
-        return comp
+        return compile_cache.aot_executable(
+            fn, shared_args, task_like, n_chunk, shared_sig=shared_sig
+        )
 
     def exec_fn(shared, sl):
         n_chunk = _leading_dim(sl)
@@ -734,43 +903,16 @@ def _aot_exec_fn(fn, shared_args, task_args, chunk, d, free_bytes,
     return exec_fn, chunk
 
 
-_JIT_CACHE = {}
-
-
+#: jit(vmap(kernel)) memo lives in compile_cache; this module-level
+#: alias is the seam tests monkeypatch (batched_map resolves the name
+#: dynamically) and callers pass positional (kernel, static_args,
+#: task_sharding, shared_shardings, cache_key, donate_tasks)
 def _jit_vmapped(kernel, static_args, task_sharding=None,
-                 shared_shardings=None):
-    """jit(vmap(kernel)) with the task axis mapped; cached per kernel+config.
-
-    ``kernel(shared_args, one_task_args, **static)`` → pytree of arrays.
-    ``shared_shardings`` may be a single sharding (replicated) or a
-    pytree mirroring the shared args (row-sharded 'data' leaves).
-    """
-    import jax
-
-    static_args = tuple(sorted((static_args or {}).items()))
-    # NamedSharding hashes by (mesh, spec): distinct meshes/device sets
-    # must never share a compiled fn. Sharding pytrees are flattened to
-    # a hashable key.
-    shared_leaves, shared_def = jax.tree_util.tree_flatten(shared_shardings)
-    key = (kernel, static_args, task_sharding,
-           tuple(shared_leaves), shared_def)
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        static = dict(static_args)
-
-        def mapped(shared, tasks):
-            return jax.vmap(lambda t: kernel(shared, t, **static))(tasks)
-
-        if task_sharding is not None:
-            fn = jax.jit(
-                mapped,
-                in_shardings=(shared_shardings, task_sharding),
-                out_shardings=task_sharding,
-            )
-        else:
-            fn = jax.jit(mapped)
-        _JIT_CACHE[key] = fn
-    return fn
+                 shared_shardings=None, cache_key=None, donate_tasks=False):
+    return compile_cache.jit_vmapped(
+        kernel, static_args, task_sharding, shared_shardings,
+        cache_key=cache_key, donate_tasks=donate_tasks,
+    )
 
 
 def row_sharded_specs(backend, shared, sample_axes):

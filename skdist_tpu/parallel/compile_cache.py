@@ -1,0 +1,532 @@
+"""
+Persistent cross-process compile cache + process-wide kernel caches.
+
+Compilation is the dominant non-compute cost of the fan-out hot path
+(BENCH_r05: quick shapes 3.99 s cold vs 0.42 s warm — ~90% of cold
+wall is XLA compilation; the full 96×5 grid pays ~12 s of it). This
+module concentrates every layer of compile reuse in one place:
+
+1. **In-process memo caches** with *structural* keys. Kernel builders
+   return fresh closures, and ``jax.jit`` keys its own cache on
+   function identity — so a fresh closure per fit silently recompiles
+   an identical program. Callers therefore pass a ``cache_key`` built
+   from the estimator class qualname + static/meta signature (+ any
+   shape constants the closure captures); two closures with the same
+   structural key share one traced/compiled function. Three tiers:
+
+   - kernel memo (``kernel_memo``): built Python closures
+     (``models/linear._KERNEL_CACHE``, ``distribute/search``'s cv
+     kernels) keyed on semantic signature;
+   - jit memo (``jit_vmapped``): ``jit(vmap(kernel))`` per
+     (structural key, static args, shardings);
+   - AOT memo (``aot_executable``): ``fn.lower(...).compile()``
+     executables per (jit entry, shared shape signature, chunk).
+
+2. **On-disk XLA compilation cache** (``enable_disk_cache``): points
+   ``jax_compilation_cache_dir`` at a directory so *repeated service
+   processes* skip XLA compilation entirely — the cold-start killer
+   for short-lived workers. Opt in per backend
+   (``TPUBackend(compile_cache_dir=...)``) or process-wide via the
+   ``SKDIST_COMPILE_CACHE_DIR`` environment variable. Entries key on
+   the serialized HLO + compile flags + jaxlib version, so a cache
+   directory is safe to share between processes and survives code
+   edits that do not change the compiled program.
+
+3. **Counters** (``snapshot()``): hits/misses per tier plus cumulative
+   lowering/compile wall time, so benchmarks and tests can *see* the
+   cold-vs-warm gap instead of inferring it from wall clock.
+
+Thread safety: counters and memo insertion take a module lock; the
+underlying dicts are plain (reads are GIL-atomic, and double-building
+a cache entry is benign — last writer wins, both entries are correct).
+"""
+
+import os
+import threading
+import time
+import warnings
+
+__all__ = [
+    "enable_disk_cache",
+    "disk_cache_dir",
+    "structural_key",
+    "kernel_memo",
+    "jit_vmapped",
+    "aot_executable",
+    "snapshot",
+    "reset_stats",
+    "clear_memos",
+]
+
+#: environment opt-in for the on-disk XLA compilation cache
+CACHE_DIR_ENV = "SKDIST_COMPILE_CACHE_DIR"
+
+_LOCK = threading.RLock()
+
+_STATS = {
+    "kernel_hits": 0,
+    "kernel_misses": 0,
+    "jit_hits": 0,
+    "jit_misses": 0,
+    "aot_hits": 0,
+    "aot_misses": 0,
+    # the on-disk EXPORT layer (serialized AOT programs; skips Python
+    # tracing in warm-disk processes): file served / file written
+    "aot_export_hits": 0,
+    "aot_export_writes": 0,
+    # wall seconds spent building/lowering/compiling on misses (AOT
+    # lower+compile is measured directly; jit tracing happens lazily at
+    # first call, so jit misses record only closure construction)
+    "lower_time_s": 0.0,
+}
+
+#: jit(vmap(kernel)) entries: (structural-or-identity key, static args,
+#: shardings) -> jitted fn
+_JIT_CACHE = {}
+#: AOT executables: (jit fn, shared shape sig, chunk) -> compiled
+_AOT_CACHE = {}
+#: built kernel closures: namespaced semantic key -> closure
+_KERNEL_MEMO = {}
+#: jit fn -> (process-stable key string, donate) for entries built with
+#: a structural cache_key — the export disk layer's filename basis
+_JIT_EXPORT_KEY = {}
+
+_DISK_DIR = None
+_ENV_CHECKED = False
+
+
+# ---------------------------------------------------------------------------
+# on-disk XLA compilation cache
+# ---------------------------------------------------------------------------
+
+def enable_disk_cache(path=None):
+    """Point JAX's persistent compilation cache at ``path`` (or the
+    ``SKDIST_COMPILE_CACHE_DIR`` environment variable when ``path`` is
+    None). Returns the active directory, or None when neither is set.
+
+    Idempotent; the first caller wins for the lifetime of the process
+    (JAX's cache config is global — re-pointing it mid-process would
+    split warm state across directories, so a conflicting second path
+    raises). Thresholds are dropped to cache-everything: a service
+    process's cold start pays for EVERY kernel, not only the slow ones.
+    """
+    global _DISK_DIR
+    with _LOCK:
+        if path is None:
+            path = os.environ.get(CACHE_DIR_ENV) or None
+        if path is None:
+            return _DISK_DIR
+        path = os.path.abspath(path)
+        if _DISK_DIR is not None:
+            if _DISK_DIR != path:
+                raise ValueError(
+                    "the persistent compile cache is already at "
+                    f"{_DISK_DIR!r}; JAX's cache config is process-global "
+                    f"and cannot be re-pointed to {path!r}"
+                )
+            return _DISK_DIR
+        import jax
+
+        # the cache backend skips a directory it cannot open; create it
+        # up front so the very first compile already writes through
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        for knob, value in (
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ):
+            try:
+                jax.config.update(knob, value)
+            except Exception:  # pragma: no cover - older jax w/o the knob
+                pass
+        try:
+            # the export layer will need it; importing now keeps its
+            # ~0.3 s module-exec cost out of the first timed fit
+            from jax import export as _export  # noqa: F401
+        except Exception:  # pragma: no cover - jax without jax.export
+            pass
+        _DISK_DIR = path
+        return _DISK_DIR
+
+
+def maybe_enable_from_env():
+    """Lazily honour ``SKDIST_COMPILE_CACHE_DIR`` once per process —
+    called from the compile paths so a bare env var works without any
+    backend constructor argument (service launchers set env, not code).
+    """
+    global _ENV_CHECKED
+    if _ENV_CHECKED:
+        return _DISK_DIR
+    with _LOCK:
+        if not _ENV_CHECKED:
+            _ENV_CHECKED = True
+            if _DISK_DIR is None and os.environ.get(CACHE_DIR_ENV):
+                enable_disk_cache()
+    return _DISK_DIR
+
+
+def disk_cache_dir():
+    """The active on-disk cache directory, or None."""
+    return _DISK_DIR
+
+
+# ---------------------------------------------------------------------------
+# structural keys + counters
+# ---------------------------------------------------------------------------
+
+_CLS_CODE_TOKENS = None
+
+
+def _cls_code_token(cls):
+    """Digest of a class's kernel-builder bytecode (inherited methods
+    included). Part of every structural key: a module-qualified NAME
+    alone would let an in-process class redefinition (REPL/notebook
+    re-execution with edited kernel math, same qualname) silently
+    serve the old class's compiled kernel. Bytecode is deterministic
+    for identical source under one Python version, so the token stays
+    process-stable for the export disk layer while distinguishing
+    redefinitions. Memoised per class object (weakly — REPL classes
+    must be collectable)."""
+    global _CLS_CODE_TOKENS
+    if _CLS_CODE_TOKENS is None:
+        import weakref
+
+        _CLS_CODE_TOKENS = weakref.WeakKeyDictionary()
+    token = _CLS_CODE_TOKENS.get(cls)
+    if token is None:
+        import hashlib
+
+        h = hashlib.sha256()
+        for name in sorted(dir(cls)):
+            if name.startswith("_build_") and name.endswith("_kernel"):
+                fn = getattr(cls, name, None)
+                code = getattr(getattr(fn, "__func__", fn), "__code__", None)
+                if code is not None:
+                    h.update(name.encode())
+                    h.update(code.co_code)
+                    h.update(repr(code.co_consts).encode())
+        token = h.hexdigest()[:12]
+        _CLS_CODE_TOKENS[cls] = token
+    return token
+
+
+def structural_key(family, est_cls, *parts):
+    """Stable cache key for a kernel closure's *semantics*.
+
+    ``family`` names the fan-out call site ("cv", "ovr", "predict",
+    ...); ``est_cls`` is the estimator class (stored as
+    module-qualified name + kernel-builder bytecode token, so the key
+    survives reload/re-import, is identical across processes, AND
+    distinguishes an in-process redefinition with edited kernel math);
+    ``parts`` must capture EVERYTHING the closure bakes in beyond its
+    argument shapes — static config, meta signature, scorer names,
+    captured shape constants. Two closures with equal structural keys
+    are promised interchangeable.
+    """
+    if isinstance(est_cls, type):
+        est_cls = (f"{est_cls.__module__}.{est_cls.__qualname__}",
+                   _cls_code_token(est_cls))
+    return (family, est_cls) + tuple(parts)
+
+
+def _record(counter, dt=0.0):
+    with _LOCK:
+        _STATS[counter] += 1
+        if dt:
+            _STATS["lower_time_s"] += dt
+
+
+def snapshot():
+    """Current counters (plus the disk cache dir), as a plain dict."""
+    with _LOCK:
+        out = dict(_STATS)
+    out["lower_time_s"] = round(out["lower_time_s"], 4)
+    out["disk_cache_dir"] = _DISK_DIR
+    return out
+
+
+def reset_stats():
+    """Zero the counters (memo contents and disk config are kept)."""
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0.0 if k == "lower_time_s" else 0
+
+
+def clear_memos():
+    """Drop every in-process memo (tests; frees compiled executables)."""
+    with _LOCK:
+        _JIT_CACHE.clear()
+        _AOT_CACHE.clear()
+        _KERNEL_MEMO.clear()
+        _JIT_EXPORT_KEY.clear()
+
+
+# ---------------------------------------------------------------------------
+# tier 1: kernel closures
+# ---------------------------------------------------------------------------
+
+def kernel_memo(key, build):
+    """Return the memoised kernel closure for ``key``, building (and
+    timing) it on first use. ``key`` must be namespaced by the caller
+    (e.g. via :func:`structural_key`)."""
+    fn = _KERNEL_MEMO.get(key)
+    if fn is not None:
+        _record("kernel_hits")
+        return fn
+    t0 = time.perf_counter()
+    fn = build()
+    _record("kernel_misses", time.perf_counter() - t0)
+    with _LOCK:
+        return _KERNEL_MEMO.setdefault(key, fn)
+
+
+# ---------------------------------------------------------------------------
+# tier 2: jit(vmap(kernel))
+# ---------------------------------------------------------------------------
+
+def jit_vmapped(kernel, static_args, task_sharding=None,
+                shared_shardings=None, cache_key=None, donate_tasks=False):
+    """jit(vmap(kernel)) with the task axis mapped; memoised.
+
+    ``kernel(shared_args, one_task_args, **static)`` → pytree of arrays.
+    ``shared_shardings`` may be a single sharding (replicated) or a
+    pytree mirroring the shared args (row-sharded 'data' leaves).
+
+    ``cache_key`` (see :func:`structural_key`) replaces closure
+    identity in the memo key so per-call closures still reuse one
+    traced program; without it the kernel object itself keys the entry
+    (safe default — distinct closures never alias).
+
+    ``donate_tasks=True`` donates the task-slice argument's buffers to
+    the computation: each round's input chunk is freshly placed and
+    never reused, so XLA may overwrite it in place — reclaiming one
+    round's task-argument HBM for outputs/temps.
+    """
+    import jax
+
+    maybe_enable_from_env()
+    static_args = tuple(sorted((static_args or {}).items()))
+    # NamedSharding hashes by (mesh, spec): distinct meshes/device sets
+    # must never share a compiled fn. Sharding pytrees are flattened to
+    # a hashable key.
+    shared_leaves, shared_def = jax.tree_util.tree_flatten(shared_shardings)
+    key = (cache_key or kernel, static_args, task_sharding,
+           tuple(shared_leaves), shared_def, bool(donate_tasks))
+    fn = _JIT_CACHE.get(key)
+    if fn is not None:
+        _record("jit_hits")
+        return fn
+    t0 = time.perf_counter()
+    static = dict(static_args)
+
+    def mapped(shared, tasks):
+        return jax.vmap(lambda t: kernel(shared, t, **static))(tasks)
+
+    jit_kwargs = {"donate_argnums": (1,)} if donate_tasks else {}
+    if task_sharding is not None:
+        fn = jax.jit(
+            mapped,
+            in_shardings=(shared_shardings, task_sharding),
+            out_shardings=task_sharding,
+            **jit_kwargs,
+        )
+    else:
+        fn = jax.jit(mapped, **jit_kwargs)
+    _record("jit_misses", time.perf_counter() - t0)
+    with _LOCK:
+        fn = _JIT_CACHE.setdefault(key, fn)
+        if cache_key is not None and fn not in _JIT_EXPORT_KEY:
+            # a structural key makes the entry PROCESS-STABLE: record
+            # the string form (+ mesh topology) the export disk layer
+            # uses as its filename basis. Identity-keyed entries (no
+            # cache_key) are not stable across processes and never
+            # reach the export layer.
+            _JIT_EXPORT_KEY[fn] = (
+                repr((cache_key, static_args,
+                      _sharding_desc(task_sharding),
+                      tuple(_sharding_desc(s) for s in shared_leaves),
+                      bool(donate_tasks))),
+                bool(donate_tasks),
+            )
+        return fn
+
+
+def _sharding_desc(s):
+    """Process-stable description of a sharding (mesh topology + spec),
+    NOT its object repr (which may embed per-process device ids)."""
+    try:
+        if s is None:
+            return None
+        mesh = s.mesh
+        kinds = (
+            str(mesh.devices.flat[0].device_kind)
+            if mesh.devices.size else ""
+        )
+        return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+                kinds, repr(s.spec))
+    except Exception:
+        return repr(s)
+
+
+# ---------------------------------------------------------------------------
+# tier 3: AOT executables
+# ---------------------------------------------------------------------------
+
+def aot_executable(fn, shared_args, task_like, n_chunk, shared_sig=None):
+    """AOT-compile ``fn`` for a task chunk of ``n_chunk`` (memoised).
+
+    ``fn`` must be an AOT-capable jitted function (``.lower``);
+    ``task_like`` supplies per-task leaf shapes/dtypes (its leading
+    axis is replaced by ``n_chunk``). The memo keys on the jit entry
+    itself — jitted fns are memoised structurally in tier 2, so this
+    composes to the same lifetime jit's own compilation cache would
+    have had, plus explicit counters and the on-disk write-through.
+    The task leaves' TRAILING shapes are part of the key: one jit
+    entry legitimately serves several task widths (jit re-traces by
+    shape; e.g. sparse predict's packed nnz width), and an executable
+    compiled for one width must never be served for another.
+    """
+    import jax
+
+    if shared_sig is None:
+        shared_sig = shape_sig(shared_args)
+    task_sig = tuple(
+        (tuple(l.shape[1:]), str(l.dtype))
+        for l in jax.tree_util.tree_leaves(task_like)
+    )
+    key = (fn, shared_sig, task_sig, n_chunk)
+    comp = _AOT_CACHE.get(key)
+    if comp is not None:
+        _record("aot_hits")
+        return comp
+    t0 = time.perf_counter()
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(
+            (n_chunk,) + tuple(a.shape[1:]), a.dtype
+        ),
+        task_like,
+    )
+    with warnings.catch_warnings():
+        # donated task leaves too small/oddly-shaped to alias an output
+        # (scalar hypers, split ids) are expected and harmless — the
+        # donation exists for the big leaves; don't warn per compile
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        comp = _exported_executable(
+            fn, shared_args, structs, shared_sig, task_sig, n_chunk
+        )
+        if comp is None:
+            comp = fn.lower(shared_args, structs).compile()
+    _record("aot_misses", time.perf_counter() - t0)
+    with _LOCK:
+        return _AOT_CACHE.setdefault(key, comp)
+
+
+_SOURCE_DIGEST = None
+
+
+def _source_digest():
+    """Digest of every .py file in the skdist_tpu package (computed
+    once per process, ~ms). Part of the export filename: structural
+    keys name WHAT a kernel computes, not HOW — a source edit that
+    changes kernel math under an unchanged structural key must
+    invalidate the serialized program, or a warm cache directory would
+    silently serve stale math across a package upgrade. (The XLA tier
+    keys on HLO bytes and self-invalidates; this tier exists to skip
+    producing the HLO, so it needs its own invalidation basis.)
+    Over-invalidates on unrelated edits, which a cache may."""
+    global _SOURCE_DIGEST
+    if _SOURCE_DIGEST is None:
+        import hashlib
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        h = hashlib.sha256()
+        for dirpath, dirnames, filenames in sorted(os.walk(root)):
+            dirnames.sort()
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    path = os.path.join(dirpath, name)
+                    h.update(path[len(root):].encode())
+                    try:
+                        with open(path, "rb") as f:
+                            h.update(f.read())
+                    except OSError:
+                        h.update(b"?")
+        _SOURCE_DIGEST = h.hexdigest()[:16]
+    return _SOURCE_DIGEST
+
+
+def _export_path(keystr, shared_sig, task_sig, n_chunk):
+    import hashlib
+
+    import jax
+
+    payload = repr((keystr, shared_sig, task_sig, n_chunk,
+                    jax.__version__, _source_digest()))
+    h = hashlib.sha256(payload.encode()).hexdigest()[:32]
+    return os.path.join(_DISK_DIR, "aot_exports", h + ".jaxexp")
+
+
+def _exported_executable(fn, shared_args, structs, shared_sig, task_sig,
+                         n_chunk):
+    """The export disk layer: serialized AOT programs next to the XLA
+    disk cache, so a warm-disk process skips PYTHON TRACING as well as
+    XLA compilation — the two costs that dominate service cold-start.
+
+    Active only when (a) the on-disk cache is enabled, (b) the jit
+    entry carries a process-stable structural key, and (c) the run is
+    single-process (exported device assignments don't transplant
+    across multi-process topologies). First process: traces once via
+    ``jax.export``, persists the serialized program, and compiles the
+    EXPORTED form — both processes then execute byte-identical
+    programs, and the exported form's XLA compile is what the disk
+    cache holds, so the warm process's compile is a pure cache read.
+    Any failure (un-exportable program — e.g. some Pallas custom
+    calls — version skew, disk trouble) returns None and the caller
+    falls back to the direct lower+compile path.
+    """
+    ent = _JIT_EXPORT_KEY.get(fn)
+    if _DISK_DIR is None or ent is None:
+        return None
+    keystr, donate = ent
+    try:
+        import jax
+        from jax import export as jexport
+
+        if jax.process_count() > 1:
+            return None
+        path = _export_path(keystr, shared_sig, task_sig, n_chunk)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                exp = jexport.deserialize(bytearray(f.read()))
+            _record("aot_export_hits")
+        else:
+            exp = jexport.export(fn)(shared_args, structs)
+            blob = exp.serialize()
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+            _record("aot_export_writes")
+        jit_kwargs = {"donate_argnums": (1,)} if donate else {}
+        return (
+            jax.jit(exp.call, **jit_kwargs)
+            .lower(shared_args, structs).compile()
+        )
+    except Exception as exc:
+        warnings.warn(
+            f"compile_cache export layer disabled for this program "
+            f"({type(exc).__name__}: {exc}); falling back to direct "
+            "compilation"
+        )
+        return None
+
+
+def shape_sig(tree):
+    import jax
+
+    return tuple(
+        (tuple(l.shape), str(l.dtype)) for l in jax.tree_util.tree_leaves(tree)
+    )

@@ -33,6 +33,16 @@ def initialize_cluster(coordinator_address=None, num_processes=None,
 
     if num_processes in (None, 0, 1):
         return
+    # Multi-process collectives on the CPU backend need an explicit
+    # cross-process transport (jax >= 0.4.34 ships gloo but defaults to
+    # 'none', and the first cross-process device_put then fails with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend"). Harmless on TPU/GPU: the knob only shapes CPU client
+    # construction. Must run before the backend is instantiated.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - jaxlib without the knob/gloo
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

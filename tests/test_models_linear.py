@@ -490,3 +490,15 @@ def test_host_engine_rejects_bad_penalty_like_xla(clf_data):
         est.set_params(penalty="l1")
         with pytest.raises(ValueError, match="penalty"):
             est.fit(X, y)
+
+
+def test_linearsvc_loss_revalidated_after_set_params(binary_data):
+    """set_params bypasses __init__: both engines must reject an
+    unsupported loss loudly instead of silently fitting squared hinge
+    (ADVICE r05 #3; mirrors the penalty/engine re-validation)."""
+    X, y = binary_data
+    for engine in ("host", "xla"):
+        est = LinearSVC(max_iter=20, engine=engine)
+        est.set_params(loss="hinge")
+        with pytest.raises(ValueError, match="squared_hinge"):
+            est.fit(X, y)

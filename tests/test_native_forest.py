@@ -510,3 +510,42 @@ def test_native_oob_aggregation_matches_xla(clf_data):
     np.testing.assert_allclose(
         f.oob_decision_function_, agg_x, atol=1e-5
     )
+
+
+def test_matmul_sib_auto_gated_to_integer_weights(tmp_path, monkeypatch):
+    """A sweep-calibrated matmul_sib may become the 'auto' default ONLY
+    for integer-effective-weight fits: callers declaring
+    fractional_weights=True degrade the calibrated pick to plain matmul
+    (sibling subtraction rounds under fractional weights and can flip
+    near-tie splits — ADVICE r05 #4). Explicit requests are honoured."""
+    import json
+
+    import jax
+
+    from skdist_tpu.models import hist_calib
+    from skdist_tpu.models.tree import resolve_hist_config
+
+    table = {jax.default_backend(): {
+        "mode": "matmul_sib", "hist_block": 8, "max_matmul_db": 16384,
+        "xla_mode": "matmul_sib", "xla_hist_block": 54, "measured": {},
+        "source": "test",
+    }}
+    p = tmp_path / "calib.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv(hist_calib.PATH_ENV, str(p))
+    # integer weights: the calibrated winner is honoured
+    assert resolve_hist_config(54, 32, "auto")[0] == "matmul_sib"
+    assert resolve_hist_config(
+        54, 32, "auto", allow_native=False, fractional_weights=False
+    )[0] == "matmul_sib"
+    # fractional weights: the calibrated 'auto' pick degrades to matmul
+    assert resolve_hist_config(
+        54, 32, "auto", fractional_weights=True
+    )[0] == "matmul"
+    assert resolve_hist_config(
+        54, 32, "auto", allow_native=False, fractional_weights=True
+    )[0] == "matmul"
+    # an EXPLICIT matmul_sib request is always honoured
+    assert resolve_hist_config(
+        54, 32, "matmul_sib", fractional_weights=True
+    )[0] == "matmul_sib"

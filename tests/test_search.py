@@ -560,3 +560,81 @@ def test_warm_c_path_continuous_distribution(clf_data):
         np.asarray(cold.cv_results_["mean_test_score"], dtype=float),
         atol=1e-4,
     )
+
+
+def test_warm_cpath_capped_candidates_recorded_cold(clf_data):
+    """A warm-seeded host-engine fit that stops on max_iter must be
+    REFIT COLD before its CV score is recorded — otherwise the capped
+    candidate's score depends on which other C values share the grid
+    (ADVICE r05 #1).
+
+    The real solver's converge-vs-cap margins are within one L-BFGS-B
+    iteration on toy data (fragile across BLAS/scipy versions), so the
+    cap is made DETERMINISTIC: a LogisticRegression subclass whose
+    warm-seeded fits always report no converged optimum (w_opt=None —
+    exactly what the host engine reports on a max_iter stop) while
+    cold fits behave normally. Every warm attempt must then be
+    followed by a cold refit of the same candidate, and each
+    candidate's recorded scores must equal its solo (grid-independent)
+    run bitwise."""
+    X, y = clf_data
+    fit_log = []
+
+    class CapsWhenWarm(LogisticRegression):
+        def fit(self, X, y=None, sample_weight=None):
+            warm = getattr(self, "_warm_w0", None) is not None
+            fit_log.append((float(self.C), warm))
+            super().fit(X, y, sample_weight=sample_weight)
+            if warm:
+                self._w_opt64 = None  # "stopped on max_iter"
+            return self
+
+    est = CapsWhenWarm(max_iter=50, engine="host")
+    grid_c = [1e-2, 1.0]
+    n_splits = 3
+    full = DistGridSearchCV(
+        est, {"C": grid_c}, cv=n_splits, scoring="accuracy", refit=False,
+    ).fit(X, y)
+    # per fold: head cold; candidate 2 warm (capped) THEN cold refit
+    assert len(fit_log) == n_splits * 3, fit_log
+    per_fold = len(fit_log) // n_splits
+    for f in range(n_splits):
+        chunk = fit_log[f * per_fold:(f + 1) * per_fold]
+        assert chunk == [(1e-2, False), (1.0, True), (1.0, False)], chunk
+    # recorded scores are the COLD ones: bitwise equal to solo runs
+    for c in grid_c:
+        solo = DistGridSearchCV(
+            est, {"C": [c]}, cv=n_splits, scoring="accuracy", refit=False,
+        ).fit(X, y)
+        i = [j for j, p in enumerate(full.cv_results_["params"])
+             if p["C"] == c][0]
+        np.testing.assert_array_equal(
+            np.asarray([full.cv_results_[f"split{s}_test_score"][i]
+                        for s in range(n_splits)]),
+            np.asarray([solo.cv_results_[f"split{s}_test_score"][0]
+                        for s in range(n_splits)]),
+            err_msg=f"C={c} recorded a grid-dependent (warm-capped) score",
+        )
+
+
+def test_engine_grid_routes_to_generic_path(clf_data, monkeypatch):
+    """A searchable 'engine' must be honoured per candidate: such grids
+    route to the generic path (each task clones + set_params + fit, so
+    each fit resolves its own engine) instead of compiling one engine
+    for the whole batched bucket (ADVICE r05 #2)."""
+    from skdist_tpu.distribute import search as search_mod
+    from skdist_tpu.parallel import TPUBackend
+
+    X, y = clf_data
+
+    def boom(*a, **k):
+        raise AssertionError("batched path must not run for engine grids")
+
+    monkeypatch.setattr(search_mod, "_cached_cv_kernel", boom)
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=20),
+        {"C": [0.1, 1.0], "engine": ["host", "xla"]},
+        backend=TPUBackend(), cv=3, scoring="accuracy",
+    ).fit(X, y)
+    assert {p["engine"] for p in gs.cv_results_["params"]} == {"host", "xla"}
+    assert gs.best_score_ > 0.5
