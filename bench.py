@@ -225,6 +225,60 @@ def _cache_delta(before, after):
     return {k: round(after[k] - before[k], 4) for k in keys}
 
 
+def _serving_aux(model, X, n_clients=4, n_requests=40):
+    """Small online-serving measurement on the already-fitted headline
+    model (skdist_tpu.serve): n_clients threads of batch-1..16
+    predict_proba requests through a prewarmed engine. Reports
+    request throughput, latency percentiles, batch fill, and the
+    steady-state compile invariant — the bench-side view of the
+    serving subsystem's health. Best-effort: {} on any failure (the
+    headline must never die for an aux field)."""
+    import threading
+
+    try:
+        from skdist_tpu.parallel import TPUBackend
+        from skdist_tpu.serve import ServingEngine
+
+        engine = ServingEngine(
+            backend=TPUBackend(reuse_broadcast=True),
+            max_batch_rows=128, max_delay_ms=2.0,
+        )
+        engine.register("headline", model, methods=("predict_proba",))
+        errors = []
+
+        def client(seed):
+            r = np.random.RandomState(seed)
+            for _ in range(n_requests):
+                n = int(r.randint(1, 17))
+                i = int(r.randint(0, X.shape[0] - n))
+                try:
+                    engine.predict_proba(X[i:i + n], timeout_s=60)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        st = engine.stats()
+        engine.close()
+        return {
+            "requests_per_s": round(n_clients * n_requests / wall, 1),
+            "clients": n_clients,
+            "p50_ms": st["p50_ms"],
+            "p99_ms": st["p99_ms"],
+            "batch_fill_ratio": st["batch_fill_ratio"],
+            "compiles_after_warmup": st["compiles_after_warmup"],
+            "errors": len(errors),
+        }
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def run_bench(platform, quick=False):
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression
@@ -424,6 +478,7 @@ def run_bench(platform, quick=False):
             "sklearn_serial_fits_per_sec": round(sk_fits_per_sec, 3),
             "compile_cache": cache_aux,
             "overlap": overlap_aux,
+            "serving": _serving_aux(gs.best_estimator_, X),
             "batched_vs_generic_cv_results_max_diff": parity,
             "f32_noise_floor_wellcond": floor_well,
             "illcond_C100_diff": parity_ill,

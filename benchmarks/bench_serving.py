@@ -1,0 +1,158 @@
+"""
+Online serving benchmark: concurrent small-request throughput of
+``skdist_tpu.serve.ServingEngine`` vs per-request ``batch_predict``.
+
+The workload models the traffic-serving north star: N client threads
+each firing batch-1..16 requests (rows drawn from the BASELINE config-5
+recipe — the SAME model and row distribution as the offline 1M-row
+bench, ``benchmarks/run_all.py::config5_recipe``). The baseline leg
+scores each request with its own ``batch_predict`` call — the cost a
+caller pays today without the server: a full dispatch per handful of
+rows. The served leg routes the identical request stream through the
+micro-batcher.
+
+Output: one JSON line with requests/sec for both legs, the speedup
+ratio (acceptance floor: >= 5x), the engine's full stats dict
+(latency percentiles, batch-fill, bucket hits), and
+``compiles_after_warmup`` (must be 0).
+
+Usage:
+    python benchmarks/bench_serving.py [--clients 8] [--requests 125]
+                                       [--scale 0.02] [--baseline-requests N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _request_stream(Xs, n_requests, seed, max_rows=16):
+    """Deterministic per-client stream of (offset, rows) request specs."""
+    r = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_requests):
+        n = int(r.randint(1, max_rows + 1))
+        i = int(r.randint(0, Xs.shape[0] - n))
+        out.append((i, n))
+    return out
+
+
+def run_serving_bench(clients=8, requests_per_client=125, scale=0.02,
+                      baseline_requests=None, max_delay_ms=2.0,
+                      max_batch_rows=256):
+    from run_all import config5_recipe
+
+    from skdist_tpu.distribute.predict import batch_predict
+    from skdist_tpu.parallel import TPUBackend
+    from skdist_tpu.serve import ServingEngine
+
+    model, Xs, _ = config5_recipe(scale)
+    backend = TPUBackend(reuse_broadcast=True)
+    streams = [
+        _request_stream(Xs, requests_per_client, seed=100 + c)
+        for c in range(clients)
+    ]
+
+    # --- baseline: per-request batch_predict, same thread fan-in ------
+    # (bounded request count: each call pays a full dispatch, so the
+    # baseline leg is the slow one — measure fewer and scale)
+    # clamp to the stream length: throughput divides by what actually
+    # ran, never by a requested count the stream cannot supply
+    base_n = min(requests_per_client,
+                 baseline_requests or max(32, requests_per_client // 4))
+    # prime the baseline's compiled shapes so it isn't billed compiles
+    for n in {n for s in streams for _, n in s[:8]}:
+        batch_predict(model, Xs[:n], method="predict_proba",
+                      backend=backend)
+
+    def baseline_client(stream):
+        for i, n in stream[:base_n]:
+            batch_predict(model, Xs[i:i + n], method="predict_proba",
+                          backend=backend)
+
+    threads = [threading.Thread(target=baseline_client, args=(s,))
+               for s in streams]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    base_s = time.perf_counter() - t0
+    base_rps = clients * base_n / base_s
+
+    # --- served leg ---------------------------------------------------
+    engine = ServingEngine(backend=backend, max_batch_rows=max_batch_rows,
+                           max_delay_ms=max_delay_ms,
+                           max_queue_depth=4096)
+    engine.register("config5", model, methods=("predict_proba",))
+
+    errors = []
+
+    def served_client(stream):
+        for i, n in stream:
+            try:
+                engine.predict_proba(Xs[i:i + n], timeout_s=60)
+            except Exception as exc:  # noqa: BLE001 - report, don't wedge
+                errors.append(repr(exc))
+
+    threads = [threading.Thread(target=served_client, args=(s,))
+               for s in streams]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    served_s = time.perf_counter() - t0
+    served_rps = clients * requests_per_client / served_s
+
+    stats = engine.stats()
+    engine.close()
+    return {
+        "bench": "serving: concurrent batch-1..16 predict_proba",
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "scale": scale,
+        "served_requests_per_s": round(served_rps, 1),
+        "baseline_requests_per_s": round(base_rps, 1),
+        "speedup_vs_per_request_batch_predict": round(
+            served_rps / base_rps, 2
+        ),
+        "served_wall_s": round(served_s, 3),
+        "baseline_wall_s": round(base_s, 3),
+        "baseline_requests_measured": clients * base_n,
+        "errors": errors[:5],
+        "n_errors": len(errors),
+        "serving_stats": stats,
+        "platform": __import__("jax").devices()[0].platform,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=125,
+                    help="requests per client on the served leg")
+    ap.add_argument("--baseline-requests", type=int, default=None,
+                    help="requests per client on the baseline leg "
+                         "(default: requests/4, min 32)")
+    ap.add_argument("--scale", type=float, default=0.02)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    args = ap.parse_args()
+    out = run_serving_bench(
+        clients=args.clients, requests_per_client=args.requests,
+        scale=args.scale, baseline_requests=args.baseline_requests,
+        max_delay_ms=args.max_delay_ms,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

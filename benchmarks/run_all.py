@@ -236,16 +236,33 @@ def config_4_forest(scale, ref):
     _emit(out)
 
 
-def config_5_batch_predict(scale, ref):
-    from skdist_tpu.distribute.predict import batch_predict
+def config5_recipe(scale):
+    """The ONE dataset/model recipe for the 1M-row prediction
+    workload, shared by the offline config (below) and the serving
+    bench (``benchmarks/bench_serving.py``) so their numbers describe
+    the same model and row distribution: 10-class LogisticRegression
+    on 64 dense features, uniform-random scoring rows.
+
+    Returns ``(model, Xs, (X, y))`` with ``Xs`` scaled from the
+    faithful 1M and ``(X, y)`` the training split (for sklearn
+    reference refits).
+    """
     from skdist_tpu.models import LogisticRegression
-    from skdist_tpu.parallel import TPUBackend
 
     n_train = 5000
     n_score = max(10_000, int(1_000_000 * scale))
     X, y = make_tabular(n_train, 64, 10, seed=3)
     model = LogisticRegression(max_iter=40).fit(X, y)
     Xs = np.random.RandomState(4).rand(n_score, 64).astype(np.float32)
+    return model, Xs, (X, y)
+
+
+def config_5_batch_predict(scale, ref):
+    from skdist_tpu.distribute.predict import batch_predict
+    from skdist_tpu.parallel import TPUBackend
+
+    model, Xs, (X, y) = config5_recipe(scale)
+    n_score = Xs.shape[0]
 
     def run():
         return batch_predict(

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Smoke cells of the build_tools matrix: the standalone end-to-end
+# gates that run NEXT TO the unit-tier device-count cells (mesh_4.sh /
+# mesh_8.sh / wheel_ci.sh). Each asserts a PR's acceptance criterion in
+# a fresh process on the CPU mesh:
+#
+#   compile_cache_smoke.py  — two fresh processes, one cache dir: the
+#                             second cold wall <= 0.5x of the first
+#                             (persistent compile cache PR).
+#   serving_smoke.py        — 1k mixed-shape requests from 8 threads:
+#                             >= 5x throughput over per-request
+#                             batch_predict, 0 post-warmup compiles, 0
+#                             dropped futures, p99 bounded, bitwise
+#                             parity with batch_predict (serving PR).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python build_tools/serving_smoke.py
+python build_tools/compile_cache_smoke.py

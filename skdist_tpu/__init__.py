@@ -28,6 +28,10 @@ Public surface (mirrors sk-dist's component inventory):
 - ``skdist_tpu.distribute.eliminate``: ``DistFeatureEliminator``
 - ``skdist_tpu.distribute.encoder``: ``Encoderizer``, ``EncoderizerExtractor``
 - ``skdist_tpu.distribute.predict``: batched large-scale inference
+- ``skdist_tpu.serve``: online inference runtime — ``ServingEngine``
+  with dynamic micro-batching, shape buckets, and an AOT-prewarmed
+  ``ModelRegistry`` (concurrent small requests, the traffic-serving
+  counterpart of ``batch_predict``)
 - ``skdist_tpu.models``: JAX/XLA estimator kernels (logistic regression,
   linear SVC, SGD, ridge, decision trees and forests) replacing the
   sklearn Cython / liblinear compute the reference leaned on
@@ -57,6 +61,8 @@ _EXPORTS = {
         "SimpleVoter": "skdist_tpu.postprocessing",
         "LocalBackend": "skdist_tpu.parallel",
         "TPUBackend": "skdist_tpu.parallel",
+        "ServingEngine": "skdist_tpu.serve",
+        "ModelRegistry": "skdist_tpu.serve",
 }
 
 

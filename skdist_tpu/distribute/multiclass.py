@@ -39,7 +39,12 @@ from ..parallel import (
     prefers_host_engine,
     resolve_backend,
 )
-from ..utils.validation import check_estimator_backend, check_is_fitted, safe_split
+from ..utils.validation import (
+    check_estimator_backend,
+    check_is_fitted,
+    full_length_sample_weight,
+    safe_split,
+)
 
 __all__ = ["DistOneVsRestClassifier", "DistOneVsOneClassifier"]
 
@@ -287,8 +292,9 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         n_classes = Y.shape[1]
 
         done = None
-        if not fit_params:
-            done = self._try_batched(backend, X, Y)
+        sw, sw_ok = full_length_sample_weight(fit_params, _n_rows(X))
+        if sw_ok:
+            done = self._try_batched(backend, X, Y, sample_weight=sw)
         if done is None:
             self._fit_generic(backend, X, Y, fit_params)
         self.estimator = clone(self.estimator)
@@ -296,7 +302,7 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         return self
 
     # -- batched device path -------------------------------------------
-    def _try_batched(self, backend, X, Y):
+    def _try_batched(self, backend, X, Y, sample_weight=None):
         est = self.estimator
         if not hasattr(type(est), "_build_fit_kernel"):
             return None
@@ -365,7 +371,13 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         shared = {
             "X": X_dev,
             "Y": jnp.asarray(Y),
-            "sw": jnp.ones(n, jnp.float32),
+            # the per-class kernels already weight by shared["sw"]: a
+            # caller's full-length sample_weight drops straight in (the
+            # keep masks compose with it multiplicatively below)
+            "sw": (
+                jnp.ones(n, jnp.float32) if sample_weight is None
+                else jnp.asarray(sample_weight, jnp.float32)
+            ),
             "hyper": {k: jnp.asarray(v) for k, v in hyper.items()},
             "aux": aux,
         }
@@ -604,15 +616,16 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
         self.pairs_ = [(i, j) for i in range(k) for j in range(i + 1, k)]
 
         done = None
-        if not fit_params:
-            done = self._try_batched(backend, X, y)
+        sw, sw_ok = full_length_sample_weight(fit_params, _n_rows(X))
+        if sw_ok:
+            done = self._try_batched(backend, X, y, sample_weight=sw)
         if done is None:
             self._fit_generic(backend, X, y, fit_params)
         self.estimator = clone(self.estimator)
         strip_runtime(self)
         return self
 
-    def _try_batched(self, backend, X, y):
+    def _try_batched(self, backend, X, y, sample_weight=None):
         est = self.estimator
         if not hasattr(type(est), "_build_fit_kernel"):
             return None
@@ -655,14 +668,22 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
             yi = shared["y"]
             in_pair = (yi == task["i"]) | (yi == task["j"])
             y_bin = (yi == task["j"]).astype(jnp.int32)
-            w = in_pair.astype(jnp.float32)
+            # pair membership composes multiplicatively with the
+            # caller's per-sample weights (ones when absent), mirroring
+            # search.py's fold-mask x sample_weight contract
+            w = in_pair.astype(jnp.float32) * shared["sw"]
             return fit_kernel(
                 shared["X"], y_bin, w, shared["hyper"], shared["aux"]
             )
 
+        n = X_arr.shape[0]
         shared = {
             "X": X_dev,
             "y": jnp.asarray(y_idx),
+            "sw": (
+                jnp.ones(n, jnp.float32) if sample_weight is None
+                else jnp.asarray(sample_weight, jnp.float32)
+            ),
             "hyper": {k_: jnp.asarray(v) for k_, v in hyper.items()},
             "aux": aux,
         }
@@ -677,7 +698,7 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
             kernel, task_args, shared,
             round_size=parse_partitions(self.partitions, len(self.pairs_)),
             shared_specs=row_sharded_specs(
-                backend, shared, {"X": 0, "y": 0}
+                backend, shared, {"X": 0, "y": 0, "sw": 0}
             ),
             cache_key=structural_key(
                 "ovo", type(est), static, _meta_signature(meta)
@@ -694,6 +715,7 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
     def _fit_generic(self, backend, X, y, fit_params):
         est = self.estimator
         y_idx = np.searchsorted(self.classes_, y)
+        n = _n_rows(X)
 
         def run_one(pair):
             i, j = pair
@@ -701,7 +723,23 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
             idx = np.where(cond)[0]
             X_sub, _ = safe_split(est, X, None, idx)
             y_bin = (y_idx[idx] == j).astype(np.int32)
-            return _fit_binary(est, X_sub, y_bin, fit_params, classes=[i, j])
+            fp = fit_params
+            sw = fp.get("sample_weight") if fp else None
+            if sw is not None:
+                sw_arr = np.asarray(sw)
+                if sw_arr.ndim == 2 and sw_arr.shape[1] == 1:
+                    # flatten (n, 1) columns BEFORE slicing, like the
+                    # shared device-path contract — a sliced (k, 1)
+                    # weight would fail sklearn's 1-D validation
+                    sw_arr = sw_arr.ravel()
+                if sw_arr.shape[:1] == (n,):
+                    # full-length per-sample weights follow the pair's
+                    # row subset (the host mirror of the device path's
+                    # membership-mask x sample_weight composition;
+                    # passing them unsliced would length-mismatch the
+                    # sliced X)
+                    fp = dict(fp, sample_weight=sw_arr[idx])
+            return _fit_binary(est, X_sub, y_bin, fp, classes=[i, j])
 
         self.estimators_ = backend.run_tasks(
             run_one, self.pairs_, verbose=self.verbose

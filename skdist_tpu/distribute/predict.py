@@ -23,7 +23,87 @@ import pandas as pd
 
 from ..parallel import resolve_backend
 
-__all__ = ["get_prediction_udf", "batch_predict"]
+__all__ = ["get_prediction_udf", "batch_predict", "device_predict_plan"]
+
+
+class DevicePredictPlan:
+    """The ONE construction of a fitted JAX estimator's block-inference
+    program, shared by every consumer: ``batch_predict``'s offline row
+    blocks, the sparse CSR path, and ``skdist_tpu.serve``'s
+    micro-batcher. Holding the memoised decision/proba kernel, the
+    host-staged parameters, and the structural cache key in one object
+    guarantees online and offline dispatches of matching shapes resolve
+    to the SAME compiled executable (bitwise-identical outputs), and
+    lets long-lived callers (the prediction UDF, the serving registry)
+    stage parameters once instead of per call.
+    """
+
+    __slots__ = ("model", "method", "which", "kernel", "static",
+                 "meta_sig", "cls", "params")
+
+    def block_kernel(self):
+        """``(shared, task) -> {'out': scores}`` over a dense row block
+        — the kernel ``batched_map``/``BatchedPlan`` vmaps on the task
+        axis."""
+        kernel = self.kernel
+
+        def bk(shared, task):
+            return {"out": kernel(shared["params"], task["X"])}
+
+        return bk
+
+    def cache_key(self):
+        from ..parallel import structural_key
+
+        return structural_key(
+            "predict", self.cls, self.which, self.static, self.meta_sig
+        )
+
+    def postprocess(self, out):
+        """Raw kernel scores → the method's user-facing output
+        (classifier label mapping for ``predict``)."""
+        return _postprocess_predict(self.model, out, self.method)
+
+    @property
+    def n_features(self):
+        return self.model._meta["n_features"]
+
+    @property
+    def out_width(self):
+        """Estimated trailing width of the kernel output (for memory
+        capping): class count for classifiers, else 1."""
+        classes = getattr(self.model, "classes_", None)
+        return len(classes) if classes is not None else 1
+
+
+def device_predict_plan(model, method="predict"):
+    """Build the device block-kernel plan for a fitted JAX estimator,
+    or None when the model exposes no device kernels (host models take
+    thread-chunked fallbacks). Parameters are staged host-side ONCE
+    here; backend placement (and the broadcast-reuse cache) happens at
+    dispatch."""
+    if not hasattr(model, "_params") or not hasattr(model, "_meta"):
+        return None
+    import jax
+
+    from ..models.linear import _freeze, _meta_signature, get_kernel
+
+    which = "proba" if method == "predict_proba" else "decision"
+    try:
+        static = _freeze(model._static_config(model._meta))
+        kernel = get_kernel(type(model), which, model._meta, static)
+    except AttributeError:
+        return None
+    plan = DevicePredictPlan()
+    plan.model = model
+    plan.method = method
+    plan.which = which
+    plan.kernel = kernel
+    plan.static = static
+    plan.meta_sig = _meta_signature(model._meta)
+    plan.cls = type(model)
+    plan.params = jax.tree_util.tree_map(np.asarray, model._params)
+    return plan
 
 
 def _get_vals(cols, feature_type, names):
@@ -56,36 +136,96 @@ def get_prediction_udf(model, method="predict", feature_type="numpy",
         raise ValueError("method must be 'predict' or 'predict_proba'")
     if not hasattr(model, method):
         raise ValueError(f"model has no {method} method")
+    return _PredictionUDF(model, method, feature_type, names, backend,
+                          batch_size)
 
-    def predict_func(*cols):
-        X = _get_vals(cols, feature_type, names)
+
+class _PredictionUDF:
+    """The callable ``get_prediction_udf`` returns.
+
+    A class (not a closure) for two contracts that pull apart:
+
+    - **hot path**: the resolved backend and the
+      :func:`device_predict_plan` (staged params, memoised kernel) are
+      built ONCE per process and reused across calls — the UDF is
+      invoked once per partition/flush, and re-resolving per call was
+      pure overhead;
+    - **shippability**: like the reference's pandas UDF, the object
+      must pickle to ride to executors. Live runtime handles cannot
+      (``TaskBackend.__reduce__`` refuses by design), so
+      ``__getstate__`` drops the resolved runtime and the destination
+      process lazily re-resolves on first call. Only the user's raw
+      ``backend`` argument is carried — pass None/'tpu'/'local' (not a
+      live instance) for a picklable UDF, exactly as before.
+    """
+
+    def __init__(self, model, method, feature_type, names, backend,
+                 batch_size):
+        self.model = model
+        self.method = method
+        self.feature_type = feature_type
+        self.names = names
+        self.backend = backend
+        self.batch_size = batch_size
+        self._runtime = None
+
+    def _ensure_runtime(self):
+        # the cached plan snapshots the model's fitted params; a REFIT
+        # replaces model._params with a new object, so key the cache on
+        # that identity — a refit model must never be served through
+        # the pre-refit plan (stale coefficients, possibly stale width)
+        params = getattr(self.model, "_params", None)
+        runtime = self._runtime
+        if runtime is None or runtime[2] is not params:
+            runtime = self._runtime = (
+                resolve_backend(self.backend),
+                device_predict_plan(self.model, self.method),
+                params,
+            )
+        return runtime
+
+    def __call__(self, *cols):
+        backend, plan, _ = self._ensure_runtime()
+        X = _get_vals(cols, self.feature_type, self.names)
         out = batch_predict(
-            model, X, method=method, backend=backend, batch_size=batch_size
+            self.model, X, method=self.method, backend=backend,
+            batch_size=self.batch_size, _plan=plan,
         )
-        if method == "predict_proba":
+        if self.method == "predict_proba":
+            # pinned output contract (the reference's Array(Double) UDF
+            # return type): one list-valued row per input row, columns
+            # in model.classes_ order, float values
             return pd.Series(list(np.asarray(out)))
         return pd.Series(np.asarray(out))
 
-    return predict_func
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_runtime"] = None
+        return state
 
 
 def batch_predict(model, X, method="predict", backend=None,
-                  batch_size=None):
+                  batch_size=None, _plan=None):
     """Predict over X in device-sharded row blocks.
 
     JAX estimators (anything exposing the batched-kernel contract) run
     their decision/proba kernel with row blocks on the mapped axis of
-    the mesh; other models run thread-chunked on host.
+    the mesh; other models run thread-chunked on host. ``_plan`` lets
+    long-lived callers (the prediction UDF, the serving engine) pass a
+    pre-built :func:`device_predict_plan` so params staging is not
+    repeated per call.
     """
     backend = resolve_backend(backend)
     fn = getattr(model, method)
     n = X.shape[0] if hasattr(X, "shape") else len(X)
     if batch_size is None:
         batch_size = max(1, min(n, 1 << 18))
+    if _plan is None:
+        _plan = device_predict_plan(model, method)
 
     if _is_sparse_2d(X):
         device_out = _try_device_predict_sparse(
-            model, X, method, backend, batch_size
+            model, X, method, backend, batch_size, plan=_plan
         )
         if device_out is not None:
             return device_out
@@ -101,12 +241,14 @@ def batch_predict(model, X, method="predict", backend=None,
         X = X.tocsr()  # coo & friends don't support row slicing
         outs = [
             batch_predict(model, X[i:j], method=method, backend=backend,
-                          batch_size=batch_size)
+                          batch_size=batch_size, _plan=_plan)
             for i, j in sparse_groups
         ]
         return np.concatenate(outs, axis=0)
 
-    device_out = _try_device_predict(model, X, method, backend, batch_size)
+    device_out = _try_device_predict(
+        model, X, method, backend, batch_size, plan=_plan
+    )
     if device_out is not None:
         return device_out
 
@@ -151,7 +293,8 @@ def _pack_csr_rows(X):
     return idx, val
 
 
-def _try_device_predict_sparse(model, X, method, backend, batch_size):
+def _try_device_predict_sparse(model, X, method, backend, batch_size,
+                               plan=None):
     """Device CSR path for sparse inference (VERDICT round-2 item 5):
     ship only (idx, val) — 2·nnz·4 bytes, not n·d·4 — and rebuild each
     row block ON DEVICE with one scatter-add, then run the model's
@@ -161,18 +304,14 @@ def _try_device_predict_sparse(model, X, method, backend, batch_size):
     the host paths. Rows with wildly skewed nnz pay padding to the max
     row; hashed-text rows are near-uniform, the target workload.
     """
-    if not hasattr(model, "_params") or not hasattr(model, "_meta"):
+    if plan is None:
+        plan = device_predict_plan(model, method)
+    if plan is None:
         return None
-    from ..models.linear import _freeze, get_kernel
     import jax
     import jax.numpy as jnp
 
-    which = "proba" if method == "predict_proba" else "decision"
-    try:
-        static = _freeze(model._static_config(model._meta))
-        kernel = get_kernel(type(model), which, model._meta, static)
-    except AttributeError:
-        return None
+    kernel = plan.kernel
 
     X = X.tocsr()
     n, d = X.shape
@@ -193,7 +332,7 @@ def _try_device_predict_sparse(model, X, method, backend, batch_size):
             outs = [
                 _try_device_predict_sparse(
                     model, X[i:min(i + rows, n)], method, backend,
-                    batch_size)
+                    batch_size, plan=plan)
                 for i in range(0, n, rows)
             ]
             return np.concatenate(outs, axis=0)
@@ -208,7 +347,6 @@ def _try_device_predict_sparse(model, X, method, backend, batch_size):
     idx = idx.reshape(n_blocks, block, m)
     val = val.reshape(n_blocks, block, m)
 
-    params = jax.tree_util.tree_map(jnp.asarray, model._params)
     rows_iota = np.arange(block)
 
     def block_kernel(shared, task):
@@ -217,21 +355,20 @@ def _try_device_predict_sparse(model, X, method, backend, batch_size):
         ].add(task["val"])
         return {"out": kernel(shared["params"], dense)}
 
-    from ..models.linear import _meta_signature
     from ..parallel import structural_key
 
     out = backend.batched_map(
-        block_kernel, {"idx": idx, "val": val}, {"params": params},
+        block_kernel, {"idx": idx, "val": val}, {"params": plan.params},
         # the closure bakes in the dense block shape (block, d) on top
         # of the memoised decision/proba kernel — all of it in the key,
         # so repeated sparse predicts share one traced program
         cache_key=structural_key(
-            "predict_sparse", type(model), which, static,
-            _meta_signature(model._meta), block, d,
+            "predict_sparse", plan.cls, plan.which, plan.static,
+            plan.meta_sig, block, d,
         ),
     )["out"]
     out = out.reshape(-1, *out.shape[2:])[:n]
-    return _postprocess_predict(model, out, method)
+    return plan.postprocess(out)
 
 
 def _postprocess_predict(model, out, method):
@@ -269,20 +406,13 @@ def _sparse_row_groups(X, n):
     return [(i, min(i + rows, n)) for i in range(0, n, rows)]
 
 
-def _try_device_predict(model, X, method, backend, batch_size):
+def _try_device_predict(model, X, method, backend, batch_size, plan=None):
     """Mesh-sharded inference for JAX estimators; None → host path."""
-    if not hasattr(model, "_params") or not hasattr(model, "_meta"):
+    if plan is None:
+        plan = device_predict_plan(model, method)
+    if plan is None:
         return None
-    from ..models.linear import _freeze, as_dense_f32, get_kernel
-    import jax
-    import jax.numpy as jnp
-
-    which = "proba" if method == "predict_proba" else "decision"
-    try:
-        static = _freeze(model._static_config(model._meta))
-        kernel = get_kernel(type(model), which, model._meta, static)
-    except AttributeError:
-        return None
+    from ..models.linear import as_dense_f32
 
     try:
         X_arr = as_dense_f32(X)
@@ -296,29 +426,9 @@ def _try_device_predict(model, X, method, backend, batch_size):
         X_arr = np.concatenate([X_arr, np.repeat(X_arr[-1:], pad, axis=0)])
     blocks = X_arr.reshape(n_blocks, block, d)
 
-    params = jax.tree_util.tree_map(jnp.asarray, model._params)
-
-    def block_kernel(shared, task):
-        return {"out": kernel(shared["params"], task["X"])}
-
-    from ..models.linear import _meta_signature
-    from ..parallel import structural_key
-
     out = backend.batched_map(
-        block_kernel, {"X": blocks}, {"params": params},
-        cache_key=structural_key(
-            "predict", type(model), which, static,
-            _meta_signature(model._meta),
-        ),
+        plan.block_kernel(), {"X": blocks}, {"params": plan.params},
+        cache_key=plan.cache_key(),
     )["out"]
     out = out.reshape(-1, *out.shape[2:])[:n]
-
-    if method == "predict":
-        if getattr(model, "_estimator_type", None) == "classifier":
-            if out.ndim == 1:
-                idx = (out > 0).astype(np.int64)
-            else:
-                idx = np.argmax(out, axis=1)
-            return model.classes_[idx]
-        return out
-    return out
+    return plan.postprocess(out)

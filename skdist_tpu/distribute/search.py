@@ -55,6 +55,7 @@ from ..utils.validation import (
     check_estimator_backend,
     check_is_fitted,
     check_n_iter,
+    full_length_sample_weight,
     index_fit_params,
     num_samples,
     safe_split,
@@ -408,30 +409,11 @@ class DistBaseSearchCV(BaseEstimator):
         # the batched device path handles the one array-valued fit
         # param with device semantics — full-length sample_weight
         # (fold masks compose with it multiplicatively); anything else
-        # routes to the generic host path
-        sw = fit_params.get("sample_weight")
-        sw_ok = sw is None
-        if sw is not None:
-            try:
-                sw_arr = np.asarray(sw, dtype=np.float64)
-            except (ValueError, TypeError):
-                # ragged / non-numeric weights go to the host path where
-                # the per-task error_score contract handles the failure
-                sw_arr = None
-            if sw_arr is not None:
-                # (n, 1) column weights flatten; anything else non-1-D
-                # (0-d scalars, (n, k) matrices) is not a per-sample
-                # weight vector
-                if sw_arr.ndim == 2 and sw_arr.shape[1] == 1:
-                    sw_arr = sw_arr.ravel()
-                sw_ok = (
-                    sw_arr.ndim == 1 and sw_arr.shape[0] == num_samples(X)
-                )
-                if sw_ok:
-                    sw = sw_arr
-        if (not fit_params or set(fit_params) == {"sample_weight"}) and sw_ok:
-            # wrong-length sample_weight stays on the host path, where
-            # the per-task error_score contract handles the failure
+        # routes to the generic host path, where the per-task
+        # error_score contract handles failures. ONE definition of the
+        # contract, shared with the OvR/OvO batched paths.
+        sw, sw_ok = full_length_sample_weight(fit_params, num_samples(X))
+        if sw_ok:
             batched = self._try_batched(
                 backend, estimator, X, y, candidate_params, splits,
                 sample_weight=sw,

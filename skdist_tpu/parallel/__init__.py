@@ -7,6 +7,7 @@ every reference estimator is built on (reference ``search.py:411-437``,
 
 from . import compile_cache
 from .backend import (
+    BatchedPlan,
     LocalBackend,
     TPUBackend,
     TaskBackend,
@@ -22,6 +23,7 @@ __all__ = [
     "TaskBackend",
     "LocalBackend",
     "TPUBackend",
+    "BatchedPlan",
     "resolve_backend",
     "parse_partitions",
     "prefers_host_engine",

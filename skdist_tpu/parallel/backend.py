@@ -121,6 +121,38 @@ class TaskBackend:
                     pad_to_round=False, cache_key=None):
         raise NotImplementedError
 
+    def prepare_batched(self, kernel, shared_args=(), static_args=None,
+                        shared_specs=None, cache_key=None):
+        raise NotImplementedError
+
+    #: task slots per round on the mapped axis (device count on mesh
+    #: backends); BatchedPlan callers shape their task axis to this
+    n_task_slots = 1
+
+    def _free_device_bytes(self):
+        """Free memory on the execution device, or None where the
+        backend reports no stats (host/CPU backends)."""
+        return None
+
+    def hbm_round_cap(self, bytes_per_task, headroom=0.85):
+        """Largest per-round task count whose in-flight footprint fits
+        free device memory — the same linear estimate ``batched_map``'s
+        proactive round sizing applies after compiling, exposed so
+        callers (the serving registry's shape buckets) can cap shapes
+        BEFORE committing to compile them. ``bytes_per_task`` counts
+        one task's argument + output bytes; the cap budgets
+        ``_MAX_ROUNDS_IN_FLIGHT`` rounds of them inside ``headroom`` of
+        free memory (temps are unknowable without compiling — callers
+        wanting exactness still get the reactive backstop). Returns
+        None when the device reports no memory stats (CPU)."""
+        free = self._free_device_bytes()
+        if free is None or free <= 0 or bytes_per_task <= 0:
+            return None
+        cap = int(free * headroom) // (
+            _MAX_ROUNDS_IN_FLIGHT * int(bytes_per_task)
+        )
+        return max(1, cap)
+
     # fitted estimators must never hold a live backend; give pickle a
     # loud failure instead of a corrupt artifact
     def __reduce__(self):
@@ -162,6 +194,20 @@ class LocalBackend(TaskBackend):
             return [fn(t) for t in tasks]
         with ThreadPoolExecutor(max_workers=n_jobs) as pool:
             return list(pool.map(fn, tasks))
+
+    def prepare_batched(self, kernel, shared_args=(), static_args=None,
+                        shared_specs=None, cache_key=None):
+        """Build a :class:`BatchedPlan` for repeated single-round
+        dispatches: the jit entry is memoised once and shared args are
+        staged on the default device up front, so per-call work is
+        placement of the task slice + execution — the serving hot path.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        fn = _jit_vmapped(kernel, static_args, None, None, cache_key, False)
+        shared_args = jax.tree_util.tree_map(jnp.asarray, shared_args)
+        return BatchedPlan(fn, shared_args, lambda t: t, n_task_slots=1)
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
                     round_size=None, shared_specs=None, return_timings=False,
@@ -294,6 +340,66 @@ class TPUBackend(TaskBackend):
         """Task-axis extent: the number of task slots per round."""
         return self.mesh.shape[self.axis_name]
 
+    @property
+    def n_task_slots(self):
+        return self.n_devices
+
+    def prepare_batched(self, kernel, shared_args=(), static_args=None,
+                        shared_specs=None, cache_key=None):
+        """Resolve shardings, place shared args (through the opt-in
+        broadcast-reuse cache), and build the memoised jit entry ONCE,
+        returning a :class:`BatchedPlan` for repeated low-latency
+        single-round dispatches. ``batched_map`` itself runs through
+        this, so a plan's compiled programs are the same entries the
+        offline path uses — a serving flush and a ``batch_predict``
+        block of matching shape execute one executable.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        task_sharding = NamedSharding(self.mesh, P(self.axis_name))
+        rep_sharding = NamedSharding(self.mesh, P())
+        if shared_specs is not None and self.data_axis_size > 1:
+            # spec tree mirrors shared_args; None leaves mean replicated
+            shared_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(
+                    self.mesh, spec if isinstance(spec, P) else P()
+                ),
+                shared_specs,
+                is_leaf=lambda x: x is None or isinstance(x, P),
+            )
+        else:
+            shared_shardings = rep_sharding
+        if isinstance(shared_shardings, NamedSharding):
+            # single sharding for the whole tree: leaf-wise put through
+            # the reuse cache (sharding-spec trees skip the cache — the
+            # 2D row-sharded case re-puts every fit)
+            shared_args = jax.tree_util.tree_map(
+                lambda a: _cached_device_put(
+                    a, shared_shardings, self.reuse_broadcast
+                ),
+                shared_args,
+            )
+        else:
+            # shardings form a PREFIX tree of shared_args (one sharding
+            # per top-level entry; entries may be sub-trees)
+            shared_args = jax.tree_util.tree_map(
+                lambda sh, sub: jax.tree_util.tree_map(
+                    lambda a: _put_mesh_scoped(a, sh), sub
+                ),
+                shared_shardings, shared_args,
+                is_leaf=lambda x: isinstance(x, NamedSharding),
+            )
+        fn = _jit_vmapped(
+            kernel, static_args, task_sharding, shared_shardings,
+            cache_key, self.donate_tasks,
+        )
+        put = lambda t: jax.tree_util.tree_map(
+            lambda a: _put_mesh_scoped(a, task_sharding), t
+        )
+        return BatchedPlan(fn, shared_args, put,
+                           n_task_slots=self.n_devices)
+
     def _mesh_min_int(self, value):
         """Minimum of a per-process host integer across THIS mesh's
         processes, as a device computation on the mesh: each process
@@ -375,7 +481,6 @@ class TPUBackend(TaskBackend):
         Returns host numpy, leading axis n_tasks.
         """
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         n_tasks = _leading_dim(task_args)
         d = self.n_devices
@@ -383,46 +488,10 @@ class TPUBackend(TaskBackend):
         chunk = round_size if pad_to_round else min(n_tasks, round_size)
         chunk = int(math.ceil(chunk / d) * d)
 
-        task_sharding = NamedSharding(self.mesh, P(self.axis_name))
-        rep_sharding = NamedSharding(self.mesh, P())
-        if shared_specs is not None and self.data_axis_size > 1:
-            # spec tree mirrors shared_args; None leaves mean replicated
-            shared_shardings = jax.tree_util.tree_map(
-                lambda spec: NamedSharding(
-                    self.mesh, spec if isinstance(spec, P) else P()
-                ),
-                shared_specs,
-                is_leaf=lambda x: x is None or isinstance(x, P),
-            )
-        else:
-            shared_shardings = rep_sharding
-        if isinstance(shared_shardings, NamedSharding):
-            # single sharding for the whole tree: leaf-wise put through
-            # the reuse cache (sharding-spec trees skip the cache — the
-            # 2D row-sharded case re-puts every fit)
-            shared_args = jax.tree_util.tree_map(
-                lambda a: _cached_device_put(
-                    a, shared_shardings, self.reuse_broadcast
-                ),
-                shared_args,
-            )
-        else:
-            # shardings form a PREFIX tree of shared_args (one sharding
-            # per top-level entry; entries may be sub-trees)
-            shared_args = jax.tree_util.tree_map(
-                lambda sh, sub: jax.tree_util.tree_map(
-                    lambda a: _put_mesh_scoped(a, sh), sub
-                ),
-                shared_shardings, shared_args,
-                is_leaf=lambda x: isinstance(x, NamedSharding),
-            )
-        fn = _jit_vmapped(
-            kernel, static_args, task_sharding, shared_shardings,
-            cache_key, self.donate_tasks,
+        plan = self.prepare_batched(
+            kernel, shared_args, static_args, shared_specs, cache_key
         )
-        put = lambda t: jax.tree_util.tree_map(
-            lambda a: _put_mesh_scoped(a, task_sharding), t
-        )
+        fn, shared_args, put = plan.fn, plan.shared, plan.put
         # Proactive round sizing (NOTES gap 5 closed): where the device
         # reports memory stats, AOT-compile the round program and shrink
         # the first round to fit BEFORE dispatch — a device OOM costs a
@@ -501,6 +570,67 @@ class TPUBackend(TaskBackend):
                 )
         out = _concat_rounds(rounds_out)
         return (out, timings) if return_timings else out
+
+
+class BatchedPlan:
+    """A pre-resolved batched dispatch: shardings computed, shared args
+    device-resident, jit entry memoised (``TaskBackend.prepare_batched``).
+
+    ``batched_map`` builds one per call and runs its round loop over
+    it; long-lived callers (the serving engine) hold a plan across many
+    calls so the per-dispatch cost is task placement + execution only —
+    no shared-data re-placement, no sharding resolution, no round
+    scheduling. ``run`` executes a SINGLE round whose task axis length
+    is whatever the slice carries (callers shape it to
+    ``n_task_slots``); ``prewarm`` AOT-compiles — and, with the disk
+    cache enabled, serializes — an explicit task shape with no data, so
+    the first live call of that shape never compiles.
+    """
+
+    __slots__ = ("fn", "shared", "put", "n_task_slots", "_shared_sig")
+
+    def __init__(self, fn, shared, put, n_task_slots=1):
+        self.fn = fn
+        self.shared = shared
+        self.put = put
+        self.n_task_slots = n_task_slots
+        self._shared_sig = compile_cache.shape_sig(shared)
+
+    def run(self, task_args):
+        """One round: place the task slice, execute the AOT executable
+        for its chunk size (a memo hit after prewarm), gather to host
+        numpy. The task leading axis must be a multiple of
+        ``n_task_slots`` (it shards over the mesh's task axis)."""
+        return self.gather(self.run_async(task_args))
+
+    def run_async(self, task_args):
+        """Launch one round WITHOUT blocking on results: returns the
+        device output tree with an async D2H copy already enqueued
+        behind the compute (the same overlap trick as the pipelined
+        round loop). Pair with :meth:`gather`; callers overlapping
+        launches must bound their in-flight depth themselves."""
+        sl = self.put(task_args)
+        comp = compile_cache.aot_executable(
+            self.fn, self.shared, sl, _leading_dim(sl),
+            shared_sig=self._shared_sig,
+        )
+        dev_out = comp(self.shared, sl)
+        _start_host_copy(dev_out)
+        return dev_out
+
+    def gather(self, dev_out):
+        """Block on a :meth:`run_async` launch: device tree → host
+        numpy (multi-process-safe, same leg as the round loop)."""
+        return _gather_host(dev_out)
+
+    def prewarm(self, task_like, n_chunk=None):
+        """Compile (and disk-export) the program for an explicit task
+        shape — pytree of arrays or ``jax.ShapeDtypeStruct``s — without
+        dispatching any data. See ``compile_cache.prewarm``."""
+        return compile_cache.prewarm(
+            self.fn, self.shared, task_like, n_chunk=n_chunk,
+            shared_sig=self._shared_sig,
+        )
 
 
 # Device-broadcast reuse cache (opt-in via TPUBackend(reuse_broadcast=

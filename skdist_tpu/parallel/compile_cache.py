@@ -53,6 +53,7 @@ __all__ = [
     "kernel_memo",
     "jit_vmapped",
     "aot_executable",
+    "prewarm",
     "snapshot",
     "reset_stats",
     "clear_memos",
@@ -421,6 +422,31 @@ def aot_executable(fn, shared_args, task_like, n_chunk, shared_sig=None):
     _record("aot_misses", time.perf_counter() - t0)
     with _LOCK:
         return _AOT_CACHE.setdefault(key, comp)
+
+
+def prewarm(fn, shared_args, task_like, n_chunk=None, shared_sig=None):
+    """AOT-prewarm ``fn`` for an explicit task shape, with NO task data.
+
+    The public entry point for shape-driven warmup (the serving
+    registry's bucket prewarm): ``task_like`` is a pytree whose leaves
+    are arrays OR ``jax.ShapeDtypeStruct``s — only ``.shape``/``.dtype``
+    are read — and whose leading axis is the chunk (overridable via
+    ``n_chunk``). Compilation goes through the same memo + disk layers
+    as live dispatch (:func:`aot_executable`), so a later real call of
+    the same shape is a pure in-process cache hit, and a warm-disk
+    process skips tracing and XLA compilation entirely. Returns the
+    compiled executable.
+    """
+    import jax
+
+    if n_chunk is None:
+        leaves = jax.tree_util.tree_leaves(task_like)
+        if not leaves:
+            raise ValueError("prewarm needs at least one task leaf")
+        n_chunk = int(leaves[0].shape[0])
+    return aot_executable(
+        fn, shared_args, task_like, n_chunk, shared_sig=shared_sig
+    )
 
 
 _SOURCE_DIGEST = None

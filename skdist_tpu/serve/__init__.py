@@ -1,0 +1,61 @@
+"""
+skdist_tpu.serve: online inference runtime.
+
+The reference's deployment story ended at a pyarrow-vectorised pandas
+UDF scoring Spark DataFrame partitions (reference
+``skdist/distribute/predict.py:74-179``) — batch in, batch out. This
+package is the other half a traffic-serving system needs: CONCURRENT
+SMALL REQUESTS, served by dynamic micro-batching (Clipper, NSDI'17)
+over the same compiled block-inference programs the offline
+``distribute.batch_predict`` path runs.
+
+- :class:`ServingEngine` — submit/predict facade, multi-model routing
+  (``name@version``), bounded-queue admission control with typed
+  :class:`Overloaded` / :class:`DeadlineExceeded` rejections, graceful
+  drain.
+- :class:`ModelRegistry` — validated, versioned model store; stages
+  parameters on device once and AOT-prewarms every shape-bucket
+  program via ``parallel.compile_cache`` so the first real request
+  never compiles.
+- :class:`MicroBatcher` / :func:`shape_buckets` — the dynamic batching
+  core: flush on size or deadline, pad to power-of-two row buckets
+  (floored at the mesh task-slot count, capped by the backend's HBM
+  round estimate).
+- :class:`ServingStats` — rolling latency percentiles, queue depth,
+  batch-fill ratio, bucket-hit histogram, compiles-after-warmup.
+
+Quickstart::
+
+    from skdist_tpu.serve import ServingEngine
+
+    engine = ServingEngine(backend="tpu", max_delay_ms=2.0)
+    engine.register("clicks", fitted_model, methods=("predict",
+                                                     "predict_proba"))
+    fut = engine.submit(x_rows)            # -> concurrent.futures.Future
+    proba = engine.predict_proba(x_rows)   # sync
+    print(engine.stats())
+    engine.close()                         # graceful drain
+"""
+
+from .batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    Overloaded,
+    ServingError,
+    shape_buckets,
+)
+from .engine import ServingEngine
+from .registry import ModelEntry, ModelRegistry
+from .stats import ServingStats
+
+__all__ = [
+    "ServingEngine",
+    "ModelRegistry",
+    "ModelEntry",
+    "MicroBatcher",
+    "ServingStats",
+    "ServingError",
+    "Overloaded",
+    "DeadlineExceeded",
+    "shape_buckets",
+]

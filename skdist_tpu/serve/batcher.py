@@ -1,0 +1,350 @@
+"""
+Dynamic micro-batching: many concurrent small requests → few fixed-shape
+device dispatches.
+
+The shape problem is the whole design: XLA compiles one program per
+input shape, so letting each request's row count reach the device would
+compile an unbounded program family (the "recompile storm"). Instead a
+flush is padded to a fixed set of **shape buckets** — powers-of-two row
+counts, floored at the backend's task-slot count (a bucket shards
+``bucket/n_slots`` rows per device) and capped by the HBM round-size
+estimate — so the compiled-program set is small, enumerable, and
+prewarmable by the registry before traffic arrives.
+
+The batching policy is Clipper-style adaptive micro-batching
+(Crankshaw et al., NSDI'17): a thread-safe FIFO queue feeds one
+dispatch loop per registered model, which flushes when either the
+accumulated rows reach the largest bucket or the OLDEST request has
+waited ``max_delay_s`` — bounded latency under light load, full
+batches under heavy load. Results scatter back to per-request
+futures; a request past its deadline at flush time is rejected with
+:class:`DeadlineExceeded` instead of being dispatched late.
+
+Flushes are PIPELINED, mirroring the backend's round scheduler: a
+device dispatch returns a *finalize* callable instead of blocking, the
+dispatch loop immediately starts collecting the next flush, and a
+scatter thread drains finalizes FIFO (gather → postprocess → per-
+request futures) with in-flight depth bounded at 2 — the device
+computes flush k+1 while flush k's results cross to host, instead of
+the loop serialising launch+gather per flush.
+"""
+
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+__all__ = [
+    "ServingError",
+    "Overloaded",
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "shape_buckets",
+]
+
+
+class ServingError(RuntimeError):
+    """Base class for typed serving rejections."""
+
+
+class Overloaded(ServingError):
+    """Admission control rejected the request: the queue is at its
+    bounded depth. Callers should back off / shed load — the bound
+    exists so latency stays bounded instead of growing without limit."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before its result was produced."""
+
+
+def shape_buckets(max_rows, min_rows=1):
+    """Doubling ladder of ``min_rows`` MULTIPLES up to ``max_rows`` —
+    the one bucket-policy definition (the registry's default ladder
+    calls this with ``min_rows`` = the mesh task-slot count).
+
+    Every bucket must divide evenly by ``min_rows`` (a flush reshapes
+    to ``(n_slots, bucket/n_slots, d)``, which plain powers of two
+    would break on non-power-of-two meshes), so the ladder is
+    ``min_rows * (1, 2, 4, ...)`` plus ``max_rows`` rounded DOWN to a
+    multiple — the cap is always included so every admissible request
+    fits the largest bucket. ``min_rows=1`` gives plain powers of two.
+    """
+    min_rows = max(1, int(min_rows))
+    max_rows = int(max_rows) // min_rows * min_rows
+    if max_rows < min_rows:
+        raise ValueError(
+            f"max_rows={max_rows} is below the bucket floor {min_rows} "
+            "(the backend's task-slot count)"
+        )
+    buckets, b = [], min_rows
+    while b < max_rows:
+        buckets.append(b)
+        b <<= 1
+    buckets.append(max_rows)
+    return sorted(set(buckets))
+
+
+class _Request:
+    """One queued inference request."""
+
+    __slots__ = ("X", "n", "future", "deadline", "enq_t")
+
+    def __init__(self, X, n, future, deadline=None, enq_t=None):
+        self.X = X
+        self.n = n
+        self.future = future
+        self.deadline = deadline
+        self.enq_t = time.monotonic() if enq_t is None else enq_t
+
+
+def _complete(future, result=None, exc=None):
+    """Resolve a request future, tolerating callers that already
+    cancelled it (``fut.cancel()`` is public API on what ``submit``
+    returns — an InvalidStateError here must never kill the dispatch
+    or scatter thread, which would strand every later request)."""
+    try:
+        if exc is not None:
+            future.set_exception(exc)
+        else:
+            future.set_result(result)
+    except Exception:
+        pass
+
+
+class MicroBatcher:
+    """Request queue + dispatch loop for ONE registered model method.
+
+    ``dispatch(X_padded)`` runs the model on a flush (rows stacked
+    FIFO, padded to the chosen bucket when ``pad``) and returns either
+    the outputs directly (host models — synchronous) or a zero-arg
+    *finalize* callable producing them (device models — the launch is
+    async and finalize blocks on the gather, which the scatter thread
+    does while the loop assembles the next flush). Outputs' leading
+    axis must match the input's; per-request slices scatter back to
+    futures. ``pad=False`` (host-fallback models, including text
+    pipelines with no fixed width) dispatches the exact concatenated
+    rows — cross-request batching without shape bucketing, since host
+    models don't compile per shape.
+    """
+
+    #: bound on launched-but-unscattered flushes — same rationale as
+    #: the round loop's _MAX_ROUNDS_IN_FLIGHT (device memory for two
+    #: flushes' args+outputs, launch/gather overlap with no pile-up)
+    MAX_IN_FLIGHT = 2
+
+    def __init__(self, dispatch, buckets, max_delay_s=0.002, stats=None,
+                 pad=True, name=""):
+        self._dispatch = dispatch
+        self.buckets = sorted({int(b) for b in buckets})
+        self.max_rows = self.buckets[-1]
+        self.max_delay_s = float(max_delay_s)
+        self._pad = bool(pad)
+        self.stats = stats
+        self.name = name
+        self._cond = threading.Condition(threading.Lock())
+        self._queue = deque()
+        self._queued_rows = 0
+        self._stop = False
+        # in-flight accounting: a SLOT is held from device launch until
+        # the gather completes (scatter thread), so launched-but-
+        # ungathered flushes are bounded at exactly MAX_IN_FLIGHT — the
+        # budget hbm_round_cap sizes buckets against. (Bounding the
+        # queue alone would under-count: the flush being gathered and
+        # the one blocked on put() both hold device memory too.)
+        self._inflight = queue_mod.Queue()
+        self._slots = threading.BoundedSemaphore(self.MAX_IN_FLIGHT)
+        suffix = ('-' + name) if name else ''
+        self._scatter_thread = threading.Thread(
+            target=self._scatter_loop, daemon=True,
+            name=f"skdist-serve-scatter{suffix}",
+        )
+        self._scatter_thread.start()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"skdist-serve{suffix}",
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def qsize(self):
+        with self._cond:
+            return len(self._queue)
+
+    def bucket_for(self, rows):
+        for b in self.buckets:
+            if b >= rows:
+                return b
+        raise ValueError(f"{rows} rows exceed the largest bucket "
+                         f"({self.max_rows})")
+
+    def submit(self, request):
+        """Enqueue; wakes the dispatch loop. The caller (engine) owns
+        admission control and size validation."""
+        with self._cond:
+            if self._stop:
+                raise ServingError("batcher is shut down")
+            self._queue.append(request)
+            self._queued_rows += request.n
+            if self.stats is not None:
+                self.stats.set_queue_depth(len(self._queue), key=self.name)
+            self._cond.notify()
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop the loops. ``drain=True`` flushes everything still
+        queued first; ``drain=False`` fails queued futures. In-flight
+        dispatches complete either way."""
+        with self._cond:
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    _complete(req.future, exc=ServingError(
+                        "engine shut down before dispatch"))
+                self._queued_rows = 0
+            self._stop = True
+            self._cond.notify_all()
+        # the dispatch loop enqueues the scatter sentinel itself when
+        # it exits (guaranteed AFTER its last flush — close() doing it
+        # here could slot the sentinel ahead of still-launching flushes
+        # when the join times out, stranding their futures forever)
+        self._thread.join(timeout)
+        self._scatter_thread.join(timeout)
+        if self.stats is not None:
+            # zero this batcher's gauge: drain=False empties the queue
+            # without a set_queue_depth, and a stale positive gauge
+            # would count against the engine's admission bound forever
+            self.stats.set_queue_depth(0, key=self.name)
+
+    # ------------------------------------------------------------------
+    # dispatch loop
+    # ------------------------------------------------------------------
+    def _loop(self):
+        try:
+            while True:
+                batch, rows = self._collect()
+                if batch is None:
+                    return
+                if batch:
+                    self._flush(batch, rows)
+        finally:
+            # sentinel strictly after the loop's final flush, whether
+            # it exited via shutdown or died unexpectedly
+            self._inflight.put(None)
+
+    def _collect(self):
+        """Block until a flush is due (rows >= largest bucket, oldest
+        request aged out, or shutdown), then pop the FIFO prefix that
+        fits the largest bucket. Returns (None, 0) when stopped with an
+        empty queue."""
+        with self._cond:
+            while not self._queue:
+                if self._stop:
+                    return None, 0
+                self._cond.wait(0.1)
+            deadline = self._queue[0].enq_t + self.max_delay_s
+            while self._queued_rows < self.max_rows and not self._stop:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch, rows = [], 0
+            while self._queue and rows + self._queue[0].n <= self.max_rows:
+                req = self._queue.popleft()
+                self._queued_rows -= req.n
+                batch.append(req)
+                rows += req.n
+            if not batch and self._queue:
+                # an unfittable head request (n > max_rows — the engine
+                # rejects these at submit; this is the backstop) must be
+                # failed and popped, or the loop would hot-spin on it
+                # and head-of-line-block everything behind it forever
+                req = self._queue.popleft()
+                self._queued_rows -= req.n
+                _complete(req.future, exc=ServingError(
+                    f"request of {req.n} rows can never fit the largest "
+                    f"bucket ({self.max_rows})"
+                ))
+            if self.stats is not None:
+                self.stats.set_queue_depth(len(self._queue), key=self.name)
+            return batch, rows
+
+    def _flush(self, batch, rows):
+        now = time.monotonic()
+        live, live_rows = [], 0
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                # reject late work instead of dispatching it: the
+                # caller has already given up, and device time spent on
+                # it would push LIVE requests past their deadlines too
+                _complete(req.future, exc=DeadlineExceeded(
+                    f"request waited {now - req.enq_t:.3f}s, deadline "
+                    f"was {req.deadline - req.enq_t:.3f}s after enqueue"
+                ))
+                if self.stats is not None:
+                    self.stats.record_rejection("deadline")
+            else:
+                live.append(req)
+                live_rows += req.n
+        if not live:
+            return
+        X = (live[0].X if len(live) == 1
+             else np.concatenate([r.X for r in live], axis=0))
+        if self._pad:
+            bucket = self.bucket_for(live_rows)
+            if bucket > live_rows:
+                pad_block = np.zeros(
+                    (bucket - live_rows,) + X.shape[1:], X.dtype
+                )
+                X = np.concatenate([X, pad_block], axis=0)
+        else:
+            bucket = live_rows
+        # take an in-flight slot BEFORE launching: blocks here (not
+        # after launch) when MAX_IN_FLIGHT flushes are already on
+        # device, so the launch itself never exceeds the budget
+        self._slots.acquire()
+        try:
+            out = self._dispatch(X)
+        except Exception as exc:  # scatter the failure; loop survives
+            self._slots.release()
+            self._fail(live, exc)
+            return
+        if callable(out):
+            # async launch: hand the finalize (and the slot) to the
+            # scatter thread and go collect the next flush while the
+            # device computes this one
+            self._inflight.put((out, live, live_rows, bucket))
+        else:
+            self._slots.release()
+            self._scatter(out, live, live_rows, bucket)
+
+    def _scatter_loop(self):
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            finalize, live, live_rows, bucket = item
+            try:
+                out = finalize()
+            except Exception as exc:
+                self._fail(live, exc)
+                continue
+            finally:
+                # gather done (or failed): this flush's device buffers
+                # are reclaimable — free its in-flight slot
+                self._slots.release()
+            self._scatter(out, live, live_rows, bucket)
+
+    def _fail(self, live, exc):
+        for req in live:
+            _complete(req.future, exc=exc)
+        if self.stats is not None:
+            self.stats.record_rejection("error")
+
+    def _scatter(self, out, live, live_rows, bucket):
+        if self.stats is not None:
+            self.stats.record_flush(live_rows, bucket)
+        off = 0
+        for req in live:
+            _complete(req.future, result=out[off:off + req.n])
+            off += req.n

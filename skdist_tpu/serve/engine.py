@@ -1,0 +1,294 @@
+"""
+ServingEngine: the online-inference facade over registry + batcher.
+
+The offline half of the prediction story (``distribute.predict``) is
+"one caller, millions of rows"; this is the inverse — many concurrent
+callers, a handful of rows each — and the contracts differ accordingly:
+
+- ``submit(X) -> Future`` / ``predict(X)``: admission-checked enqueue
+  into the target model's micro-batcher; the future resolves when a
+  flush carries the rows through the (prewarmed) device program.
+- **multi-model routing**: requests name ``"model"`` or
+  ``"model@version"``; a single-model engine routes by default.
+- **admission control**: a bounded total queue depth. At the bound,
+  ``submit`` raises :class:`Overloaded` IMMEDIATELY — the typed,
+  bounded-latency alternative to queueing without limit. Per-request
+  deadlines reject late work with :class:`DeadlineExceeded` both at
+  flush time (batcher) and in the sync ``predict`` wait.
+- **graceful drain**: ``close()`` stops admissions, flushes everything
+  queued, and joins the dispatch threads; ``close(drain=False)`` fails
+  queued futures instead. The engine is a context manager.
+
+Requests larger than the largest shape bucket are rejected at submit
+with a pointer at ``batch_predict`` — bulk scoring is the offline
+path's job; letting one giant request ride the micro-batcher would
+stall every small request behind it.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
+
+import numpy as np
+
+from .batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    Overloaded,
+    ServingError,
+    _Request,
+)
+from .registry import ModelRegistry
+from .stats import ServingStats
+
+__all__ = ["ServingEngine"]
+
+#: per-request row bound on the HOST-fallback path — host models don't
+#: bucket (no per-shape compiles), but an unbounded request would still
+#: monopolise the dispatch thread; anything bigger belongs on
+#: distribute.batch_predict. Deliberately its own constant: it has
+#: nothing to do with the admission-control queue depth.
+_HOST_MAX_ROWS = 1 << 16
+
+
+class ServingEngine:
+    """Online inference runtime (see module docstring).
+
+    Parameters mirror the subsystem's knobs: ``max_delay_ms`` is the
+    batching window (oldest-request age that forces a flush),
+    ``max_queue_depth`` the admission bound across all batchers,
+    ``default_timeout_s`` the per-request deadline when the caller
+    sets none (None = no deadline). ``registry`` may be shared between
+    engines; by default each engine owns one on ``backend``.
+    """
+
+    def __init__(self, backend=None, registry=None, max_batch_rows=None,
+                 buckets=None, max_delay_ms=2.0, max_queue_depth=1024,
+                 default_timeout_s=None):
+        self.registry = registry if registry is not None else ModelRegistry(
+            backend=backend, max_batch_rows=max_batch_rows,
+            buckets=buckets,
+        )
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.max_queue_depth = int(max_queue_depth)
+        self.default_timeout_s = default_timeout_s
+        self._stats = ServingStats()
+        self._batchers = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def register(self, name, model, methods=("predict",), version=None,
+                 prewarm=True):
+        """Register (and prewarm) a fitted model; returns its entry.
+        The warm mark moves AFTER each registration's prewarm, so
+        ``compiles_after_warmup`` always measures from the last model
+        onboarded."""
+        entry = self.registry.register(
+            name, model, methods=methods, version=version, prewarm=prewarm
+        )
+        if prewarm:
+            self._stats.mark_warm()
+        return entry
+
+    def unregister(self, name, version=None, drain=True, timeout=30.0):
+        """Unload a model version (all versions with ``version=None``):
+        closes (draining by default) and discards its batchers, then
+        drops the registry entries — releasing the staged device
+        parameters. The unload half of the rollout loop; without it
+        every historical version's params and batcher threads live for
+        the engine's lifetime."""
+        removed = self.registry.unregister(name, version=version)
+        gone = {(e.name, e.version) for e in removed}
+        with self._lock:
+            keys = [k for k in self._batchers if (k[0], k[1]) in gone]
+            batchers = [self._batchers.pop(k) for k in keys]
+        for b in batchers:
+            b.close(drain=drain, timeout=timeout)
+        return removed
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+    def submit(self, X, model=None, method="predict", timeout_s=None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the method's output for X's rows. Raises
+        :class:`Overloaded` at the admission bound and ``ValueError``
+        for malformed/oversized requests."""
+        if self._closed:
+            raise ServingError("engine is closed")
+        entry = (self.registry.default_entry() if model is None
+                 else self.registry.get(model))
+        if method not in entry.methods:
+            raise ValueError(
+                f"{entry.spec} was registered without {method!r} "
+                f"(has: {sorted(entry.methods)})"
+            )
+        path = entry.methods[method]
+        X = self._as_request_rows(X, entry, device=path.device)
+        batcher = self._batcher_for(entry, method)
+        n = X.shape[0] if hasattr(X, "shape") else len(X)
+        if n > batcher.max_rows:
+            # both paths: a request the batcher can never fit would
+            # otherwise sit unfittable at the queue head forever
+            what = ("the largest shape bucket" if path.device
+                    else "the host batcher's row bound")
+            raise ValueError(
+                f"request of {n} rows exceeds {what} "
+                f"({batcher.max_rows}); bulk scoring belongs on "
+                "distribute.batch_predict, not the online engine"
+            )
+        if self.queue_depth() >= self.max_queue_depth:
+            self._stats.record_rejection("overload")
+            raise Overloaded(
+                f"queue depth is at max_queue_depth={self.max_queue_depth}"
+            )
+        timeout_s = (self.default_timeout_s if timeout_s is None
+                     else timeout_s)
+        enq_t = time.monotonic()
+        req = _Request(
+            X, n, Future(),
+            # `is not None`, not truthiness: an explicit timeout_s=0
+            # means "already due" (rejected at the next flush), not
+            # "no deadline"
+            deadline=(enq_t + timeout_s) if timeout_s is not None
+            else None,
+            enq_t=enq_t,
+        )
+        self._stats.record_submitted()
+        stats = self._stats
+
+        def _done(fut):
+            # a caller-cancelled future has no result/exception to read
+            # (fut.exception() would itself raise CancelledError)
+            if not fut.cancelled() and fut.exception() is None:
+                stats.record_completed(time.monotonic() - enq_t)
+
+        req.future.add_done_callback(_done)
+        batcher.submit(req)
+        return req.future
+
+    def predict(self, X, model=None, method="predict", timeout_s=None):
+        """Synchronous ``submit``: blocks for the result; raises
+        :class:`DeadlineExceeded` when the deadline passes first."""
+        timeout_s = (self.default_timeout_s if timeout_s is None
+                     else timeout_s)
+        fut = self.submit(X, model=model, method=method,
+                          timeout_s=timeout_s)
+        # wait slightly past the deadline: the batcher's flush-time
+        # check is the authority, and racing it exactly would turn its
+        # typed rejection into a bare timeout here
+        wait = None if timeout_s is None else (
+            timeout_s + max(0.25, 4 * self.max_delay_s)
+        )
+        try:
+            return fut.result(timeout=wait)
+        except _FutureTimeout:
+            raise DeadlineExceeded(
+                f"no result within {timeout_s}s (+flush grace)"
+            ) from None
+
+    def predict_proba(self, X, model=None, timeout_s=None):
+        return self.predict(X, model=model, method="predict_proba",
+                            timeout_s=timeout_s)
+
+    def decision_function(self, X, model=None, timeout_s=None):
+        return self.predict(X, model=model, method="decision_function",
+                            timeout_s=timeout_s)
+
+    # ------------------------------------------------------------------
+    # observability / lifecycle
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Serving metrics snapshot (see ``serve.stats``), plus the
+        engine's own gauges."""
+        out = self._stats.snapshot()
+        out["models"] = {
+            name: self.registry.versions(name)
+            for name in self.registry.names()
+        }
+        out["max_queue_depth"] = self.max_queue_depth
+        out["max_delay_ms"] = round(self.max_delay_s * 1e3, 3)
+        return out
+
+    def queue_depth(self):
+        """Total queued requests across batchers — read from the
+        per-batcher stats gauges (one lock, O(#gauges)), NOT by taking
+        every batcher's condition lock: this runs on every submit for
+        admission, and contending each dispatch loop's lock per request
+        would serialise the hot path against the batchers themselves."""
+        return self._stats.total_queue_depth()
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop admissions; drain (default) or fail queued requests;
+        join dispatch threads. Idempotent."""
+        with self._lock:
+            self._closed = True
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.close(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _batcher_for(self, entry, method):
+        key = (entry.name, entry.version, method)
+        with self._lock:
+            if self._closed:
+                # re-check under the lock: submit's unlocked fast-path
+                # check can race close(), and a batcher created AFTER
+                # close snapshotted the table would never be joined
+                raise ServingError("engine is closed")
+            b = self._batchers.get(key)
+            if b is None:
+                path = entry.methods[method]
+                b = MicroBatcher(
+                    path.dispatch,
+                    buckets=(entry.buckets if path.device
+                             else [_HOST_MAX_ROWS]),
+                    max_delay_s=self.max_delay_s,
+                    stats=self._stats,
+                    pad=path.device,
+                    name=f"{entry.spec}.{method}",
+                )
+                self._batchers[key] = b
+            return b
+
+    @staticmethod
+    def _as_request_rows(X, entry, device):
+        """Normalise one request's rows. Device entries get contiguous
+        float32 (n, d) with width validation ((d,) promotes to one
+        row); host entries pass through as numpy (text pipelines take
+        1-D object arrays)."""
+        if hasattr(X, "values") and not isinstance(X, np.ndarray):
+            X = X.values
+        X = np.asarray(X)
+        if not device:
+            return X
+        if X.ndim == 1:
+            if entry.n_features is not None and X.shape[0] == entry.n_features:
+                X = X[None, :]
+            else:
+                X = X[:, None]
+        if X.ndim != 2:
+            raise ValueError(
+                f"expected a (rows, {entry.n_features}) matrix, got "
+                f"shape {X.shape}"
+            )
+        if (entry.n_features is not None
+                and X.shape[1] != entry.n_features):
+            raise ValueError(
+                f"{entry.spec} expects {entry.n_features} features, "
+                f"request has {X.shape[1]}"
+            )
+        return np.ascontiguousarray(X, dtype=np.float32)

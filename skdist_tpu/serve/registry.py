@@ -1,0 +1,328 @@
+"""
+Model registry: validated, versioned, parameter-staged, AOT-prewarmed.
+
+Registration is where serving pays ALL of its one-time costs, so the
+request path never does:
+
+1. **validate** — ``check_is_fitted`` plus the requested method(s)
+   existing. Anything with the batched-kernel contract (``_params`` +
+   ``_meta``) gets the device path; everything else (sklearn models,
+   pipelines, text models) gets the host fallback with cross-request
+   batching but no shape bucketing.
+2. **version** — every ``register(name, model)`` is immutable and gets
+   a monotonically increasing version; routing is by ``name@version``
+   with bare ``name`` resolving to the latest. Rolling out a new model
+   is a new register; nothing in flight re-binds.
+3. **stage** — device models build ONE :class:`~skdist_tpu.distribute.
+   predict.DevicePredictPlan` per method (the same block-kernel
+   construction ``batch_predict`` uses, same structural cache key) and
+   one ``BatchedPlan`` via ``backend.prepare_batched`` — parameters go
+   device-resident through the backend's broadcast-reuse placement
+   once, not per request.
+4. **prewarm** — every (method, bucket) program is AOT-compiled through
+   ``compile_cache.prewarm`` with explicit shapes, no data. With the
+   on-disk cache enabled the compiled artifacts persist, so a restarted
+   server prewarms from disk without compiling either. After prewarm, a
+   serving process's ``compiles_after_warmup`` must stay 0.
+
+Buckets are powers-of-two row counts: floored at the backend's
+task-slot count (a flush shards ``bucket/n_slots`` rows per device) and
+capped by ``backend.hbm_round_cap`` using the entry's own row byte
+width, so a bucket that could not execute is never compiled.
+"""
+
+import threading
+
+import numpy as np
+
+from ..distribute.predict import device_predict_plan
+from ..parallel import resolve_backend
+from ..utils.validation import check_is_fitted
+from .batcher import shape_buckets
+
+__all__ = ["ModelRegistry", "ModelEntry"]
+
+#: default largest bucket when the backend reports no memory stats
+_DEFAULT_MAX_BATCH_ROWS = 256
+
+
+class _MethodPath:
+    """Per-(entry, method) dispatch: device (bucketed, prewarmed) or
+    host fallback (exact-shape, thread-dispatched)."""
+
+    __slots__ = ("method", "plan", "batched", "model")
+
+    def __init__(self, model, method, plan=None, batched=None):
+        self.model = model
+        self.method = method
+        self.plan = plan          # DevicePredictPlan (device) or None
+        self.batched = batched    # parallel.BatchedPlan or None
+
+    @property
+    def device(self):
+        return self.batched is not None
+
+    def dispatch(self, X):
+        """One flush: (rows, d) float32 (bucket-padded, rows a multiple
+        of the plan's task slots) on the device path — launched async,
+        returning a finalize callable (the batcher's scatter thread
+        blocks on the gather while the dispatch loop assembles the
+        next flush). Host-fallback dispatch computes synchronously and
+        returns the outputs directly."""
+        if not self.device:
+            return np.asarray(getattr(self.model, self.method)(X))
+        n_slots = self.batched.n_task_slots
+        rows = X.shape[0]
+        block = rows // n_slots
+        dev_out = self.batched.run_async(
+            {"X": X.reshape(n_slots, block, X.shape[1])}
+        )
+
+        def finalize():
+            out = self.batched.gather(dev_out)["out"]
+            return self.plan.postprocess(
+                out.reshape(rows, *out.shape[2:])
+            )
+
+        return finalize
+
+
+class ModelEntry:
+    """One immutable registered (name, version, model)."""
+
+    __slots__ = ("name", "version", "model", "methods", "buckets",
+                 "n_features")
+
+    def __init__(self, name, version, model, methods, buckets,
+                 n_features):
+        self.name = name
+        self.version = version
+        self.model = model
+        self.methods = methods        # {method: _MethodPath}
+        self.buckets = buckets        # row buckets (device entries)
+        self.n_features = n_features  # None: unknown width (host/text)
+
+    @property
+    def spec(self):
+        return f"{self.name}@{self.version}"
+
+    @property
+    def device(self):
+        return any(p.device for p in self.methods.values())
+
+
+class ModelRegistry:
+    """Thread-safe name@version store of :class:`ModelEntry` objects."""
+
+    def __init__(self, backend=None, max_batch_rows=None, buckets=None,
+                 prewarm=True):
+        """``buckets`` overrides the power-of-two ladder (still floored
+        at the backend's task slots and HBM-capped per entry);
+        ``max_batch_rows`` sets the ladder's top instead.
+        ``prewarm=False`` skips registration-time AOT compilation
+        (first requests then compile lazily — only for tooling that
+        never serves)."""
+        self.backend = resolve_backend(backend)
+        self.max_batch_rows = max_batch_rows
+        self._buckets = list(buckets) if buckets is not None else None
+        self.prewarm_default = bool(prewarm)
+        self._lock = threading.Lock()
+        self._models = {}  # name -> {version: ModelEntry}
+
+    # ------------------------------------------------------------------
+    def register(self, name, model, methods=("predict",), version=None,
+                 prewarm=None):
+        """Validate, stage, prewarm, and store; returns the entry."""
+        check_is_fitted(model)
+        methods = (methods,) if isinstance(methods, str) else tuple(methods)
+        for m in methods:
+            if m not in ("predict", "predict_proba", "decision_function"):
+                raise ValueError(f"unsupported serving method {m!r}")
+            if not hasattr(model, m):
+                raise ValueError(
+                    f"model {type(model).__name__} has no {m!r} method"
+                )
+        paths = {}
+        for m in methods:
+            plan = device_predict_plan(model, m)
+            if plan is None:
+                paths[m] = _MethodPath(model, m)
+            else:
+                batched = self.backend.prepare_batched(
+                    plan.block_kernel(), {"params": plan.params},
+                    cache_key=plan.cache_key(),
+                )
+                paths[m] = _MethodPath(model, m, plan=plan,
+                                       batched=batched)
+        n_features = self._resolve_width(model, paths)
+        buckets = self._entry_buckets(paths, n_features)
+
+        # prewarm BEFORE publishing: the moment the entry lands in the
+        # routing table a bare-name request can resolve to it, and on a
+        # live rollout that request must hit already-compiled programs
+        # (a compile here would both spike its latency and trip the
+        # compiles_after_warmup == 0 invariant)
+        if (self.prewarm_default if prewarm is None else prewarm):
+            self._prewarm_paths(paths, buckets, n_features)
+
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = max(versions) + 1 if versions else 1
+            else:
+                version = int(version)
+                if version in versions:
+                    raise ValueError(
+                        f"{name}@{version} is already registered; "
+                        "versions are immutable — register a new one"
+                    )
+            entry = ModelEntry(name, version, model, paths, buckets,
+                               n_features)
+            versions[version] = entry
+        return entry
+
+    def _resolve_width(self, model, paths):
+        for p in paths.values():
+            if p.device:
+                return p.plan.n_features
+        width = getattr(model, "n_features_in_", None)
+        return int(width) if width is not None else None
+
+    def _entry_buckets(self, paths, n_features):
+        device_paths = [p for p in paths.values() if p.device]
+        if not device_paths:
+            return None
+        n_slots = max(
+            p.batched.n_task_slots for p in device_paths
+        )
+        out_width = max(p.plan.out_width for p in device_paths)
+        max_rows = self.max_batch_rows or _DEFAULT_MAX_BATCH_ROWS
+        # cap the largest bucket with the backend's HBM round estimate
+        # for THIS entry's row footprint (input row + widest output row)
+        row_bytes = 4 * (int(n_features) + int(out_width))
+        cap = self.backend.hbm_round_cap(row_bytes)
+        if cap is not None:
+            max_rows = min(max_rows, max(n_slots, cap))
+        if self._buckets is not None:
+            kept = [b for b in self._buckets
+                    if n_slots <= b <= max_rows and b % n_slots == 0]
+            if not kept:
+                raise ValueError(
+                    f"no configured bucket fits: floor={n_slots} "
+                    f"(task slots), cap={max_rows} (HBM/max_batch_rows)"
+                )
+            return sorted(set(kept))
+        max_rows = max(n_slots, max_rows)
+        return shape_buckets(max_rows, min_rows=n_slots)
+
+    def prewarm_entry(self, entry):
+        """AOT-compile every (method, bucket) program of an existing
+        entry (e.g. after registering with ``prewarm=False``)."""
+        return self._prewarm_paths(entry.methods, entry.buckets,
+                                   entry.n_features)
+
+    @staticmethod
+    def _prewarm_paths(paths, buckets, n_features):
+        """The prewarm core, callable BEFORE an entry is published:
+        every (method, bucket) program through the public
+        ``compile_cache.prewarm`` shape entry — no data moves."""
+        import jax
+
+        if buckets is None:
+            return 0
+        n = 0
+        for path in paths.values():
+            if not path.device:
+                continue
+            n_slots = path.batched.n_task_slots
+            for bucket in buckets:
+                block = bucket // n_slots
+                path.batched.prewarm({"X": jax.ShapeDtypeStruct(
+                    (n_slots, block, n_features), np.float32
+                )})
+                n += 1
+        return n
+
+    # ------------------------------------------------------------------
+    def get(self, spec, version=None):
+        """Resolve ``"name"`` (latest) or ``"name@version"``."""
+        name = spec
+        if isinstance(spec, str) and "@" in spec:
+            if version is not None:
+                raise ValueError(
+                    "pass version either inline (name@v) or as an "
+                    "argument, not both"
+                )
+            name, _, v = spec.partition("@")
+            version = v
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError(
+                    f"no model registered under {name!r}; have: "
+                    f"{sorted(self._models) or 'none'}"
+                )
+            if version is None:
+                return versions[max(versions)]
+            try:
+                return versions[int(version)]
+            except (KeyError, ValueError):
+                raise KeyError(
+                    f"no version {version!r} of {name!r}; have: "
+                    f"{sorted(versions)}"
+                ) from None
+
+    def default_entry(self):
+        """The single registered model (latest version) — the routing
+        default when a request names no model."""
+        with self._lock:
+            if len(self._models) != 1:
+                raise ValueError(
+                    "engine has "
+                    f"{'no' if not self._models else 'multiple'} models "
+                    "registered; pass model='name[@version]' "
+                    f"(have: {sorted(self._models)})"
+                )
+            versions = next(iter(self._models.values()))
+            return versions[max(versions)]
+
+    def unregister(self, name, version=None):
+        """Drop a version (or, with ``version=None``, every version) of
+        a model — the unload half of the re-register rollout lifecycle.
+        Releases the entry's staged device parameters (the
+        ``BatchedPlan.shared`` references); without this a long-lived
+        server accumulates one device-resident parameter set per
+        historical version. Returns the removed entries. In-flight
+        requests holding the entry finish normally (the plan lives
+        until their dispatch drops it)."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError(
+                    f"no model registered under {name!r}; have: "
+                    f"{sorted(self._models) or 'none'}"
+                )
+            if version is None:
+                removed = list(versions.values())
+                del self._models[name]
+            else:
+                try:
+                    removed = [versions.pop(int(version))]
+                except (KeyError, ValueError):
+                    raise KeyError(
+                        f"no version {version!r} of {name!r}; have: "
+                        f"{sorted(versions)}"
+                    ) from None
+                if not versions:
+                    del self._models[name]
+            return removed
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def versions(self, name):
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(name)
+            return sorted(self._models[name])

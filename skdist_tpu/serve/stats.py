@@ -1,0 +1,153 @@
+"""
+Serving metrics: the observability half of the online runtime.
+
+Everything the batcher and engine record lands here, thread-safe, and
+comes back out of :meth:`ServingStats.snapshot` as one plain dict —
+printed by ``benchmarks/bench_serving.py`` and asserted on by
+``build_tools/serving_smoke.py``:
+
+- rolling request latency percentiles (p50/p95/p99) over a bounded
+  ring, so a long-lived server's stats track current behaviour rather
+  than its cold start;
+- queue depth (gauge, updated by the batcher on every enqueue/flush);
+- batch-fill ratio: rows actually served / bucket capacity dispatched
+  — how much of each padded flush was real work;
+- bucket-hit histogram: which shape buckets traffic lands in (the
+  input for re-tuning the bucket set);
+- ``compiles_after_warmup``: movement of the process-wide compile
+  counters (``parallel.compile_cache``) since :meth:`mark_warm` — the
+  steady-state invariant of an AOT-prewarmed server. The registry
+  prewarms every (model, bucket) program, marks warm, and from then on
+  this MUST stay 0: any compile in steady state is a shape that
+  escaped the bucket set. Process-global by construction — concurrent
+  non-serving work in the same process moves it too, which a server
+  process does not have.
+"""
+
+import threading
+from collections import deque
+
+from ..parallel import compile_cache
+
+__all__ = ["ServingStats"]
+
+#: compile_cache counters whose movement after warmup means "a request
+#: paid a compile": closure builds, jit traces, and AOT lower+compiles
+_COMPILE_COUNTERS = ("kernel_misses", "jit_misses", "aot_misses")
+
+
+class ServingStats:
+    """Thread-safe rolling serving metrics (see module docstring)."""
+
+    def __init__(self, window=4096):
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=window)
+        self._bucket_hits = {}
+        self._rows_served = 0
+        self._capacity_served = 0
+        self._flushes = 0
+        self._requests = 0
+        self._completed = 0
+        self._rejected_overload = 0
+        self._rejected_deadline = 0
+        self._dispatch_errors = 0
+        self._queue_depths = {}  # per-batcher gauges; snapshot sums
+        self._warm_snap = None
+
+    # ------------------------------------------------------------------
+    # recording (batcher/engine side)
+    # ------------------------------------------------------------------
+    def record_submitted(self):
+        with self._lock:
+            self._requests += 1
+
+    def record_completed(self, latency_s):
+        with self._lock:
+            self._completed += 1
+            self._lat.append(float(latency_s))
+
+    def record_rejection(self, kind):
+        with self._lock:
+            if kind == "overload":
+                self._rejected_overload += 1
+            elif kind == "deadline":
+                self._rejected_deadline += 1
+            else:
+                self._dispatch_errors += 1
+
+    def record_flush(self, rows, bucket):
+        with self._lock:
+            self._flushes += 1
+            self._rows_served += int(rows)
+            self._capacity_served += int(bucket)
+            self._bucket_hits[int(bucket)] = (
+                self._bucket_hits.get(int(bucket), 0) + 1
+            )
+
+    def set_queue_depth(self, depth, key=None):
+        """Per-batcher gauge (``key`` = the batcher's name): a
+        multi-model engine shares one stats object, and a single
+        last-writer-wins gauge would report whichever batcher moved
+        most recently instead of the engine total."""
+        with self._lock:
+            self._queue_depths[key] = int(depth)
+
+    def total_queue_depth(self):
+        """Sum of the per-batcher gauges — the engine's admission
+        check reads this instead of polling every batcher's lock."""
+        with self._lock:
+            return sum(self._queue_depths.values())
+
+    def mark_warm(self):
+        """Snapshot the compile counters; ``compiles_after_warmup``
+        counts movement from here on. Called by the registry after the
+        last prewarm compile."""
+        with self._lock:
+            self._warm_snap = compile_cache.snapshot()
+
+    # ------------------------------------------------------------------
+    # reading
+    # ------------------------------------------------------------------
+    def compiles_after_warmup(self):
+        """Compile-shaped counter movement since :meth:`mark_warm`;
+        None before any warm mark."""
+        with self._lock:
+            warm = self._warm_snap
+        if warm is None:
+            return None
+        now = compile_cache.snapshot()
+        return int(sum(now[k] - warm[k] for k in _COMPILE_COUNTERS))
+
+    @staticmethod
+    def _percentile(sorted_vals, q):
+        if not sorted_vals:
+            return None
+        idx = min(len(sorted_vals) - 1,
+                  max(0, int(round(q * (len(sorted_vals) - 1)))))
+        return sorted_vals[idx]
+
+    def snapshot(self):
+        """Current metrics as a plain dict (latency in milliseconds)."""
+        with self._lock:
+            lat = sorted(self._lat)
+            out = {
+                "requests": self._requests,
+                "completed": self._completed,
+                "flushes": self._flushes,
+                "queue_depth": sum(self._queue_depths.values()),
+                "rejected_overloaded": self._rejected_overload,
+                "rejected_deadline": self._rejected_deadline,
+                "dispatch_errors": self._dispatch_errors,
+                "rows_served": self._rows_served,
+                "batch_fill_ratio": (
+                    round(self._rows_served / self._capacity_served, 4)
+                    if self._capacity_served else None
+                ),
+                "bucket_hits": dict(sorted(self._bucket_hits.items())),
+            }
+        for name, q in (("p50_ms", 0.50), ("p95_ms", 0.95),
+                        ("p99_ms", 0.99)):
+            v = self._percentile(lat, q)
+            out[name] = round(v * 1e3, 3) if v is not None else None
+        out["compiles_after_warmup"] = self.compiles_after_warmup()
+        return out

@@ -13,6 +13,39 @@ import numbers
 import numpy as np
 
 
+def full_length_sample_weight(fit_params, n):
+    """The batched device paths' fit-params contract, shared by search
+    and the OvR/OvO multiclass strategies (one definition, so the
+    accepted-weights rules cannot drift between fan-out families): the
+    compiled programs accept exactly ONE array-valued fit param — a
+    full-length per-sample ``sample_weight``, which composes
+    multiplicatively with fold/down-sampling/pair masks.
+
+    Returns ``(sw_or_None, ok)``. ``ok`` False routes the fit to the
+    generic host path (any other fit param, ragged or non-numeric
+    weights, wrong length — where the host estimators' own validation
+    owns the failure); ``(None, True)`` means "no weights, batched path
+    fine". ``(n, 1)`` column weights flatten; anything else non-1-D
+    (0-d scalars, (n, k) matrices) is not a per-sample weight vector.
+    """
+    if not fit_params:
+        return None, True
+    if set(fit_params) != {"sample_weight"}:
+        return None, False
+    sw = fit_params["sample_weight"]
+    if sw is None:
+        return None, True
+    try:
+        arr = np.asarray(sw, dtype=np.float64)
+    except (ValueError, TypeError):
+        return None, False
+    if arr.ndim == 2 and arr.shape[1] == 1:
+        arr = arr.ravel()
+    if arr.ndim == 1 and arr.shape[0] == n:
+        return arr, True
+    return None, False
+
+
 def check_estimator_backend(estimator, verbose=False):
     """Print which execution path a fit will use (reference
     ``_check_estimator``, validation.py:14-20, printed spark-vs-local)."""

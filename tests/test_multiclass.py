@@ -374,3 +374,108 @@ def test_constant_predictor():
     cp = _ConstantPredictor().fit(None, np.array([1, 1]))
     assert (cp.predict(np.zeros((3, 2))) == 1).all()
     assert np.allclose(cp.predict_proba(np.zeros((3, 2)))[:, 1], 1.0)
+
+
+def test_ovr_sample_weight_device_path(clf_data, tpu_backend):
+    """VERDICT gap #6: a full-length sample_weight must ride the
+    BATCHED OvR path (not bail to host) and match the generic per-task
+    path's weighted fits, mirroring search.py's sample_weight
+    contract."""
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = clf_data
+    w = np.random.RandomState(7).rand(len(y)) * 2.0
+
+    est = LogisticRegression(max_iter=200)
+    ovr_b = DistOneVsRestClassifier(est, backend=tpu_backend).fit(
+        X, y, sample_weight=w
+    )
+    # the batched path really ran: per-class artifacts are kernel slices
+    assert all(hasattr(e, "_params") for e in ovr_b.estimators_)
+
+    ovr_g = DistOneVsRestClassifier(
+        LogisticRegression(max_iter=200, engine="xla"),
+        backend=tpu_backend,
+    )
+    ovr_g._try_batched = lambda *a, **k: None  # force the generic path
+    ovr_g.fit(X, y, sample_weight=w)
+    np.testing.assert_allclose(
+        ovr_b.predict_proba(X), ovr_g.predict_proba(X), atol=1e-4
+    )
+
+    # the weights actually flow: weighted != unweighted
+    ovr_u = DistOneVsRestClassifier(est, backend=tpu_backend).fit(X, y)
+    assert np.abs(
+        ovr_b.predict_proba(X) - ovr_u.predict_proba(X)
+    ).max() > 1e-3
+
+    # (n, 1) column weights flatten like search.py's handling
+    ovr_c = DistOneVsRestClassifier(est, backend=tpu_backend).fit(
+        X, y, sample_weight=w[:, None]
+    )
+    np.testing.assert_allclose(
+        ovr_c.predict_proba(X), ovr_b.predict_proba(X), atol=1e-6
+    )
+
+
+def test_ovo_sample_weight_device_path(clf_data, tpu_backend):
+    """Same contract for OvO: weights compose with the pair-membership
+    masks on device; the host mirror slices them per pair."""
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = clf_data
+    w = np.random.RandomState(11).rand(len(y)) * 2.0
+
+    ovo_b = DistOneVsOneClassifier(
+        LogisticRegression(max_iter=200), backend=tpu_backend
+    ).fit(X, y, sample_weight=w)
+    assert all(hasattr(e, "_params") for e in ovo_b.estimators_)
+
+    ovo_g = DistOneVsOneClassifier(
+        LogisticRegression(max_iter=200, engine="xla"),
+        backend=tpu_backend,
+    )
+    ovo_g._try_batched = lambda *a, **k: None
+    ovo_g.fit(X, y, sample_weight=w)
+    np.testing.assert_allclose(
+        ovo_b.decision_function(X), ovo_g.decision_function(X), atol=1e-4
+    )
+
+
+def test_ovr_bad_sample_weight_routes_to_host(clf_data):
+    """Wrong-length / wrong-shape weights stay off the device path and
+    surface the host estimator's own validation error."""
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = clf_data
+    with pytest.raises(ValueError):
+        DistOneVsRestClassifier(
+            LogisticRegression(max_iter=20, engine="xla")
+        ).fit(X, y, sample_weight=np.ones(len(y) - 5))
+    # other fit params still take the generic path (sklearn estimator
+    # accepts sample_weight; an unknown kwarg raises there)
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    with pytest.raises(TypeError):
+        DistOneVsRestClassifier(SkLR(max_iter=20)).fit(
+            X, y, not_a_param=1
+        )
+
+
+def test_ovo_column_weights_host_path(clf_data):
+    """(n, 1) column weights through the OvO HOST path: flattened
+    before the per-pair slice (a sliced (k, 1) array would fail
+    sklearn's 1-D sample_weight validation)."""
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, y = clf_data
+    w = np.random.RandomState(3).rand(len(y), 1)
+    ovo = DistOneVsOneClassifier(SkLR(max_iter=200)).fit(
+        X, y, sample_weight=w
+    )
+    flat = DistOneVsOneClassifier(SkLR(max_iter=200)).fit(
+        X, y, sample_weight=w.ravel()
+    )
+    np.testing.assert_allclose(
+        ovo.decision_function(X), flat.decision_function(X), atol=1e-8
+    )

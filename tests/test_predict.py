@@ -244,6 +244,78 @@ def test_device_csr_budget_checked_before_pack(clf_data, tpu_backend,
     np.testing.assert_allclose(out, expected, atol=1e-6)
 
 
+def test_concurrent_callers_no_crosstalk_no_recompile(clf_data,
+                                                      tpu_backend):
+    """Two threads sharing one model+backend, interleaved shapes: every
+    caller gets its own rows back (no cross-talk through the shared
+    compile memos or staged params) and the compiled-program set stays
+    bounded at one per distinct block shape (no recompile storm)."""
+    import threading
+
+    from skdist_tpu.parallel import compile_cache
+
+    X, y = clf_data
+    model = LogisticRegression(max_iter=100).fit(X, y)
+    expected = model.predict_proba(X)
+    udf = get_prediction_udf(model, method="predict_proba",
+                             backend=tpu_backend, batch_size=16)
+    shapes = [32, 48, 32, 48, 32, 48]  # two shapes, interleaved
+
+    # prime both block shapes once so the threaded phase is steady-state
+    for n in (32, 48):
+        batch_predict(model, X[:n], method="predict_proba",
+                      backend=tpu_backend, batch_size=16)
+    snap = compile_cache.snapshot()
+
+    errors = []
+
+    def caller(offset):
+        for n in shapes:
+            lo = offset * 8
+            out = batch_predict(model, X[lo:lo + n],
+                                method="predict_proba",
+                                backend=tpu_backend, batch_size=16)
+            if not np.allclose(out, expected[lo:lo + n], atol=1e-6):
+                errors.append(("batch", offset, n))
+            cols = [pd.Series(X[lo:lo + n, j]) for j in range(X.shape[1])]
+            rows = udf(*cols)
+            if not np.allclose(np.stack(rows.values),
+                               expected[lo:lo + n], atol=1e-6):
+                errors.append(("udf", offset, n))
+
+    threads = [threading.Thread(target=caller, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    after = compile_cache.snapshot()
+    assert after["jit_misses"] == snap["jit_misses"]
+    assert after["kernel_misses"] == snap["kernel_misses"]
+    # the udf path may AOT one extra tail-block chunk beyond the primed
+    # full blocks; anything more means per-caller recompilation
+    assert after["aot_misses"] - snap["aot_misses"] <= 2
+
+
+def test_udf_proba_dtype_and_column_order_pin(clf_data):
+    """Pin the list-valued proba Series contract: one list-like row per
+    input row, float32 values, columns in model.classes_ order (the
+    reference's Array(Double) UDF schema, predict.py:125-141)."""
+    X, y = clf_data
+    model = LogisticRegression(max_iter=100).fit(X, y)
+    udf = get_prediction_udf(model, method="predict_proba")
+    rows = udf(*[pd.Series(X[:, j]) for j in range(X.shape[1])])
+    assert isinstance(rows, pd.Series) and rows.dtype == object
+    stacked = np.stack(rows.values)
+    assert stacked.dtype == np.float32
+    assert stacked.shape == (len(X), len(model.classes_))
+    # column order IS classes_ order: the argmax column must agree with
+    # predict's label through the classes_ lookup
+    labels = model.classes_[np.argmax(stacked, axis=1)]
+    assert (labels == model.predict(X)).all()
+    np.testing.assert_allclose(stacked, model.predict_proba(X), atol=1e-6)
+
+
 def test_batch_predict_and_udf_with_forest(clf_data, tpu_backend):
     """Forest models ride batch_predict's host-chunk path (no device
     proba kernel) — on CPU that is the native C walker — and the
@@ -268,3 +340,39 @@ def test_batch_predict_and_udf_with_forest(clf_data, tpu_backend):
     proba_rows = udf(*cols)
     np.testing.assert_allclose(np.stack(proba_rows.values), direct,
                                atol=1e-6)
+
+
+def test_udf_tracks_refit(clf_data):
+    """The UDF's cached plan keys on the fitted-params object: a REFIT
+    of the same model instance must be served with the new
+    coefficients, never the pre-refit snapshot."""
+    X, y = clf_data
+    model = LogisticRegression(max_iter=100).fit(X, y)
+    udf = get_prediction_udf(model, method="predict_proba")
+    cols = [pd.Series(X[:20, j]) for j in range(X.shape[1])]
+    before = np.stack(udf(*cols).values)
+
+    y_flipped = (np.asarray(y) + 1) % 3
+    model.fit(X, y_flipped)
+    after = np.stack(udf(*cols).values)
+    np.testing.assert_allclose(after, model.predict_proba(X[:20]),
+                               atol=1e-6)
+    assert np.abs(after - before).max() > 1e-3  # the refit really showed
+
+
+def test_udf_pickles_without_runtime(clf_data):
+    """The UDF must pickle (the reference's pandas UDF ships to
+    executors); live runtime handles are re-resolved on the other
+    side."""
+    import pickle
+
+    X, y = clf_data
+    model = LogisticRegression(max_iter=100).fit(X, y)
+    udf = get_prediction_udf(model, method="predict_proba")
+    cols = [pd.Series(X[:8, j]) for j in range(X.shape[1])]
+    udf(*cols)  # resolve the runtime first — pickling must still work
+    clone = pickle.loads(pickle.dumps(udf))
+    np.testing.assert_allclose(
+        np.stack(clone(*cols).values), model.predict_proba(X[:8]),
+        atol=1e-6,
+    )
