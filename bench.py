@@ -279,6 +279,95 @@ def _serving_aux(model, X, n_clients=4, n_requests=40):
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+def compaction_workload(quick=False, seed=0):
+    """Convergence-skewed grid for the compaction readout: three tol
+    bands over a log-C sweep — most lanes converge inside the first
+    iteration slice (loose tol), a band retires gradually (mid tol,
+    what live-task compaction merges), and a straggler band runs to
+    max_iter (tight tol). 96 candidates x 5 folds = 480 tasks."""
+    rng = np.random.RandomState(seed)
+    n, d, k = (400, 32, 3) if quick else (1500, 96, 3)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.argmax(X @ W + 1.5 * rng.normal(size=(n, k)), axis=1)
+    grid = [
+        {"C": list(np.logspace(-4, 1, 64)), "tol": [20.0]},
+        {"C": list(np.logspace(-3, 1, 16)), "tol": [1e-2]},
+        {"C": list(np.logspace(-2, 2, 16)), "tol": [1e-6]},
+    ]
+    return X, y, grid, 96 * 5
+
+
+def compaction_aux(quick=False):
+    """Measured readout of the convergence-compacted scheduler on the
+    skewed 480-task grid: warm wall of the compacted path vs the same
+    grid forced through the classic single-slice lockstep rounds
+    (SKDIST_COMPACTION=0 — every task pays all iterations in one fused
+    program), plus the scheduler observability (slices run, tasks
+    retired per slice, compaction events) and the compile-invariant
+    evidence (counter movement of a warm compacted run must be hits
+    only). Best-effort: a dict with "error" on any failure."""
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend, compile_cache
+
+    try:
+        X, y, grid, n_tasks = compaction_workload(quick=quick)
+        est = LogisticRegression(max_iter=60, engine="xla")
+
+        def run_once(compaction):
+            # pin BOTH legs explicitly: an ambient SKDIST_COMPACTION=0
+            # (left over from debugging the kill switch) would silently
+            # turn the "compacted" leg into a second lockstep run and
+            # report speedup ~1.0 as a scheduler regression
+            old = os.environ.get("SKDIST_COMPACTION")
+            os.environ["SKDIST_COMPACTION"] = "1" if compaction else "0"
+            try:
+                bk = TPUBackend(reuse_broadcast=True)
+                t0 = time.perf_counter()
+                gs = DistGridSearchCV(
+                    est, grid, backend=bk, cv=5, scoring="accuracy",
+                    refit=False,
+                ).fit(X, y)
+                wall = time.perf_counter() - t0
+            finally:
+                if old is None:
+                    os.environ.pop("SKDIST_COMPACTION", None)
+                else:
+                    os.environ["SKDIST_COMPACTION"] = old
+            return wall, gs, dict(bk.last_round_stats or {})
+
+        run_once(True)  # cold (compiles init/step/finalize)
+        snap0 = compile_cache.snapshot()
+        warm_s, gs_c, stats = run_once(True)
+        warm_delta = _cache_delta(snap0, compile_cache.snapshot())
+        run_once(False)  # classic cold
+        base_s, gs_k, _ = run_once(False)
+        retired = [int(v) for v in stats.get("retired_per_slice", [])]
+        diff = float(np.max(np.abs(
+            np.asarray(gs_c.cv_results_["mean_test_score"])
+            - np.asarray(gs_k.cv_results_["mean_test_score"])
+        )))
+        return {
+            "n_tasks": n_tasks,
+            "warm_wall_s": round(warm_s, 3),
+            "single_slice_lockstep_warm_wall_s": round(base_s, 3),
+            "speedup_vs_single_slice": round(base_s / warm_s, 3),
+            "slices": stats.get("slices"),
+            "chunk": stats.get("chunk"),
+            "compactions": stats.get("compactions"),
+            "rounds_per_slice": stats.get("rounds_per_slice"),
+            "retired_per_slice": retired,
+            "first_slice_retired_frac": (
+                round(retired[0] / n_tasks, 4) if retired else None
+            ),
+            "cv_results_max_diff_vs_single_slice": diff,
+            "warm_compile_cache_delta": warm_delta,
+        }
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def run_bench(platform, quick=False):
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression
@@ -479,6 +568,7 @@ def run_bench(platform, quick=False):
             "compile_cache": cache_aux,
             "overlap": overlap_aux,
             "serving": _serving_aux(gs.best_estimator_, X),
+            "compaction": compaction_aux(quick=quick),
             "batched_vs_generic_cv_results_max_diff": parity,
             "f32_noise_floor_wellcond": floor_well,
             "illcond_C100_diff": parity_ill,

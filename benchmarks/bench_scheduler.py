@@ -1,0 +1,85 @@
+"""Scheduler micro-benchmark: sweep the convergence-compacted round
+loop's two knobs — iterations per slice (``SKDIST_SLICE_ITERS``) and
+round size (``partitions``) — on the skewed 480-task grid and print one
+JSON line per cell, plus the single-slice lockstep baseline.
+
+The sweep answers the tuning questions the defaults bake in: slices
+much shorter than ~1/8 of max_iter pay more dispatch than they save;
+rounds much smaller than ~1/8 of the task set pay per-round dispatch
+for compaction granularity the workload cannot use.
+
+Usage (CPU mesh, like the unit tier):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/bench_scheduler.py [--quick]
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def _fit(X, y, grid, backend, partitions="auto"):
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+
+    t0 = time.perf_counter()
+    DistGridSearchCV(
+        LogisticRegression(max_iter=60, engine="xla"), grid,
+        backend=backend, cv=5, scoring="accuracy", refit=False,
+        partitions=partitions,
+    ).fit(X, y)
+    return time.perf_counter() - t0
+
+
+def main(quick=False):
+    from bench import compaction_workload
+    from skdist_tpu.parallel import TPUBackend
+
+    X, y, grid, n_tasks = compaction_workload(quick=quick)
+
+    # baseline: classic single-slice lockstep (warm of 2 runs)
+    os.environ["SKDIST_COMPACTION"] = "0"
+    _fit(X, y, grid, TPUBackend())
+    base = _fit(X, y, grid, TPUBackend())
+    del os.environ["SKDIST_COMPACTION"]
+    print(json.dumps({
+        "cell": "single_slice_lockstep", "warm_wall_s": round(base, 3),
+        "n_tasks": n_tasks,
+    }), flush=True)
+
+    for slice_iters in (4, 8, 15, 30):
+        for partitions in ("auto", 16, 4):
+            os.environ["SKDIST_SLICE_ITERS"] = str(slice_iters)
+            try:
+                _fit(X, y, grid, TPUBackend(), partitions=partitions)
+                bk = TPUBackend()
+                wall = _fit(X, y, grid, bk, partitions=partitions)
+                stats = dict(bk.last_round_stats or {})
+            finally:
+                del os.environ["SKDIST_SLICE_ITERS"]
+            print(json.dumps({
+                "cell": f"slice={slice_iters} partitions={partitions}",
+                "warm_wall_s": round(wall, 3),
+                "speedup_vs_single_slice": round(base / wall, 3),
+                "mode": stats.get("mode"),
+                "chunk": stats.get("chunk"),
+                "slices": stats.get("slices"),
+                "compactions": stats.get("compactions"),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main(quick="--quick" in sys.argv)

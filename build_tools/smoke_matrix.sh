@@ -12,7 +12,13 @@
 #                             batch_predict, 0 post-warmup compiles, 0
 #                             dropped futures, p99 bounded, bitwise
 #                             parity with batch_predict (serving PR).
+#   compaction_smoke.py     — skewed 480-task grid: compacted warm wall
+#                             >= 1.3x over single-slice lockstep, >=60%
+#                             of lanes retired in slice 0, cv_results_
+#                             parity <= 1e-5, 0 compiles after warmup
+#                             (convergence-compacted scheduler PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
 python build_tools/compile_cache_smoke.py
+python build_tools/compaction_smoke.py

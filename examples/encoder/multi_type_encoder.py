@@ -56,7 +56,7 @@ def main():
         "multilabel_col": "multihotencoder",
     })
     X_t = encoder.fit_transform(df)
-    print("steps:", [name for name, _ in encoder.transformer_list])
+    print("steps:", encoder.step_names)
 
     gs = DistGridSearchCV(
         LogisticRegression(max_iter=100), {"C": [0.1, 1.0, 10.0]}, cv=3,

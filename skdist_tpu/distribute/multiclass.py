@@ -35,6 +35,7 @@ import numpy as np
 
 from ..base import BaseEstimator, ClassifierMixin, clone, strip_runtime
 from ..parallel import (
+    iterative_fit_supported,
     parse_partitions,
     prefers_host_engine,
     resolve_backend,
@@ -234,6 +235,47 @@ def _binary_confidence(est, X):
     return np.asarray(est.predict_proba(X))[:, 1] - 0.5
 
 
+def _iterative_fit_spec(est_cls, meta, static, n_slice, derive,
+                        fallback_kernel, fallback_key, key):
+    """Wrap an estimator's iteration-sliced fit kernels for the
+    convergence-compacted backend entry point (the SAME
+    ``batched_map_iterative`` path the CV search uses). ``derive(shared,
+    task) -> (X, y_bin, w, hyper, aux)`` supplies the per-task binary
+    sub-problem (OvR class column / OvO pair mask); ``key`` must bake in
+    everything ``derive`` depends on beyond (est_cls, static, meta).
+    Returns an ``IterativeKernelSpec`` whose kernels are memoised on
+    ``key``."""
+    from ..models.linear import maybe_exact_matmuls
+    from ..parallel import IterativeKernelSpec, compile_cache
+
+    def build():
+        ks = est_cls._build_fit_slice_kernels(meta, static, n_slice)
+        f_init = maybe_exact_matmuls(est_cls, ks["init"])
+        f_step = maybe_exact_matmuls(est_cls, ks["step"])
+        f_fin = maybe_exact_matmuls(est_cls, ks["finalize"])
+
+        def init(shared, task):
+            X, y, w, hyper, aux = derive(shared, task)
+            return f_init(X, y, w, hyper, aux)
+
+        def step(shared, task, carry):
+            X, y, w, hyper, aux = derive(shared, task)
+            return f_step(X, y, w, hyper, carry, aux)
+
+        def finalize(shared, task, carry):
+            X, y, w, hyper, aux = derive(shared, task)
+            return f_fin(X, y, w, hyper, carry, aux)
+
+        return {"init": init, "step": step, "finalize": finalize,
+                "keys": ks["finalize_keys"]}
+
+    parts = compile_cache.kernel_memo(("spec",) + tuple(key), build)
+    return IterativeKernelSpec(
+        parts["init"], parts["step"], parts["finalize"], parts["keys"],
+        fallback=fallback_kernel, fallback_cache_key=fallback_key,
+    )
+
+
 def _make_fitted_binary(base, params_slice, meta, static_names=None):
     """Materialise a fitted JAX binary estimator from a kernel params
     slice (the batched path's per-class artifact)."""
@@ -420,18 +462,60 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
                 (lo, min(lo + span_rows, int(live.size)))
                 for lo in range(0, int(live.size), span_rows)
             ]
+            # convergence-compacted path (the same backend entry point
+            # the CV search uses): classes converge at different rates,
+            # so the class-axis fan-out compacts exactly like a grid —
+            # single-span only (the span machinery re-dispatches with a
+            # pinned round shape the slice loop doesn't need)
+            n_slice = (
+                iterative_fit_supported(
+                    backend, type(est), int(live.size),
+                    getattr(est, "max_iter", None),
+                )
+                if len(spans) == 1 else None
+            )
             parts = []
-            for lo, hi in spans:
-                task_args = {"cls": live[lo:hi].astype(np.int32)}
+            if n_slice is not None:
+
+                def derive(shared, task):
+                    y_bin = shared["Y"][:, task["cls"]]
+                    w = shared["sw"]
+                    if use_masks:
+                        w = w * task["keep"].astype(jnp.float32)
+                    return (shared["X"], y_bin, w, shared["hyper"],
+                            shared["aux"])
+
+                iter_key = structural_key(
+                    "ovr_iter", type(est), static, _meta_signature(meta),
+                    use_masks, int(n_slice),
+                )
+                spec = _iterative_fit_spec(
+                    type(est), meta, static, n_slice, derive, kernel,
+                    kernel_key, iter_key,
+                )
+                task_args = {"cls": live.astype(np.int32)}
                 if use_masks:
-                    task_args["keep"] = self._exact_keep_masks(
-                        Y, live[lo:hi]
-                    )
-                parts.append(backend.batched_map(
-                    kernel, task_args, shared, round_size=round_size,
-                    shared_specs=specs, pad_to_round=len(spans) > 1,
-                    cache_key=kernel_key,
+                    task_args["keep"] = self._exact_keep_masks(Y, live)
+                parts.append(backend.batched_map_iterative(
+                    spec, task_args, shared,
+                    round_size=(
+                        None if self.partitions in ("auto", None)
+                        else round_size
+                    ),
+                    shared_specs=specs, cache_key=iter_key,
                 ))
+            else:
+                for lo, hi in spans:
+                    task_args = {"cls": live[lo:hi].astype(np.int32)}
+                    if use_masks:
+                        task_args["keep"] = self._exact_keep_masks(
+                            Y, live[lo:hi]
+                        )
+                    parts.append(backend.batched_map(
+                        kernel, task_args, shared, round_size=round_size,
+                        shared_specs=specs, pad_to_round=len(spans) > 1,
+                        cache_key=kernel_key,
+                    ))
             stacked = parts[0] if len(parts) == 1 else (
                 jax.tree_util.tree_map(
                     lambda *xs: np.concatenate(xs, axis=0), *parts
@@ -694,16 +778,52 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
         from ..models.linear import _meta_signature
         from ..parallel import row_sharded_specs, structural_key
 
-        stacked = backend.batched_map(
-            kernel, task_args, shared,
-            round_size=parse_partitions(self.partitions, len(self.pairs_)),
-            shared_specs=row_sharded_specs(
-                backend, shared, {"X": 0, "y": 0, "sw": 0}
-            ),
-            cache_key=structural_key(
-                "ovo", type(est), static, _meta_signature(meta)
-            ),
+        specs = row_sharded_specs(
+            backend, shared, {"X": 0, "y": 0, "sw": 0}
         )
+        kernel_key = structural_key(
+            "ovo", type(est), static, _meta_signature(meta)
+        )
+        # convergence-compacted path: class pairs converge at different
+        # rates (same backend entry point as the CV search / OvR)
+        n_slice = iterative_fit_supported(
+            backend, type(est), len(self.pairs_),
+            getattr(est, "max_iter", None),
+        )
+        if n_slice is not None:
+
+            def derive(shared, task):
+                yi = shared["y"]
+                in_pair = (yi == task["i"]) | (yi == task["j"])
+                y_bin = (yi == task["j"]).astype(jnp.int32)
+                w = in_pair.astype(jnp.float32) * shared["sw"]
+                return shared["X"], y_bin, w, shared["hyper"], shared["aux"]
+
+            iter_key = structural_key(
+                "ovo_iter", type(est), static, _meta_signature(meta),
+                int(n_slice),
+            )
+            spec = _iterative_fit_spec(
+                type(est), meta, static, n_slice, derive, kernel,
+                kernel_key, iter_key,
+            )
+            stacked = backend.batched_map_iterative(
+                spec, task_args, shared,
+                round_size=(
+                    None if self.partitions in ("auto", None)
+                    else parse_partitions(self.partitions, len(self.pairs_))
+                ),
+                shared_specs=specs, cache_key=iter_key,
+            )
+        else:
+            stacked = backend.batched_map(
+                kernel, task_args, shared,
+                round_size=parse_partitions(
+                    self.partitions, len(self.pairs_)
+                ),
+                shared_specs=specs,
+                cache_key=kernel_key,
+            )
         self.estimators_ = [
             _make_fitted_binary(
                 est, jax.tree_util.tree_map(lambda a: a[t], stacked), meta

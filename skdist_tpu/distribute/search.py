@@ -46,6 +46,7 @@ from ..metrics import (
     device_scorer_compatible,
 )
 from ..parallel import (
+    iterative_fit_supported,
     parse_partitions,
     prefers_host_engine,
     resolve_backend,
@@ -250,6 +251,109 @@ def _cached_cv_kernel(est_cls, meta, static, scorer_specs,
         lambda: _build_cv_kernel(est_cls, meta, static, scorer_specs,
                                  return_train_score),
     )
+
+
+def _cost_order(est_cls, task_hyper, split_ids):
+    """Cost-ordered round packing: a permutation of the task axis
+    sorting by the estimator family's convergence-cost heuristic
+    (ascending), fold id fastest — so each chunk-shaped round holds
+    tasks of similar expected iteration count and the compacted loop
+    retires whole rounds instead of dragging one straggler per round.
+    Returns None when the family has no heuristic or the order is
+    already cost-sorted."""
+    cost_fn = getattr(est_cls, "_batched_task_cost", None)
+    if cost_fn is None or len(split_ids) <= 1:
+        return None
+    try:
+        cost = np.asarray(cost_fn(task_hyper), dtype=np.float64)
+    except Exception:
+        return None
+    if cost.shape != (len(split_ids),):
+        return None
+    order = np.lexsort((np.asarray(split_ids), cost))
+    if np.array_equal(order, np.arange(len(order))):
+        return None
+    return order
+
+
+def _cv_iterative_spec(est_cls, meta, static, scorer_specs,
+                       return_train_score, n_slice, fallback,
+                       fallback_key):
+    """Build (memoised) the iteration-sliced CV kernels and wrap them as
+    an :class:`~skdist_tpu.parallel.IterativeKernelSpec`: init/step
+    advance the estimator's sliced fit on the fold-masked weights;
+    finalize shapes params from the carry and computes the same scorer
+    outputs as the classic fused kernel. Returns ``(spec, cache_key)``.
+    """
+    from ..models.linear import _meta_signature, maybe_exact_matmuls
+    from ..parallel import IterativeKernelSpec, compile_cache, structural_key
+
+    key = structural_key(
+        "cv_iter", est_cls, static,
+        tuple((out, metric, kind) for out, metric, _k, kind in scorer_specs),
+        bool(return_train_score),
+        _meta_signature(meta),
+        int(n_slice),
+    )
+
+    def build():
+        ks = est_cls._build_fit_slice_kernels(meta, static, n_slice)
+        fit_init = maybe_exact_matmuls(est_cls, ks["init"])
+        fit_step = maybe_exact_matmuls(est_cls, ks["step"])
+        fit_fin = maybe_exact_matmuls(est_cls, ks["finalize"])
+        decision_kernel = maybe_exact_matmuls(
+            est_cls, est_cls._build_decision_kernel(meta, static)
+        )
+        needs_proba = any(kind == "proba" for *_, kind in scorer_specs)
+        proba_kernel = (
+            maybe_exact_matmuls(
+                est_cls, est_cls._build_proba_kernel(meta, static)
+            )
+            if needs_proba else None
+        )
+
+        def fit_args(shared, task):
+            fit_w = shared["sw"] * shared["train_masks"][task["split"]]
+            return (shared["X"], shared["y"], fit_w, task["hyper"],
+                    shared["aux"])
+
+        def init(shared, task):
+            X, y, w, hyper, aux = fit_args(shared, task)
+            return fit_init(X, y, w, hyper, aux)
+
+        def step(shared, task, carry):
+            X, y, w, hyper, aux = fit_args(shared, task)
+            return fit_step(X, y, w, hyper, carry, aux)
+
+        def finalize(shared, task, carry):
+            X, y, w, hyper, aux = fit_args(shared, task)
+            params = fit_fin(X, y, w, hyper, carry, aux)
+            train_w = shared["train_masks"][task["split"]]
+            test_w = shared["test_masks"][task["split"]]
+            outputs = {"decision": decision_kernel(params, X)}
+            outputs["predict"] = outputs["decision"]
+            if proba_kernel is not None:
+                outputs["proba"] = proba_kernel(params, X)
+            scores = {}
+            for out_name, _metric, score_kernel, kind in scorer_specs:
+                scores[f"test_{out_name}"] = score_kernel(
+                    y, outputs[kind], test_w, meta
+                )
+                if return_train_score:
+                    scores[f"train_{out_name}"] = score_kernel(
+                        y, outputs[kind], train_w, meta
+                    )
+            return scores
+
+        return {"init": init, "step": step, "finalize": finalize,
+                "keys": ks["finalize_keys"]}
+
+    parts = compile_cache.kernel_memo(key, build)
+    spec = IterativeKernelSpec(
+        parts["init"], parts["step"], parts["finalize"], parts["keys"],
+        fallback=fallback, fallback_cache_key=fallback_key,
+    )
+    return spec, key
 
 
 def _build_cv_kernel(est_cls, meta, static, scorer_specs, return_train_score):
@@ -622,7 +726,8 @@ class DistBaseSearchCV(BaseEstimator):
                 # through the host path so the error_score contract
                 # (raise vs numeric substitute) applies per task
                 return None
-            static = _freeze(bucket_est._static_config(meta))
+            static_cfg = bucket_est._static_config(meta)
+            static = _freeze(static_cfg)
             kernel_key = _cv_kernel_key(
                 est_cls, meta, static, scorer_specs, self.return_train_score
             )
@@ -660,14 +765,52 @@ class DistBaseSearchCV(BaseEstimator):
                 },
                 "split": np.asarray(split_ids, dtype=np.int32),
             }
-            round_size = parse_partitions(self.partitions, len(split_ids))
-            scores, round_timings = backend.batched_map(
-                kernel, task_args, shared, round_size=round_size,
-                shared_specs=row_sharded_specs(
-                    backend, shared, _CV_SAMPLE_AXES
-                ),
-                return_timings=True, cache_key=kernel_key,
+            specs = row_sharded_specs(backend, shared, _CV_SAMPLE_AXES)
+            n_bucket = len(split_ids)
+            # convergence-compacted path: iteration-sliced solvers +
+            # live-task compaction, for families that support sliced
+            # fits on buckets big enough to span several rounds
+            n_slice = iterative_fit_supported(
+                backend, est_cls, n_bucket, static_cfg.get("max_iter")
             )
+            inv = None
+            if n_slice is not None:
+                # cost-ordered round packing (iterative path only: the
+                # classic fused program is order-insensitive, and
+                # keeping it untouched pins its bitwise behaviour)
+                order = _cost_order(
+                    est_cls, task_args["hyper"], task_args["split"]
+                )
+                if order is not None:
+                    task_args = {
+                        "hyper": {
+                            k: v[order]
+                            for k, v in task_args["hyper"].items()
+                        },
+                        "split": task_args["split"][order],
+                    }
+                    inv = np.argsort(order)
+                spec, iter_key = _cv_iterative_spec(
+                    est_cls, meta, static, scorer_specs,
+                    self.return_train_score, n_slice,
+                    fallback=kernel, fallback_key=kernel_key,
+                )
+                round_size = (
+                    None if self.partitions in ("auto", None)
+                    else parse_partitions(self.partitions, n_bucket)
+                )
+                scores, round_timings = backend.batched_map_iterative(
+                    spec, task_args, shared, round_size=round_size,
+                    shared_specs=specs, return_timings=True,
+                    cache_key=iter_key,
+                )
+            else:
+                round_size = parse_partitions(self.partitions, n_bucket)
+                scores, round_timings = backend.batched_map(
+                    kernel, task_args, shared, round_size=round_size,
+                    shared_specs=specs,
+                    return_timings=True, cache_key=kernel_key,
+                )
             # per-task fit_time = its round's measured wall / tasks in
             # that round (fit+score run fused in one kernel, so the
             # whole round wall is recorded as fit_time; score_time is
@@ -677,6 +820,12 @@ class DistBaseSearchCV(BaseEstimator):
                 np.full(keep, wall / max(keep, 1))
                 for wall, keep in round_timings
             ]) if round_timings else np.zeros(len(split_ids))
+            if inv is not None:
+                # undo the cost permutation BEFORE unpacking so
+                # cv_results_ rows keep candidate order (round packing
+                # is a scheduler detail, invisible in the artifact)
+                scores = {k: np.asarray(v)[inv] for k, v in scores.items()}
+                per_task_time = per_task_time[inv]
             # unpack into global task order
             t = 0
             for cand_idx in cand_indices:
@@ -955,7 +1104,6 @@ class DistMultiModelSearch(BaseEstimator):
         self.n_jobs = n_jobs
 
     def fit(self, X, y=None, groups=None, **fit_params):
-        import pandas as pd
         from sklearn.model_selection import check_cv
 
         check_estimator_backend(self, self.verbose)
@@ -971,8 +1119,11 @@ class DistMultiModelSearch(BaseEstimator):
                                   random_state=self.random_state)
 
         # evaluate model-by-model through the shared scheduler: each
-        # model's candidates batch on device when possible
-        rows = []
+        # model's candidates batch on device when possible; per-model
+        # results come back in the FULL sklearn schema via the shared
+        # _format_results (per-split columns, mean/std, fit/score
+        # times, masked param arrays)
+        per_model = []
         for index, (name, estimator, _dists) in enumerate(models):
             cands = [p["param_set"] for p in param_sets
                      if p["model_index"] == index]
@@ -993,60 +1144,37 @@ class DistMultiModelSearch(BaseEstimator):
             out = shim._run_search_tasks(
                 backend, estimator, X, y, cands, splits, scorers, fit_params
             )
-            scores = np.asarray(
-                [o["test_score"] for o in out], dtype=np.float64
-            ).reshape(len(cands), n_splits)
-            for pi, cand in enumerate(cands):
-                rows.append({
-                    "model_index": index,
-                    "params_index": pi,
-                    "param_set": cand,
-                    "score": scores[pi].mean(),
-                })
+            per_model.append((
+                index, name, cands,
+                shim._format_results(cands, scorers, n_splits, out),
+            ))
 
-        results = pd.DataFrame(
-            rows, columns=["model_index", "params_index", "param_set", "score"]
-        )
-        model_results = (
-            results.groupby("model_index")["score"].max().reset_index()
-            .sort_values("model_index")
-        )
-        if self.verbose:
-            print(model_results)
-
-        score_vals = results["score"].values.astype(float)
-        if np.all(np.isnan(score_vals)):
+        results = self._merge_model_results(per_model, n_splits)
+        score_vals = np.asarray(results["mean_test_score"], dtype=float)
+        if score_vals.size == 0 or np.all(np.isnan(score_vals)):
             raise RuntimeError(
                 "All candidate fits failed (every score is NaN)."
             )
+        if self.verbose:
+            for index, name, cands, full in per_model:
+                seg = np.asarray(full["mean_test_score"], dtype=float)
+                best = (
+                    float(np.nanmax(seg)) if not np.all(np.isnan(seg))
+                    else float("nan")
+                )
+                print(f"model_index={index} ({name}): "
+                      f"best score {best:.6f}")
         best_index = int(np.nanargmax(score_vals))
-        self.best_model_index_ = int(results.iloc[best_index]["model_index"])
+        self.best_index_ = best_index
+        self.best_model_index_ = int(results["model_index"][best_index])
         self.best_model_name_ = models[self.best_model_index_][0]
-        self.best_params_ = results.iloc[best_index]["param_set"]
-        self.best_score_ = float(results.iloc[best_index]["score"])
+        self.best_params_ = results["params"][best_index]
+        self.best_score_ = float(score_vals[best_index])
         # the reference set worst_score_ = best_score_ (a known bug,
         # search.py:836-837); we record the actual worst
         self.worst_score_ = float(np.nanmin(score_vals))
-
-        results = results.copy()
-        # method="min" for sklearn-style integer ranks on ties (the base
-        # search already did this; reference search.py:481-484)
-        results["rank_test_score"] = np.asarray(
-            rankdata(
-                -_nan_as_worst(results["score"].values.astype(float)),
-                method="min",
-            ),
-            dtype=np.int32,
-        )
-        results["mean_test_score"] = results["score"]
-        results["params"] = results["param_set"]
-        results["model_name"] = results["model_index"].map(
-            lambda i: models[i][0]
-        )
-        self.cv_results_ = results[[
-            "model_index", "model_name", "params", "rank_test_score",
-            "mean_test_score",
-        ]].to_dict(orient="list")
+        self.cv_results_ = results
+        self.n_splits_ = n_splits
 
         if self.refit:
             best = clone(models[self.best_model_index_][1])
@@ -1061,6 +1189,64 @@ class DistMultiModelSearch(BaseEstimator):
         ]
         strip_runtime(self)
         return self
+
+    @staticmethod
+    def _merge_model_results(per_model, n_splits):
+        """Stack the per-model ``_format_results`` dicts into ONE
+        cross-model cv_results_ (sklearn schema + ``model_name`` /
+        ``model_index``): numeric columns concatenate in model order,
+        ``param_*`` masked arrays take the union of parameter names
+        (masked where a model lacks the param), and ``rank_test_score``
+        re-ranks across ALL models' candidates."""
+        n_total = sum(len(cands) for _, _, cands, _ in per_model)
+        num_keys = [
+            "mean_fit_time", "std_fit_time", "mean_score_time",
+            "std_score_time", "mean_test_score", "std_test_score",
+        ] + [f"split{i}_test_score" for i in range(n_splits)]
+        results = {
+            key: np.concatenate([
+                np.asarray(full[key], dtype=np.float64)
+                for _, _, _, full in per_model
+            ]) if per_model else np.empty(0)
+            for key in num_keys
+        }
+        param_cols = {}
+        params_list, names, model_idx = [], [], []
+        offset = 0
+        for index, name, cands, full in per_model:
+            m = len(cands)
+            for key, arr in full.items():
+                if not key.startswith("param_"):
+                    continue
+                col = param_cols.get(key)
+                if col is None:
+                    col = MaskedArray(
+                        np.empty(n_total, dtype=object), mask=True
+                    )
+                    param_cols[key] = col
+                for j in range(m):
+                    if not np.ma.getmaskarray(arr)[j]:
+                        col[offset + j] = arr[j]
+            params_list.extend(full["params"])
+            names.extend([name] * m)
+            model_idx.extend([index] * m)
+            offset += m
+        results.update(param_cols)
+        results["params"] = params_list
+        results["model_name"] = names
+        results["model_index"] = model_idx
+        # method="min" for sklearn-style integer ranks on ties (the base
+        # search already did this; reference search.py:481-484)
+        results["rank_test_score"] = np.asarray(
+            rankdata(
+                -_nan_as_worst(
+                    np.asarray(results["mean_test_score"], dtype=float)
+                ),
+                method="min",
+            ),
+            dtype=np.int32,
+        ) if n_total else np.empty(0, dtype=np.int32)
+        return results
 
     # -- post-fit delegation -------------------------------------------
     def _check_is_fitted(self):

@@ -26,7 +26,14 @@ import jax
 import jax.numpy as jnp
 
 from ..base import BaseEstimator, ClassifierMixin, RegressorMixin
-from .solvers import lbfgs_minimize, sgd_minimize
+from .solvers import (
+    lbfgs_carry_init,
+    lbfgs_minimize,
+    lbfgs_resume,
+    sgd_carry_init,
+    sgd_minimize,
+    sgd_resume,
+)
 
 __all__ = [
     "LogisticRegression",
@@ -481,11 +488,95 @@ class _LinearClassifierBase(_LinearModelBase, ClassifierMixin):
         return self.classes_[idx]
 
 
+class _LbfgsFitMixin:
+    """Fit kernels for the L-BFGS linear family, derived from one
+    ``_build_fit_problem(meta, static)`` definition of the objective.
+
+    ``_build_fit_problem`` returns ``problem(X, y_idx, sw, hyper) ->
+    (loss, w0, unpack)`` where ``unpack(w, n_iter)`` shapes the fitted
+    params dict. The plain fit kernel and the iteration-sliced variant
+    (``_build_fit_slice_kernels`` — the convergence-compacted
+    scheduler's contract) are both generated from it, so the two
+    execution forms minimise the *same traced objective* and the sliced
+    run is bitwise identical to the unsliced solve (see
+    ``models/solvers.py``)."""
+
+    #: batched-path marker consulted by the scheduler gates
+    _supports_sliced_fit = True
+
+    @classmethod
+    def _batched_task_cost(cls, hyper):
+        """Per-task convergence-cost heuristic for round packing
+        (``hyper``: dict of per-task f32 arrays). L-BFGS family: weak
+        regularisation (large C) and tight tolerance both mean more
+        iterations — log-additive so neither axis drowns the other;
+        ``tol <= 0`` (the tol=None → -inf mapping) never converges and
+        sorts last."""
+        C = np.asarray(hyper.get("C", 1.0), dtype=np.float64)
+        tol = np.asarray(hyper.get("tol", 1e-4), dtype=np.float64)
+        # log only on the positive mask: tol=-inf (the tol=None
+        # mapping) must select -inf via where, not evaluate log(-inf)
+        cost = np.log(np.maximum(C, 1e-30)) - np.where(
+            tol > 0, np.log(np.where(tol > 0, tol, 1.0)), -np.inf
+        )
+        return np.broadcast_to(cost, np.broadcast_shapes(C.shape, tol.shape))
+
+    @classmethod
+    def _build_fit_kernel(cls, meta, static):
+        problem = cls._build_fit_problem(meta, static)
+        st = dict(static)
+        max_iter, hist = st["max_iter"], st["history"]
+
+        def kernel(X, y_idx, sw, hyper, aux=None):
+            loss, w0, unpack = problem(X, y_idx, sw, hyper)
+            w, n_iter = lbfgs_minimize(loss, w0, max_iter=max_iter,
+                                       tol=hyper["tol"], history=hist)
+            return unpack(w, n_iter)
+
+        return kernel
+
+    @classmethod
+    def _build_fit_slice_kernels(cls, meta, static, n_slice):
+        """Iteration-sliced fit: ``init`` starts the solve and runs the
+        first ``n_slice`` iterations, ``step`` advances a carry by
+        another slice, ``finalize`` shapes the fitted params from the
+        (w, it) carry leaves. The carry is the solver's dict pytree —
+        its ``done`` leaf is the flags-only gather the backend's
+        compaction loop reads."""
+        problem = cls._build_fit_problem(meta, static)
+        st = dict(static)
+        max_iter, hist = st["max_iter"], st["history"]
+        n_slice = int(n_slice)
+
+        def init(X, y_idx, sw, hyper, aux=None):
+            loss, w0, _ = problem(X, y_idx, sw, hyper)
+            carry = lbfgs_carry_init(loss, w0, max_iter=max_iter,
+                                     tol=hyper["tol"], history=hist)
+            return lbfgs_resume(loss, carry, n_slice, max_iter=max_iter,
+                                tol=hyper["tol"], history=hist)
+
+        def step(X, y_idx, sw, hyper, carry, aux=None):
+            loss, _, _ = problem(X, y_idx, sw, hyper)
+            return lbfgs_resume(loss, carry, n_slice, max_iter=max_iter,
+                                tol=hyper["tol"], history=hist)
+
+        def finalize(X, y_idx, sw, hyper, carry, aux=None):
+            _, _, unpack = problem(X, y_idx, sw, hyper)
+            return unpack(carry["w"], carry["it"])
+
+        return {
+            "init": init, "step": step, "finalize": finalize,
+            # finalize touches only these carry leaves: retired lanes'
+            # S/Y/rho history never needs to leave the device
+            "finalize_keys": ("w", "it"),
+        }
+
+
 # --------------------------------------------------------------------------
 # LogisticRegression
 # --------------------------------------------------------------------------
 
-class LogisticRegression(_LinearClassifierBase):
+class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
     """L2 multinomial / binary logistic regression via jittable L-BFGS.
 
     sklearn-compatible surface; objective matches sklearn
@@ -593,11 +684,10 @@ class LogisticRegression(_LinearClassifierBase):
         return self
 
     @classmethod
-    def _build_fit_kernel(cls, meta, static):
+    def _build_fit_problem(cls, meta, static):
         st = dict(static)
         k = meta["n_classes"]
         fit_intercept = st["fit_intercept"]
-        max_iter, hist = st["max_iter"], st["history"]
         class_weight, cw_arr = st["class_weight"], meta.get("cw_arr")
         binary = k <= 2
 
@@ -617,9 +707,8 @@ class LogisticRegression(_LinearClassifierBase):
         unpenalized = penalty in (None, "none")
         bf16 = md == "bfloat16"
 
-        def kernel(X, y_idx, sw, hyper, aux=None):
+        def problem(X, y_idx, sw, hyper):
             C = hyper["C"]
-            tol = hyper["tol"]
             Xa = _augment(X, fit_intercept)
             p = Xa.shape[1]
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
@@ -653,9 +742,11 @@ class LogisticRegression(_LinearClassifierBase):
                     return ce + reg
 
                 w0 = jnp.zeros(p, X.dtype)
-                w, n_iter = lbfgs_minimize(loss, w0, max_iter=max_iter,
-                                           tol=tol, history=hist)
-                return {"W": w, "n_iter": n_iter}
+
+                def unpack(w, n_iter):
+                    return {"W": w, "n_iter": n_iter}
+
+                return loss, w0, unpack
 
             onehot = jax.nn.one_hot(y_idx, k, dtype=X.dtype)
 
@@ -670,11 +761,13 @@ class LogisticRegression(_LinearClassifierBase):
                 return ce + reg
 
             w0 = jnp.zeros(p * k, X.dtype)
-            w, n_iter = lbfgs_minimize(loss, w0, max_iter=max_iter,
-                                       tol=tol, history=hist)
-            return {"W": w.reshape(p, k), "n_iter": n_iter}
 
-        return kernel
+            def unpack(w, n_iter):
+                return {"W": w.reshape(p, k), "n_iter": n_iter}
+
+            return loss, w0, unpack
+
+        return problem
 
     @classmethod
     def _build_decision_kernel(cls, meta, static):
@@ -720,7 +813,7 @@ class LogisticRegression(_LinearClassifierBase):
 # LinearSVC (squared hinge, OvR)
 # --------------------------------------------------------------------------
 
-class LinearSVC(_LinearClassifierBase):
+class LinearSVC(_LbfgsFitMixin, _LinearClassifierBase):
     """L2-regularised squared-hinge linear SVM (primal, L-BFGS).
 
     Multiclass is one-vs-rest with all class columns solved jointly in a
@@ -787,12 +880,11 @@ class LinearSVC(_LinearClassifierBase):
         return self
 
     @classmethod
-    def _build_fit_kernel(cls, meta, static):
+    def _build_fit_problem(cls, meta, static):
         st = dict(static)
         k = meta["n_classes"]
         d = meta["n_features"]
         fit_intercept = st["fit_intercept"]
-        max_iter, hist = st["max_iter"], st["history"]
         class_weight, cw_arr = st["class_weight"], meta.get("cw_arr")
         binary = k <= 2
 
@@ -805,9 +897,8 @@ class LinearSVC(_LinearClassifierBase):
             # not silently fit squared hinge (ADVICE r05 #3)
             raise ValueError("LinearSVC supports loss='squared_hinge'")
 
-        def kernel(X, y_idx, sw, hyper, aux=None):
+        def problem(X, y_idx, sw, hyper):
             C = hyper["C"]
-            tol = hyper["tol"]
             Xa = _augment(X, fit_intercept)
             p = Xa.shape[1]
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
@@ -819,9 +910,11 @@ class LinearSVC(_LinearClassifierBase):
                     return 0.5 * jnp.dot(w[:d], w[:d]) + C * jnp.sum(sw * margin**2)
 
                 w0 = jnp.zeros(p, X.dtype)
-                w, n_iter = lbfgs_minimize(loss, w0, max_iter=max_iter,
-                                           tol=tol, history=hist)
-                return {"W": w, "n_iter": n_iter}
+
+                def unpack(w, n_iter):
+                    return {"W": w, "n_iter": n_iter}
+
+                return loss, w0, unpack
 
             Ypm = jnp.where(jax.nn.one_hot(y_idx, k) > 0, 1.0, -1.0).astype(X.dtype)
 
@@ -832,11 +925,13 @@ class LinearSVC(_LinearClassifierBase):
                 return 0.5 * jnp.sum(W[:d] * W[:d]) + C * hinge
 
             w0 = jnp.zeros(p * k, X.dtype)
-            w, n_iter = lbfgs_minimize(loss, w0, max_iter=max_iter,
-                                       tol=tol, history=hist)
-            return {"W": w.reshape(p, k), "n_iter": n_iter}
 
-        return kernel
+            def unpack(w, n_iter):
+                return {"W": w.reshape(p, k), "n_iter": n_iter}
+
+            return loss, w0, unpack
+
+        return problem
 
     _build_decision_kernel = LogisticRegression._build_decision_kernel
 
@@ -900,8 +995,32 @@ class SGDClassifier(_LinearClassifierBase):
         self.batch_size = batch_size
         self.n_iter_no_change = n_iter_no_change
 
+    _supports_sliced_fit = True
+
     @classmethod
-    def _build_fit_kernel(cls, meta, static):
+    def _batched_task_cost(cls, hyper):
+        """Round-packing cost heuristic: weak regularisation (small
+        ``alpha``) and tight ``tol`` both mean more epochs before the
+        no-improvement rule fires; ``tol <= 0`` (tol=None → -inf) never
+        stops early and sorts last."""
+        alpha = np.asarray(hyper.get("alpha", 1e-4), dtype=np.float64)
+        tol = np.asarray(hyper.get("tol", 1e-3), dtype=np.float64)
+        # log only on the positive mask (see _LbfgsFitMixin)
+        cost = -np.log(np.maximum(alpha, 1e-30)) - np.where(
+            tol > 0, np.log(np.where(tol > 0, tol, 1.0)), -np.inf
+        )
+        return np.broadcast_to(
+            cost, np.broadcast_shapes(alpha.shape, tol.shape)
+        )
+
+    @classmethod
+    def _build_fit_problem(cls, meta, static):
+        """Everything the SGD solve needs, built once per (meta,
+        static): ``problem(X, y_idx, sw, hyper)`` returns a dict with
+        the gradient/loss/schedule closures, the initial weights and
+        post-step state, and ``unpack`` — consumed identically by the
+        plain fit kernel (``sgd_minimize``) and the iteration-sliced
+        variant (``sgd_carry_init``/``sgd_resume``)."""
         st = dict(static)
         k = meta["n_classes"]
         d = meta["n_features"]
@@ -916,7 +1035,6 @@ class SGDClassifier(_LinearClassifierBase):
             raise ValueError(
                 f"n_iter_no_change must be >= 1; got {n_iter_no_change}"
             )
-        seed = st["random_state"] or 0
         class_weight, cw_arr = st["class_weight"], meta.get("cw_arr")
         n_out = 1 if k <= 2 else k
 
@@ -934,11 +1052,12 @@ class SGDClassifier(_LinearClassifierBase):
                 raise ValueError(f"unsupported loss {loss_name!r}")
             return dloss
 
-        def kernel(X, y_idx, sw, hyper, aux=None):
+        seed = st["random_state"] or 0
+
+        def problem(X, y_idx, sw, hyper):
             alpha = hyper["alpha"]
             eta0 = hyper["eta0"]
             l1_ratio = hyper["l1_ratio"]
-            tol = hyper["tol"]
             n = X.shape[0]
             Xa = _augment(X, fit_intercept)
             p = Xa.shape[1]
@@ -1004,7 +1123,6 @@ class SGDClassifier(_LinearClassifierBase):
                 def lr_fn(t):
                     return eta0 * jnp.ones_like(t, jnp.float32)
 
-            key = jax.random.PRNGKey(seed)
             W0 = jnp.zeros(p * n_out, X.dtype)
 
             if penalty in ("l1", "elasticnet"):
@@ -1039,25 +1157,81 @@ class SGDClassifier(_LinearClassifierBase):
                     W = W.at[:d].set(w_trunc)
                     return W.reshape(-1), (u, Q.reshape(-1))
 
-                W, n_epochs = sgd_minimize(
-                    grad_fn, W0, n, key, max_iter, batch_size,
-                    lr_fn, loss_fn=loss_fn, tol=tol,
-                    n_iter_no_change=n_iter_no_change,
-                    post_step=post_step,
-                    post_state=(jnp.float32(0.0), jnp.zeros_like(W0)),
-                )
+                post_state = (jnp.float32(0.0), jnp.zeros_like(W0))
             else:
-                W, n_epochs = sgd_minimize(
-                    grad_fn, W0, n, key, max_iter, batch_size, lr_fn,
-                    loss_fn=loss_fn, tol=tol,
-                    n_iter_no_change=n_iter_no_change,
-                )
-            W = W.reshape(p, n_out)
-            if n_out == 1:
-                W = W[:, 0]
-            return {"W": W, "n_iter": n_epochs}
+                post_step, post_state = None, ()
+
+            def unpack(W, n_epochs):
+                W = W.reshape(p, n_out)
+                if n_out == 1:
+                    W = W[:, 0]
+                return {"W": W, "n_iter": n_epochs}
+
+            return {
+                "grad_fn": grad_fn, "loss_fn": loss_fn, "lr_fn": lr_fn,
+                "post_step": post_step, "post_state": post_state,
+                "W0": W0, "n": n, "key": jax.random.PRNGKey(seed),
+                "unpack": unpack,
+            }
+
+        return problem
+
+    @classmethod
+    def _build_fit_kernel(cls, meta, static):
+        problem = cls._build_fit_problem(meta, static)
+        st = dict(static)
+        max_iter, batch_size = st["max_iter"], st["batch_size"]
+        n_iter_no_change = int(st["n_iter_no_change"])
+
+        def kernel(X, y_idx, sw, hyper, aux=None):
+            pb = problem(X, y_idx, sw, hyper)
+            W, n_epochs = sgd_minimize(
+                pb["grad_fn"], pb["W0"], pb["n"], pb["key"], max_iter,
+                batch_size, pb["lr_fn"], loss_fn=pb["loss_fn"],
+                tol=hyper["tol"], n_iter_no_change=n_iter_no_change,
+                post_step=pb["post_step"], post_state=pb["post_state"],
+            )
+            return pb["unpack"](W, n_epochs)
 
         return kernel
+
+    @classmethod
+    def _build_fit_slice_kernels(cls, meta, static, n_slice):
+        """Epoch-sliced SGD fit (the convergence-compacted scheduler's
+        contract; slice unit = one epoch): same closures, carries
+        advanced by ``sgd_resume`` — bitwise identical to the unsliced
+        scan (stopped lanes and overhanging tails freeze in place)."""
+        problem = cls._build_fit_problem(meta, static)
+        st = dict(static)
+        max_iter, batch_size = st["max_iter"], st["batch_size"]
+        n_iter_no_change = int(st["n_iter_no_change"])
+        n_slice = int(n_slice)
+
+        def resume(pb, carry, hyper):
+            return sgd_resume(
+                pb["grad_fn"], carry, n_slice, pb["n"], pb["key"],
+                max_iter, batch_size, pb["lr_fn"], loss_fn=pb["loss_fn"],
+                tol=hyper["tol"], n_iter_no_change=n_iter_no_change,
+                post_step=pb["post_step"],
+            )
+
+        def init(X, y_idx, sw, hyper, aux=None):
+            pb = problem(X, y_idx, sw, hyper)
+            carry = sgd_carry_init(pb["W0"], pb["post_state"])
+            return resume(pb, carry, hyper)
+
+        def step(X, y_idx, sw, hyper, carry, aux=None):
+            pb = problem(X, y_idx, sw, hyper)
+            return resume(pb, carry, hyper)
+
+        def finalize(X, y_idx, sw, hyper, carry, aux=None):
+            pb = problem(X, y_idx, sw, hyper)
+            return pb["unpack"](carry["w"], carry["n_done"])
+
+        return {
+            "init": init, "step": step, "finalize": finalize,
+            "finalize_keys": ("w", "n_done"),
+        }
 
     _build_decision_kernel = LogisticRegression._build_decision_kernel
 

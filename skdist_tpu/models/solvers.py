@@ -15,6 +15,20 @@ Design notes for TPU:
   steps all lanes until every lane's predicate is false)
 - all dot products are on flat f32 vectors; the heavy lifting (loss and
   gradient) is the caller's X @ W matmuls, which land on the MXU
+
+Resumable carry form (convergence-compacted scheduling): both solvers
+also expose an explicit carry-in/carry-out API —
+``lbfgs_carry_init`` / ``lbfgs_resume`` and ``sgd_carry_init`` /
+``sgd_resume`` — so a solve can run in bounded iteration *slices* and
+resume exactly where it left off. The carries are plain dict pytrees
+(every leaf a fixed-shape array), so a vmapped batch of carries is a
+batch of arrays the fan-out backend can gather, compact to the
+still-running lanes, and re-dispatch. ``lbfgs_minimize`` /
+``sgd_minimize`` are themselves implemented as init + one full-length
+resume, which is what makes a sliced run *bitwise identical* to the
+unsliced solve: both apply the same traced body the same number of
+times to the same carried state — slicing only changes where the host
+observes the carry.
 """
 
 import jax
@@ -23,18 +37,16 @@ from jax import lax
 
 _EPS = 1e-12
 
+#: order of the L-BFGS carry leaves (the ISSUE-pinned pytree contract)
+LBFGS_CARRY_KEYS = ("w", "f", "g", "S", "Y", "rho", "k", "it", "done")
 
-def lbfgs_minimize(fun, w0, max_iter=100, tol=1e-4, history=10, max_ls=20):
-    """Minimise ``fun(w) -> scalar`` from ``w0`` (flat vector).
 
-    Returns ``(w, n_iter)``. Convergence: ``max|grad| <= tol`` (the same
-    criterion sklearn passes to scipy's lbfgs as ``gtol``).
-    """
-    value_and_grad = jax.value_and_grad(fun)
-    p = w0.shape[0]
+def _lbfgs_body(fun, value_and_grad, max_iter, tol, history, max_ls):
+    """One L-BFGS iteration on the tuple state
+    ``(w, f, g, S, Y, rho, k, it, done)`` — shared verbatim by the
+    unsliced solve and every resume slice, so their trajectories cannot
+    diverge."""
     m = history
-
-    f0, g0 = value_and_grad(w0)
 
     def two_loop(g, S, Y, rho, k):
         n_corr = jnp.minimum(k, m)
@@ -84,10 +96,6 @@ def lbfgs_minimize(fun, w0, max_iter=100, tol=1e-4, history=10, max_ls=20):
         ok = f_new <= f + 1e-4 * t * gd
         return t, f_new, ok
 
-    def cond(state):
-        _, _, _, _, _, _, _, it, done = state
-        return jnp.logical_and(it < max_iter, ~done)
-
     def body(state):
         w, f, g, S, Y, rho, k, it, done = state
         d = two_loop(g, S, Y, rho, k)
@@ -120,16 +128,184 @@ def lbfgs_minimize(fun, w0, max_iter=100, tol=1e-4, history=10, max_ls=20):
         k_new = k + jnp.where(store, 1, 0)
         converged = jnp.max(jnp.abs(g_new)) <= tol
         stalled = ~ok  # line search failed to find decrease
-        return (w_new, f_new2, g_new, S, Y, rho, k_new, it + 1,
-                converged | stalled)
+        # ``done`` also latches the iteration cap so the flag alone
+        # answers "will more steps change this lane?" — what the
+        # backend's flags-only compaction gather reads
+        done_new = converged | stalled | (it + 1 >= max_iter)
+        return (w_new, f_new2, g_new, S, Y, rho, k_new, it + 1, done_new)
 
-    S = jnp.zeros((m, p), w0.dtype)
-    Y = jnp.zeros((m, p), w0.dtype)
-    rho = jnp.zeros(m, w0.dtype)
-    done0 = jnp.max(jnp.abs(g0)) <= tol
-    state = (w0, f0, g0, S, Y, rho, jnp.array(0), jnp.array(0), done0)
-    w, _, _, _, _, _, _, it, _ = lax.while_loop(cond, body, state)
-    return w, it
+    return body
+
+
+def lbfgs_carry_init(fun, w0, max_iter=100, tol=1e-4, history=10):
+    """Initial L-BFGS carry for ``fun(w) -> scalar`` from ``w0``.
+
+    The carry is a dict pytree over :data:`LBFGS_CARRY_KEYS`; feed it to
+    :func:`lbfgs_resume` to advance it. ``done`` is True when no further
+    step can change the lane (converged at ``tol``, line-search stall,
+    or ``max_iter`` reached)."""
+    value_and_grad = jax.value_and_grad(fun)
+    p = w0.shape[0]
+    m = history
+    f0, g0 = value_and_grad(w0)
+    done0 = (jnp.max(jnp.abs(g0)) <= tol) | jnp.asarray(max_iter <= 0)
+    return dict(zip(LBFGS_CARRY_KEYS, (
+        w0, f0, g0,
+        jnp.zeros((m, p), w0.dtype),
+        jnp.zeros((m, p), w0.dtype),
+        jnp.zeros(m, w0.dtype),
+        jnp.array(0), jnp.array(0), done0,
+    )))
+
+
+def lbfgs_resume(fun, carry, n_steps, max_iter=100, tol=1e-4, history=10,
+                 max_ls=20):
+    """Advance an L-BFGS carry by at most ``n_steps`` iterations.
+
+    Applies the exact iteration body of :func:`lbfgs_minimize` (they
+    share one closure), stopping early when the lane converges/stalls
+    or hits ``max_iter``. ``n_steps >= max_iter`` therefore runs the
+    solve to completion in one call — which is precisely how
+    ``lbfgs_minimize`` is implemented, making chained short resumes
+    bitwise identical to the unsliced solve."""
+    value_and_grad = jax.value_and_grad(fun)
+    body = _lbfgs_body(fun, value_and_grad, max_iter, tol, history, max_ls)
+    state = tuple(carry[k] for k in LBFGS_CARRY_KEYS)
+
+    def cond_j(state_j):
+        (_, _, _, _, _, _, _, it, done), j = state_j
+        return (j < n_steps) & (it < max_iter) & ~done
+
+    def body_j(state_j):
+        state, j = state_j
+        return body(state), j + 1
+
+    state, _ = lax.while_loop(cond_j, body_j, (state, jnp.array(0)))
+    return dict(zip(LBFGS_CARRY_KEYS, state))
+
+
+def lbfgs_minimize(fun, w0, max_iter=100, tol=1e-4, history=10, max_ls=20):
+    """Minimise ``fun(w) -> scalar`` from ``w0`` (flat vector).
+
+    Returns ``(w, n_iter)``. Convergence: ``max|grad| <= tol`` (the same
+    criterion sklearn passes to scipy's lbfgs as ``gtol``). Implemented
+    as :func:`lbfgs_carry_init` + one full-length
+    :func:`lbfgs_resume`, so iteration-sliced runs share its exact
+    trajectory."""
+    carry = lbfgs_carry_init(fun, w0, max_iter=max_iter, tol=tol,
+                             history=history)
+    carry = lbfgs_resume(fun, carry, max_iter, max_iter=max_iter, tol=tol,
+                         history=history, max_ls=max_ls)
+    return carry["w"], carry["it"]
+
+
+# ---------------------------------------------------------------------------
+# SGD
+# ---------------------------------------------------------------------------
+
+#: order of the SGD carry leaves (``pstate`` is the post_step pytree)
+SGD_CARRY_KEYS = ("w", "pstate", "step", "best", "bad", "n_done", "it",
+                  "done")
+
+
+def _sgd_epoch_body(grad_fn, keys, n_samples, max_epochs, batch_size,
+                    learning_rate_fn, shuffle, loss_fn, tol,
+                    n_iter_no_change, post_step):
+    """One SGD epoch on the tuple state
+    ``(w, pstate, step, best, bad, n_done, it, done)``, keyed by the
+    *global* epoch index (``it``-relative) so a resumed slice draws the
+    same shuffles the unsliced scan would. Shared by the unsliced solve
+    and every slice."""
+    n_batches = -(-n_samples // batch_size)
+    padded = n_batches * batch_size
+    track = loss_fn is not None and tol is not None
+
+    def epoch(carry, e):
+        w, pstate, step, best, bad, n_done, it, done = carry
+        # global epoch index -> the SAME per-epoch key as the unsliced
+        # scan; clamped for overhanging slice tails (frozen below)
+        ekey = keys[jnp.minimum(e, max_epochs - 1)]
+        if shuffle:
+            perm = jax.random.permutation(ekey, padded) % n_samples
+        else:
+            perm = jnp.arange(padded) % n_samples
+        batches = perm.reshape(n_batches, batch_size)
+
+        def one(carry, idx):
+            w, pstate, step, acc = carry
+            g = grad_fn(w, idx)
+            lr = learning_rate_fn(step)
+            w_new = w - lr * g
+            if post_step is not None:
+                w_new, pstate = post_step(w_new, pstate, lr)
+            if track:
+                acc = acc + loss_fn(w_new, idx)
+            return (w_new, pstate, step + 1, acc), None
+
+        (w_new, pstate_new, step_new, acc), _ = lax.scan(
+            one, (w, pstate, step, jnp.float32(0.0)), batches
+        )
+        # frozen lanes keep everything: early-stopped lanes, and every
+        # lane of an epoch index past max_epochs (a slice tail that
+        # overhangs the cap — the unsliced scan never reaches it)
+        keep = done | (e >= max_epochs)
+
+        def pick(a, b):
+            return jnp.where(keep, a, b)
+
+        if track:
+            loss = acc / n_batches
+            improved = loss < best - tol
+            bad_new = jnp.where(improved, 0, bad + 1)
+            newly_stopped = bad_new >= n_iter_no_change
+            best_new = jnp.minimum(best, loss)
+        else:
+            bad_new = bad
+            newly_stopped = jnp.asarray(False)
+            best_new = best
+        it_new = jnp.where(e >= max_epochs, it, it + 1)
+        done_new = keep | newly_stopped | (it_new >= max_epochs)
+        return (
+            pick(w, w_new),
+            jax.tree_util.tree_map(pick, pstate, pstate_new),
+            pick(step, step_new),
+            pick(best, best_new),
+            pick(bad, bad_new),
+            pick(n_done, n_done + 1),
+            it_new,
+            done_new,
+        ), None
+
+    return epoch
+
+
+def sgd_carry_init(w0, post_state=()):
+    """Initial SGD carry (dict over :data:`SGD_CARRY_KEYS`)."""
+    return dict(zip(SGD_CARRY_KEYS, (
+        w0, post_state, jnp.array(0), jnp.float32(jnp.inf),
+        jnp.array(0), jnp.array(0), jnp.array(0), jnp.array(False),
+    )))
+
+
+def sgd_resume(grad_fn, carry, n_steps, n_samples, key, max_epochs,
+               batch_size, learning_rate_fn, shuffle=True, loss_fn=None,
+               tol=None, n_iter_no_change=5, post_step=None):
+    """Advance an SGD carry by ``n_steps`` epochs (a fixed-shape scan;
+    lanes already stopped — and slice tails overhanging ``max_epochs``
+    — freeze in place, exactly as the unsliced scan freezes stopped
+    lanes). ``key`` must be the same PRNG key every call: per-epoch
+    keys are re-derived from it and indexed by the carry's global epoch
+    clock, so slice boundaries cannot change the shuffle sequence."""
+    keys = jax.random.split(key, max_epochs)
+    epoch = _sgd_epoch_body(
+        grad_fn, keys, n_samples, max_epochs, batch_size,
+        learning_rate_fn, shuffle, loss_fn, tol, n_iter_no_change,
+        post_step,
+    )
+    state = tuple(carry[k] for k in SGD_CARRY_KEYS)
+    it0 = carry["it"]
+    state, _ = lax.scan(epoch, state, it0 + jnp.arange(n_steps))
+    return dict(zip(SGD_CARRY_KEYS, state))
 
 
 def sgd_minimize(grad_fn, w0, n_samples, key, max_epochs, batch_size,
@@ -162,61 +338,16 @@ def sgd_minimize(grad_fn, w0, n_samples, key, max_epochs, batch_size,
     a proximal-style elementwise operation with persistent (u, q)
     state, not a gradient term.
 
-    Returns ``(w, n_epochs_run)``.
+    Implemented as :func:`sgd_carry_init` + one ``max_epochs``-long
+    :func:`sgd_resume`, so iteration-sliced runs share its exact
+    epoch sequence. Returns ``(w, n_epochs_run)``.
     """
-    n_batches = -(-n_samples // batch_size)
-    padded = n_batches * batch_size
-    track = loss_fn is not None and tol is not None
     if post_step is None:
         post_state = ()
-
-    def epoch(carry, ekey):
-        w, pstate, step, best, bad, stopped, n_done = carry
-        if shuffle:
-            perm = jax.random.permutation(ekey, padded) % n_samples
-        else:
-            perm = jnp.arange(padded) % n_samples
-        batches = perm.reshape(n_batches, batch_size)
-
-        def one(carry, idx):
-            w, pstate, step, acc = carry
-            g = grad_fn(w, idx)
-            lr = learning_rate_fn(step)
-            w_new = w - lr * g
-            if post_step is not None:
-                w_new, pstate = post_step(w_new, pstate, lr)
-            if track:
-                acc = acc + loss_fn(w_new, idx)
-            return (w_new, pstate, step + 1, acc), None
-
-        (w_new, pstate_new, step_new, acc), _ = lax.scan(
-            one, (w, pstate, step, jnp.float32(0.0)), batches
-        )
-        if not track:
-            return (w_new, pstate_new, step_new, best, bad, stopped,
-                    n_done + 1), None
-        loss = acc / n_batches
-        improved = loss < best - tol
-        bad_new = jnp.where(improved, 0, bad + 1)
-        newly_stopped = bad_new >= n_iter_no_change
-        # frozen lanes keep everything; live lanes advance and may stop
-        keep = stopped
-
-        def pick(a, b):
-            return jnp.where(keep, a, b)
-
-        return (
-            pick(w, w_new),
-            jax.tree_util.tree_map(pick, pstate, pstate_new),
-            pick(step, step_new),
-            pick(best, jnp.minimum(best, loss)),
-            pick(bad, bad_new),
-            jnp.logical_or(keep, newly_stopped),
-            pick(n_done, n_done + 1),
-        ), None
-
-    keys = jax.random.split(key, max_epochs)
-    state0 = (w0, post_state, jnp.array(0), jnp.float32(jnp.inf),
-              jnp.array(0), jnp.array(False), jnp.array(0))
-    (w, _, _, _, _, _, n_done), _ = lax.scan(epoch, state0, keys)
-    return w, n_done
+    carry = sgd_carry_init(w0, post_state)
+    carry = sgd_resume(
+        grad_fn, carry, max_epochs, n_samples, key, max_epochs,
+        batch_size, learning_rate_fn, shuffle=shuffle, loss_fn=loss_fn,
+        tol=tol, n_iter_no_change=n_iter_no_change, post_step=post_step,
+    )
+    return carry["w"], carry["n_done"]

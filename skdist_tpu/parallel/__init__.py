@@ -8,13 +8,19 @@ every reference estimator is built on (reference ``search.py:411-437``,
 from . import compile_cache
 from .backend import (
     BatchedPlan,
+    IterativeKernelSpec,
+    IterativePlan,
     LocalBackend,
     TPUBackend,
     TaskBackend,
+    compaction_enabled,
     get_value,
+    iterative_chunk_size,
+    iterative_fit_supported,
     parse_partitions,
     prefers_host_engine,
     resolve_backend,
+    resolve_slice_iters,
     row_sharded_specs,
 )
 from .compile_cache import enable_disk_cache, structural_key
@@ -24,9 +30,15 @@ __all__ = [
     "LocalBackend",
     "TPUBackend",
     "BatchedPlan",
+    "IterativeKernelSpec",
+    "IterativePlan",
     "resolve_backend",
     "parse_partitions",
     "prefers_host_engine",
+    "compaction_enabled",
+    "resolve_slice_iters",
+    "iterative_fit_supported",
+    "iterative_chunk_size",
     "get_value",
     "row_sharded_specs",
     "compile_cache",

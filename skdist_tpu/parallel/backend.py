@@ -125,6 +125,30 @@ class TaskBackend:
                         shared_specs=None, cache_key=None):
         raise NotImplementedError
 
+    #: whether batched_map_iterative runs the convergence-compacted
+    #: slice loop on this backend (False falls back to the spec's
+    #: classic kernel)
+    supports_iterative = False
+
+    def batched_map_iterative(self, spec, task_args, shared_args=(),
+                              static_args=None, round_size=None,
+                              shared_specs=None, return_timings=False,
+                              cache_key=None):
+        """Convergence-compacted execution of an iterative kernel (see
+        :class:`IterativeKernelSpec`). Backends without the slice loop
+        run the spec's fallback kernel through :meth:`batched_map`."""
+        if spec.fallback is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no iterative slice loop and "
+                "the spec carries no fallback kernel"
+            )
+        return self.batched_map(
+            spec.fallback, task_args, shared_args,
+            static_args=static_args, round_size=round_size,
+            shared_specs=shared_specs, return_timings=return_timings,
+            cache_key=spec.fallback_cache_key or cache_key,
+        )
+
     #: task slots per round on the mapped axis (device count on mesh
     #: backends); BatchedPlan callers shape their task axis to this
     n_task_slots = 1
@@ -160,6 +184,171 @@ class TaskBackend:
             f"{type(self).__name__} holds live runtime state and cannot be "
             "pickled; fitted estimators strip it automatically."
         )
+
+
+class IterativeKernelSpec:
+    """An iterative (convergence-aware) batched kernel, in three parts:
+
+    - ``init(shared, task) -> carry``: start one task's solve and run
+      its first iteration slice; the carry is a dict pytree whose
+      ``done_key`` leaf (a scalar bool per task) means "no further step
+      can change this task".
+    - ``step(shared, task, carry) -> carry``: advance one more slice.
+    - ``finalize(shared, task, carry) -> outputs``: shape the final
+      per-task outputs. Only the ``finalize_keys`` leaves of the carry
+      are consumed — retired lanes' remaining solver state (e.g. the
+      L-BFGS S/Y history) never needs to leave the device.
+
+    ``fallback`` is the classic all-iterations kernel with the same
+    outputs (and ``fallback_cache_key`` its compile-cache key): the
+    scheduler downgrades to a plain :meth:`TaskBackend.batched_map` of
+    it on backends without the slice loop, on multi-process meshes
+    (per-slice host compaction decisions would need cross-process
+    agreement), and when a compacted round exhausts device memory.
+    """
+
+    __slots__ = ("init", "step", "finalize", "finalize_keys", "done_key",
+                 "fallback", "fallback_cache_key")
+
+    def __init__(self, init, step, finalize, finalize_keys,
+                 done_key="done", fallback=None, fallback_cache_key=None):
+        self.init = init
+        self.step = step
+        self.finalize = finalize
+        self.finalize_keys = tuple(finalize_keys)
+        self.done_key = done_key
+        self.fallback = fallback
+        self.fallback_cache_key = fallback_cache_key
+
+
+class IterativePlan:
+    """The :class:`BatchedPlan` counterpart for iterative kernels:
+    shardings resolved, shared args device-resident, and the three jit
+    entries (init slice / step slice / finalize) memoised — built once
+    by ``prepare_batched_iterative`` and driven by the compacted round
+    loop (:func:`_run_compacted`)."""
+
+    __slots__ = ("init_fn", "step_fn", "fin_fn", "shared", "put",
+                 "n_task_slots", "_shared_sig")
+
+    def __init__(self, init_fn, step_fn, fin_fn, shared, put,
+                 n_task_slots=1):
+        self.init_fn = init_fn
+        self.step_fn = step_fn
+        self.fin_fn = fin_fn
+        self.shared = shared
+        self.put = put
+        self.n_task_slots = n_task_slots
+        self._shared_sig = compile_cache.shape_sig(shared)
+
+
+def _iterative_jit_entries(spec, static_args, task_sharding,
+                           shared_shardings, cache_key):
+    """The three memoised jit entries of an iterative kernel. The step
+    and finalize kernels see ``{"task": ..., "carry": ...}`` as their
+    task tree so the whole existing task-axis machinery (vmap, task
+    sharding, AOT-per-chunk memo) applies unchanged; the carry rides
+    the task axis like any other per-task leaf.
+
+    Donation is deliberately OFF for these entries: the slice loop
+    feeds each step's output carry back as the next step's input while
+    the host still holds the round's done flags (and, at compaction,
+    gathered carry leaves) — on the CPU backend those host reads can be
+    zero-copy views of the very buffers donation would recycle, and the
+    self-feedback chain was measured to corrupt carries (wrong-task
+    trajectories) under exactly that pattern. The classic path keeps
+    donation: its inputs are one-shot host slices nothing reads back.
+    """
+
+    def init_kernel(shared, task):
+        return spec.init(shared, task)
+
+    def step_kernel(shared, tc):
+        return spec.step(shared, tc["task"], tc["carry"])
+
+    def fin_kernel(shared, tc):
+        return spec.finalize(shared, tc["task"], tc["carry"])
+
+    def key(part):
+        return ("iter", part, cache_key) if cache_key is not None else None
+
+    return (
+        _jit_vmapped(init_kernel, static_args, task_sharding,
+                     shared_shardings, key("init"), False),
+        _jit_vmapped(step_kernel, static_args, task_sharding,
+                     shared_shardings, key("step"), False),
+        _jit_vmapped(fin_kernel, static_args, task_sharding,
+                     shared_shardings, key("fin"), False),
+    )
+
+
+#: smallest task set the convergence-compacted path engages for — below
+#: this the workload fits in one or two rounds and live-task compaction
+#: has nothing to merge, while the three slice-loop programs would
+#: still have to compile (the classic fused kernel also stays the
+#: bitwise-pinned reference path for the small parity tests)
+MIN_ITER_TASKS = 24
+
+
+def compaction_enabled():
+    """The convergence-compacted batched path is ON by default for
+    estimators that support iteration-sliced fits;
+    ``SKDIST_COMPACTION=0`` is the kill switch back to the classic
+    all-iterations-fused path."""
+    return os.environ.get("SKDIST_COMPACTION", "").strip().lower() not in (
+        "0", "false", "no",
+    )
+
+
+def resolve_slice_iters(max_iter):
+    """Iterations per slice of the compacted path: ``SKDIST_SLICE_ITERS``
+    when set, else ~1/8 of the iteration budget (floor 4 — slices much
+    shorter than that pay more dispatch than they save on a CPU mesh).
+    """
+    env = os.environ.get("SKDIST_SLICE_ITERS", "").strip()
+    if env:
+        try:
+            n = int(env)
+        except ValueError:
+            n = 0
+        if n > 0:
+            return n
+    return max(4, -(-int(max_iter) // 8))
+
+
+def iterative_fit_supported(backend, est_cls, n_tasks, max_iter):
+    """The ONE gate every batched call site (search, OvR, OvO) asks
+    before taking the convergence-compacted path: returns the slice
+    size to use, or None for the classic fused kernel. Engages when the
+    estimator family exposes iteration-sliced fit kernels, the backend
+    runs the slice loop, the task set spans several rounds, and the
+    iteration budget is worth slicing."""
+    if not compaction_enabled():
+        return None
+    if not getattr(backend, "supports_iterative", False):
+        return None
+    if not getattr(est_cls, "_supports_sliced_fit", False):
+        return None
+    if not hasattr(est_cls, "_build_fit_slice_kernels"):
+        return None
+    if n_tasks < max(MIN_ITER_TASKS,
+                     2 * getattr(backend, "n_task_slots", 1)):
+        return None
+    if not max_iter:
+        return None
+    n_slice = resolve_slice_iters(max_iter)
+    if n_slice >= int(max_iter):
+        return None
+    return n_slice
+
+
+def iterative_chunk_size(n_tasks, n_slots, target_rounds=8):
+    """Default round size of the compacted path: aim for about
+    ``target_rounds`` slot-aligned rounds so live-task compaction has
+    rounds to merge (one big round can never shrink), without paying
+    per-round dispatch overhead for hundreds of tiny rounds."""
+    chunk = max(n_slots, -(-n_tasks // target_rounds))
+    return int(math.ceil(chunk / n_slots) * n_slots)
 
 
 class LocalBackend(TaskBackend):
@@ -208,6 +397,40 @@ class LocalBackend(TaskBackend):
         fn = _jit_vmapped(kernel, static_args, None, None, cache_key, False)
         shared_args = jax.tree_util.tree_map(jnp.asarray, shared_args)
         return BatchedPlan(fn, shared_args, lambda t: t, n_task_slots=1)
+
+    supports_iterative = True
+
+    def prepare_batched_iterative(self, spec, shared_args=(),
+                                  static_args=None, shared_specs=None,
+                                  cache_key=None):
+        import jax
+        import jax.numpy as jnp
+
+        fns = _iterative_jit_entries(
+            spec, static_args, None, None, cache_key
+        )
+        shared_args = jax.tree_util.tree_map(jnp.asarray, shared_args)
+        return IterativePlan(*fns, shared_args, lambda t: t, n_task_slots=1)
+
+    def batched_map_iterative(self, spec, task_args, shared_args=(),
+                              static_args=None, round_size=None,
+                              shared_specs=None, return_timings=False,
+                              cache_key=None):
+        """Convergence-compacted execution on the host device: same
+        slice/compact/finalize loop as the mesh backend, single task
+        slot."""
+        n_tasks = _leading_dim(task_args)
+        chunk = (
+            min(n_tasks, round_size) if round_size
+            else iterative_chunk_size(n_tasks, 1)
+        )
+        plan = self.prepare_batched_iterative(
+            spec, shared_args, static_args, shared_specs, cache_key
+        )
+        return _dispatch_iterative(
+            self, plan, spec, task_args, shared_args, static_args,
+            shared_specs, n_tasks, chunk, return_timings, cache_key,
+        )
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
                     round_size=None, shared_specs=None, return_timings=False,
@@ -344,16 +567,12 @@ class TPUBackend(TaskBackend):
     def n_task_slots(self):
         return self.n_devices
 
-    def prepare_batched(self, kernel, shared_args=(), static_args=None,
-                        shared_specs=None, cache_key=None):
-        """Resolve shardings, place shared args (through the opt-in
-        broadcast-reuse cache), and build the memoised jit entry ONCE,
-        returning a :class:`BatchedPlan` for repeated low-latency
-        single-round dispatches. ``batched_map`` itself runs through
-        this, so a plan's compiled programs are the same entries the
-        offline path uses — a serving flush and a ``batch_predict``
-        block of matching shape execute one executable.
-        """
+    def _resolve_placement(self, shared_args, shared_specs):
+        """Shared sharding/placement logic of the batched plans: resolve
+        the task-axis and shared shardings, place the shared args
+        (through the opt-in broadcast-reuse cache), and build the
+        task-slice ``put``. Returns ``(task_sharding, shared_shardings,
+        shared_args_placed, put)``."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -390,15 +609,82 @@ class TPUBackend(TaskBackend):
                 shared_shardings, shared_args,
                 is_leaf=lambda x: isinstance(x, NamedSharding),
             )
+        put = lambda t: jax.tree_util.tree_map(
+            lambda a: _put_mesh_scoped(a, task_sharding), t
+        )
+        return task_sharding, shared_shardings, shared_args, put
+
+    def prepare_batched(self, kernel, shared_args=(), static_args=None,
+                        shared_specs=None, cache_key=None):
+        """Resolve shardings, place shared args (through the opt-in
+        broadcast-reuse cache), and build the memoised jit entry ONCE,
+        returning a :class:`BatchedPlan` for repeated low-latency
+        single-round dispatches. ``batched_map`` itself runs through
+        this, so a plan's compiled programs are the same entries the
+        offline path uses — a serving flush and a ``batch_predict``
+        block of matching shape execute one executable.
+        """
+        task_sharding, shared_shardings, shared_args, put = (
+            self._resolve_placement(shared_args, shared_specs)
+        )
         fn = _jit_vmapped(
             kernel, static_args, task_sharding, shared_shardings,
             cache_key, self.donate_tasks,
         )
-        put = lambda t: jax.tree_util.tree_map(
-            lambda a: _put_mesh_scoped(a, task_sharding), t
-        )
         return BatchedPlan(fn, shared_args, put,
                            n_task_slots=self.n_devices)
+
+    supports_iterative = True
+
+    def prepare_batched_iterative(self, spec, shared_args=(),
+                                  static_args=None, shared_specs=None,
+                                  cache_key=None):
+        """The iterative counterpart of :meth:`prepare_batched`: one
+        placement pass, three memoised jit entries (init slice / step
+        slice / finalize)."""
+        task_sharding, shared_shardings, shared_args, put = (
+            self._resolve_placement(shared_args, shared_specs)
+        )
+        fns = _iterative_jit_entries(
+            spec, static_args, task_sharding, shared_shardings, cache_key
+        )
+        return IterativePlan(*fns, shared_args, put,
+                             n_task_slots=self.n_devices)
+
+    def batched_map_iterative(self, spec, task_args, shared_args=(),
+                              static_args=None, round_size=None,
+                              shared_specs=None, return_timings=False,
+                              cache_key=None):
+        """Convergence-compacted execution over the mesh: slice the
+        solvers, gather per-lane done flags (flags-only D2H), compact
+        survivors into fewer slot-aligned rounds, finalize in original
+        task order. Multi-process meshes take the spec's classic
+        fallback kernel through :meth:`batched_map` — the per-slice
+        host compaction decisions would otherwise need cross-process
+        agreement at every slice."""
+        n_tasks = _leading_dim(task_args)
+        d = self.n_devices
+        multiprocess = (
+            len({dd.process_index for dd in self.mesh.devices.flat}) > 1
+        )
+        if multiprocess:
+            return TaskBackend.batched_map_iterative(
+                self, spec, task_args, shared_args,
+                static_args=static_args, round_size=round_size,
+                shared_specs=shared_specs, return_timings=return_timings,
+                cache_key=cache_key,
+            )
+        if round_size:
+            chunk = int(math.ceil(min(n_tasks, round_size) / d) * d)
+        else:
+            chunk = iterative_chunk_size(n_tasks, d)
+        plan = self.prepare_batched_iterative(
+            spec, shared_args, static_args, shared_specs, cache_key
+        )
+        return _dispatch_iterative(
+            self, plan, spec, task_args, shared_args, static_args,
+            shared_specs, n_tasks, chunk, return_timings, cache_key,
+        )
 
     def _mesh_min_int(self, value):
         """Minimum of a per-process host integer across THIS mesh's
@@ -958,6 +1244,296 @@ def _leading_dim(task_args):
     if not leaves:
         raise ValueError("batched_map needs at least one task-axis array")
     return leaves[0].shape[0]
+
+
+# ---------------------------------------------------------------------------
+# convergence-compacted iterative dispatch
+# ---------------------------------------------------------------------------
+
+class _LiveRound:
+    """One chunk-shaped round of the compacted slice loop: the original
+    task ids it carries (``len(idx) <= chunk``; trailing lanes are
+    padding), its host task slice (placed once — ``dev_task`` caches
+    the device copy across slices, safe because the iterative jit
+    entries never donate), and its carry — device-resident between
+    slices, host-resident only across a compaction event."""
+
+    __slots__ = ("idx", "task_sl", "dev_task", "dev_carry", "host_carry",
+                 "done")
+
+    def __init__(self, idx, task_sl):
+        self.idx = idx
+        self.task_sl = task_sl
+        self.dev_task = None
+        self.dev_carry = None
+        self.host_carry = None
+        self.done = None
+
+
+def _pad_tail(tree, pad):
+    import jax
+
+    if not pad:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda a: np.concatenate(
+            [np.asarray(a), np.repeat(np.asarray(a)[-1:], pad, axis=0)]
+        ),
+        tree,
+    )
+
+
+def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
+                        static_args, shared_specs, n_tasks, chunk,
+                        return_timings, cache_key):
+    """Run the compacted loop with the classic-path safety net: a
+    RESOURCE_EXHAUSTED anywhere (a compacted round's carries do not fit,
+    or the finalize pass trips the round loop's OOM machinery) downgrades
+    to a plain ``batched_map`` of the spec's fallback kernel at the same
+    round size — correctness never depends on the slice loop."""
+    stats = backend.last_round_stats = {}
+    t0 = time.perf_counter()
+    try:
+        out = _run_compacted(
+            plan, spec, task_args, n_tasks, chunk, stats,
+            pipeline=not backend.sync_rounds,
+        )
+    except Exception as exc:
+        cause = exc.cause if isinstance(exc, _RoundsExhausted) else exc
+        if (not isinstance(exc, _RoundsExhausted)
+                and "RESOURCE_EXHAUSTED" not in str(exc)):
+            raise
+        if spec.fallback is None:
+            raise cause
+        warnings.warn(
+            "compacted iterative dispatch exhausted device memory; "
+            "falling back to the classic batched path at "
+            f"round_size={chunk}"
+        )
+        return backend.batched_map(
+            spec.fallback, task_args, shared_args,
+            static_args=static_args, round_size=chunk,
+            shared_specs=shared_specs, return_timings=return_timings,
+            cache_key=spec.fallback_cache_key or cache_key,
+        )
+    if return_timings:
+        # one pseudo-round covering the whole call: per-task wall is a
+        # uniform smear (slices interleave tasks, so a per-round
+        # attribution would be fiction); the scheduler detail lives in
+        # last_round_stats instead
+        return out, [(time.perf_counter() - t0, n_tasks)]
+    return out
+
+
+def _flags_only_gather(leaf):
+    """D2H of ONE carry leaf (the done flags) — the only per-slice
+    transfer of the compacted loop's decision path. Always a real copy
+    (``np.array``): on the CPU backend ``device_get`` can return a
+    zero-copy view of the device buffer, and the loop must never hold a
+    view across the slice boundary that recycles that buffer."""
+    import jax
+
+    if getattr(leaf, "is_fully_addressable", True):
+        return np.array(jax.device_get(leaf))
+    return np.array(_gather_host(leaf))
+
+
+def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
+                   pipeline=True):
+    """The convergence-compacted slice loop.
+
+    Phase 1 (iterate): partition the task axis into chunk-shaped rounds
+    and dispatch the init-slice program over each; per slice thereafter,
+    gather ONLY each round's ``done`` flags (flags-only D2H — carries
+    stay device-resident between slices), retire rounds whose lanes all
+    finished, and, when the survivor count frees at least one round,
+    COMPACT the still-running lanes into fewer dense rounds (the one
+    point where surviving carries cross the host). Retired lanes store
+    only their ``finalize_keys`` carry leaves.
+
+    Phase 2 (finalize): run the finalize program over ALL tasks in
+    original order through the ordinary round loop — outputs come back
+    un-permuted, and the phase reuses the same chunk shape, so the
+    whole call compiles at most three programs per (kernel, chunk).
+
+    Dispatch depth is bounded at :data:`_MAX_ROUNDS_IN_FLIGHT` queued
+    computations, same as the classic loop. Raises whatever the device
+    raises on OOM (the caller downgrades to the classic path).
+    """
+    import jax
+
+    depth = _MAX_ROUNDS_IN_FLIGHT if pipeline else 1
+    put = plan.put
+    shared = plan.shared
+    shared_sig = plan._shared_sig
+
+    def make_exec(fn):
+        if not hasattr(fn, "lower"):
+            # test doubles / non-AOT callables: run direct
+            return lambda sl: fn(shared, sl)
+
+        def run(sl):
+            comp = compile_cache.aot_executable(
+                fn, shared, sl, _leading_dim(sl), shared_sig=shared_sig
+            )
+            return comp(shared, sl)
+
+        return run
+
+    init_exec = make_exec(plan.init_fn)
+    step_exec = make_exec(plan.step_fn)
+    fin_exec = make_exec(plan.fin_fn)
+
+    rounds = []
+    for start in range(0, n_tasks, chunk):
+        stop = min(start + chunk, n_tasks)
+        sl = jax.tree_util.tree_map(lambda a: a[start:stop], task_args)
+        rounds.append(_LiveRound(
+            np.arange(start, stop), _pad_tail(sl, chunk - (stop - start))
+        ))
+
+    stats.update({
+        "mode": "compacted", "chunk": int(chunk), "slices": 0,
+        "compactions": 0, "rounds_per_slice": [], "retired_per_slice": [],
+        "dispatch_s": 0.0, "flags_wait_s": 0.0,
+    })
+
+    # per-task store of the finalize-subset carry leaves, filled as
+    # lanes retire; allocated lazily from the first retired leaf
+    fin_store = {}
+
+    def retire(idx_arr, subset):
+        for key in spec.finalize_keys:
+            leaf = np.asarray(subset[key])
+            arr = fin_store.get(key)
+            if arr is None:
+                arr = np.zeros((n_tasks,) + leaf.shape[1:], leaf.dtype)
+                fin_store[key] = arr
+            arr[idx_arr] = leaf
+
+    n_done_prev = 0
+    while rounds:
+        stats["slices"] += 1
+        stats["rounds_per_slice"].append(len(rounds))
+        pending = []
+
+        def flags_pop():
+            r = pending.pop(0)
+            t_g = time.perf_counter()
+            r.done = _flags_only_gather(r.dev_carry[spec.done_key])
+            stats["flags_wait_s"] += time.perf_counter() - t_g
+
+        for r in rounds:
+            t_d = time.perf_counter()
+            if r.dev_task is None:
+                # task args never change between slices: place once per
+                # round and reuse (keep masks at OvR scale are
+                # chunk x n_samples — re-uploading them every slice
+                # would undo the flags-only-D2H economy on the H2D side)
+                r.dev_task = put(r.task_sl)
+            if r.dev_carry is None and r.host_carry is None:
+                dev = init_exec(r.dev_task)
+            else:
+                carry_in = (
+                    r.dev_carry if r.dev_carry is not None
+                    else put(r.host_carry)
+                )
+                r.host_carry = None
+                dev = step_exec({"task": r.dev_task,
+                                 "carry": carry_in})
+            r.dev_carry = dev
+            try:
+                leaf = dev[spec.done_key]
+                if getattr(leaf, "is_fully_addressable", True):
+                    leaf.copy_to_host_async()
+            except Exception:
+                pass
+            pending.append(r)
+            stats["dispatch_s"] += time.perf_counter() - t_d
+            while len(pending) >= depth:
+                flags_pop()
+        while pending:
+            flags_pop()
+
+        # retire rounds whose real lanes are all done (the padding
+        # lanes mirror a real lane and are ignored throughout)
+        still = []
+        n_alive = 0
+        for r in rounds:
+            keep = len(r.idx)
+            done_lanes = r.done[:keep].astype(bool)
+            n_alive += int((~done_lanes).sum())
+            if done_lanes.all():
+                retire(r.idx, {
+                    k: _flags_only_gather(r.dev_carry[k])[:keep]
+                    for k in spec.finalize_keys
+                })
+                r.dev_carry = None
+            else:
+                still.append(r)
+        # newly-finished lanes this slice (lanes already compacted out
+        # of the rounds were counted when they finished)
+        stats["retired_per_slice"].append(
+            (n_tasks - n_alive) - n_done_prev
+        )
+        n_done_prev = n_tasks - n_alive
+        if not still:
+            break
+        needed = -(-n_alive // chunk)
+        if needed < len(still):
+            # compaction event: the survivors fit in fewer rounds. This
+            # is the one place surviving carries cross the host — full
+            # gather for live lanes, finalize-subset only for the lanes
+            # retiring out of mixed rounds.
+            stats["compactions"] += 1
+            id_parts, carry_parts = [], []
+            for r in still:
+                keep = len(r.idx)
+                alive = ~r.done[:keep].astype(bool)
+                host_c = _gather_host(r.dev_carry)
+                r.dev_carry = None
+                if not alive.all():
+                    retire(r.idx[~alive], {
+                        k: np.asarray(host_c[k])[:keep][~alive]
+                        for k in spec.finalize_keys
+                    })
+                id_parts.append(r.idx[alive])
+                carry_parts.append(jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[:keep][alive], host_c
+                ))
+            alive_ids = np.concatenate(id_parts)
+            packed = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs), *carry_parts
+            )
+            rounds = []
+            for i in range(needed):
+                lo, hi = i * chunk, min((i + 1) * chunk, n_alive)
+                ids = alive_ids[lo:hi]
+                pad = chunk - (hi - lo)
+                r = _LiveRound(ids, _pad_tail(
+                    jax.tree_util.tree_map(
+                        lambda a: np.asarray(a)[ids], task_args
+                    ), pad,
+                ))
+                r.host_carry = _pad_tail(
+                    jax.tree_util.tree_map(lambda a: a[lo:hi], packed), pad
+                )
+                rounds.append(r)
+        else:
+            rounds = still
+
+    # phase 2: finalize everything in ORIGINAL task order through the
+    # ordinary round loop (same chunk shape -> same compiled program
+    # for every finalize round, tail padded by _run_in_rounds)
+    fin_stats = {}
+    out = _run_in_rounds(
+        lambda sh, sl: fin_exec(sl),
+        {"task": task_args, "carry": dict(fin_store)},
+        shared, n_tasks, chunk, put=put, concat=True,
+        pipeline=pipeline, stats=fin_stats,
+    )
+    stats["finalize"] = fin_stats
+    return out
 
 
 #: AOT executables live in compile_cache (keyed by (jit fn, shared

@@ -55,6 +55,7 @@ __all__ = [
     "aot_executable",
     "prewarm",
     "snapshot",
+    "last_stats",
     "reset_stats",
     "clear_memos",
 ]
@@ -197,15 +198,33 @@ def _cls_code_token(cls):
     if token is None:
         import hashlib
 
+        import types
+
         h = hashlib.sha256()
+
+        def hash_code(code):
+            h.update(code.co_code)
+            for const in code.co_consts:
+                if isinstance(const, types.CodeType):
+                    # recurse into nested closures' bytecode: their
+                    # repr() embeds per-process memory addresses, which
+                    # would make the token differ in every process and
+                    # silently defeat the cross-process export layer
+                    hash_code(const)
+                else:
+                    h.update(repr(const).encode())
+
         for name in sorted(dir(cls)):
-            if name.startswith("_build_") and name.endswith("_kernel"):
+            # every _build_* method participates: kernel math also
+            # lives in the shared _build_fit_problem /
+            # _build_fit_slice_kernels builders the sliced-solver
+            # variants are generated from
+            if name.startswith("_build_"):
                 fn = getattr(cls, name, None)
                 code = getattr(getattr(fn, "__func__", fn), "__code__", None)
                 if code is not None:
                     h.update(name.encode())
-                    h.update(code.co_code)
-                    h.update(repr(code.co_consts).encode())
+                    hash_code(code)
         token = h.hexdigest()[:12]
         _CLS_CODE_TOKENS[cls] = token
     return token
@@ -244,6 +263,13 @@ def snapshot():
     out["lower_time_s"] = round(out["lower_time_s"], 4)
     out["disk_cache_dir"] = _DISK_DIR
     return out
+
+
+def last_stats():
+    """Alias of :func:`snapshot` — the name the compaction tests/smoke
+    read when asserting "no recompile after warmup" (counter deltas
+    between two snapshots around the flags-only slice loop)."""
+    return snapshot()
 
 
 def reset_stats():

@@ -1,0 +1,493 @@
+"""
+Convergence-compacted execution tests: iteration-sliced solvers,
+live-task compaction in the backend, and cost-ordered round packing.
+
+Pins the PR's contracts:
+- a sliced solver run is BITWISE identical to the unsliced solve (both
+  solvers, several slice sizes including slice=1 and slice >= max_iter);
+- the compacted scheduler path produces the same cv_results_ rows (order
+  and values) as the classic fused path and the generic per-task path;
+- a forced RESOURCE_EXHAUSTED mid-loop downgrades to the classic path
+  with correct results (OOM-resume contract);
+- the flags-only slice loop never triggers a recompile after warmup
+  (compile_cache counters: misses bounded by kernels x chunk shapes).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from skdist_tpu.models.solvers import (
+    lbfgs_carry_init,
+    lbfgs_minimize,
+    lbfgs_resume,
+    sgd_carry_init,
+    sgd_minimize,
+    sgd_resume,
+)
+from skdist_tpu.parallel import (
+    IterativeKernelSpec,
+    LocalBackend,
+    TPUBackend,
+    compile_cache,
+    iterative_fit_supported,
+)
+
+
+# ---------------------------------------------------------------------------
+# sliced-vs-unsliced solver bitwise fuzz
+# ---------------------------------------------------------------------------
+
+def _logreg_loss(X, y, reg):
+    def loss(w):
+        z = X @ w
+        return jnp.sum(jax.nn.softplus(z) - y * z) + reg * jnp.dot(w, w)
+
+    return loss
+
+
+@pytest.mark.parametrize("n_slice", [1, 3, 7, 33, 50])
+def test_lbfgs_sliced_bitwise(n_slice):
+    """Chained short resumes == one unsliced solve, bit for bit, for
+    several random problems (incl. slice=1 and slice >= max_iter)."""
+    max_iter, tol = 33, 1e-5
+    for seed in range(3):
+        rng = np.random.RandomState(seed)
+        X = jnp.asarray(rng.normal(size=(48, 7)).astype(np.float32))
+        y = jnp.asarray((rng.rand(48) > 0.5).astype(np.float32))
+        loss = _logreg_loss(X, y, 0.05)
+        w0 = jnp.zeros(7, jnp.float32)
+        w_ref, it_ref = jax.jit(
+            lambda w0: lbfgs_minimize(loss, w0, max_iter, tol)
+        )(w0)
+        carry = jax.jit(
+            lambda w0: lbfgs_carry_init(loss, w0, max_iter, tol)
+        )(w0)
+        step = jax.jit(
+            lambda c: lbfgs_resume(loss, c, n_slice, max_iter, tol)
+        )
+        for _ in range(200):
+            if bool(carry["done"]):
+                break
+            carry = step(carry)
+        assert bool(carry["done"])
+        np.testing.assert_array_equal(
+            np.asarray(w_ref), np.asarray(carry["w"])
+        )
+        assert int(it_ref) == int(carry["it"])
+
+
+@pytest.mark.parametrize("n_slice", [1, 4, 19, 30])
+def test_sgd_sliced_bitwise(n_slice):
+    max_epochs, batch = 19, 16
+    for seed in range(2):
+        rng = np.random.RandomState(seed)
+        n = 64
+        X = jnp.asarray(rng.normal(size=(n, 5)).astype(np.float32))
+        y = jnp.asarray((rng.rand(n) > 0.5).astype(np.float32))
+        key = jax.random.PRNGKey(seed)
+
+        def grad_fn(w, idx):
+            z = X[idx] @ w
+            return (
+                X[idx].T @ (jax.nn.sigmoid(z) - y[idx]) / idx.shape[0]
+                + 0.01 * w
+            )
+
+        def loss_fn(w, idx):
+            z = X[idx] @ w
+            return jnp.mean(jax.nn.softplus(z) - y[idx] * z)
+
+        def lr_fn(t):
+            return 0.2 / (1.0 + 0.02 * t)
+
+        w0 = jnp.zeros(5, jnp.float32)
+        w_ref, nd_ref = jax.jit(lambda w0: sgd_minimize(
+            grad_fn, w0, n, key, max_epochs, batch, lr_fn,
+            loss_fn=loss_fn, tol=1e-3,
+        ))(w0)
+        carry = sgd_carry_init(w0)
+        step = jax.jit(lambda c: sgd_resume(
+            grad_fn, c, n_slice, n, key, max_epochs, batch, lr_fn,
+            loss_fn=loss_fn, tol=1e-3,
+        ))
+        for _ in range(100):
+            if bool(carry["done"]):
+                break
+            carry = step(carry)
+        assert bool(carry["done"])
+        np.testing.assert_array_equal(
+            np.asarray(w_ref), np.asarray(carry["w"])
+        )
+        assert int(nd_ref) == int(carry["n_done"])
+
+
+def test_sliced_vmapped_bitwise():
+    """The vmapped (fan-out) shape: a batch of lanes compacts per-lane
+    done flags; the final batch of weights must equal the unsliced
+    vmapped solve bit for bit."""
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.normal(size=(48, 7)).astype(np.float32))
+    y = jnp.asarray((rng.rand(48) > 0.5).astype(np.float32))
+    Cs = jnp.asarray(np.logspace(-2, 2, 9).astype(np.float32))
+    max_iter, tol = 25, 1e-5
+    w0 = jnp.zeros(7, jnp.float32)
+
+    def fit(C):
+        return lbfgs_minimize(
+            _logreg_loss(X, y, 0.5 / C), w0, max_iter, tol
+        )
+
+    W_ref, it_ref = jax.jit(jax.vmap(fit))(Cs)
+
+    def init(C):
+        return lbfgs_carry_init(
+            _logreg_loss(X, y, 0.5 / C), w0, max_iter, tol
+        )
+
+    def step(C, c):
+        return lbfgs_resume(
+            _logreg_loss(X, y, 0.5 / C), c, 4, max_iter, tol
+        )
+
+    carry = jax.jit(jax.vmap(init))(Cs)
+    stepv = jax.jit(jax.vmap(step))
+    for _ in range(20):
+        if bool(jnp.all(carry["done"])):
+            break
+        carry = stepv(Cs, carry)
+    np.testing.assert_array_equal(np.asarray(W_ref), np.asarray(carry["w"]))
+    np.testing.assert_array_equal(
+        np.asarray(it_ref), np.asarray(carry["it"])
+    )
+
+
+# ---------------------------------------------------------------------------
+# backend: batched_map_iterative
+# ---------------------------------------------------------------------------
+
+def _toy_spec_and_tasks(n_tasks=37):
+    """A self-contained iterative kernel + its classic fallback over a
+    tiny logistic problem, for driving the backend loop directly."""
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.models.linear import _freeze, as_dense_f32
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(90, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=90) > 0).astype(np.int64)
+    est = LogisticRegression(max_iter=40, tol=1e-5, engine="xla")
+    data, meta = est._prep_fit_data(as_dense_f32(X), y, None)
+    static = _freeze(est._static_config(meta))
+    plain = type(est)._build_fit_kernel(meta, static)
+    ks = type(est)._build_fit_slice_kernels(meta, static, 5)
+
+    def derive(shared, task):
+        return (shared["X"], shared["y"], shared["sw"],
+                {"C": task["C"], "tol": task["tol"]}, None)
+
+    def init(shared, task):
+        return ks["init"](*derive(shared, task)[:4])
+
+    def step(shared, task, carry):
+        Xs, ys, sw, hyper, _ = derive(shared, task)
+        return ks["step"](Xs, ys, sw, hyper, carry)
+
+    def fin(shared, task, carry):
+        Xs, ys, sw, hyper, _ = derive(shared, task)
+        return ks["finalize"](Xs, ys, sw, hyper, carry)
+
+    def fallback(shared, task):
+        Xs, ys, sw, hyper, _ = derive(shared, task)
+        return plain(Xs, ys, sw, hyper)
+
+    spec = IterativeKernelSpec(
+        init, step, fin, ks["finalize_keys"], fallback=fallback,
+    )
+    shared = {"X": np.asarray(data["X"]), "y": np.asarray(data["y"]),
+              "sw": np.asarray(data["sw"])}
+    tasks = {
+        "C": np.logspace(-3, 2, n_tasks).astype(np.float32),
+        "tol": np.where(
+            np.arange(n_tasks) % 2 == 0, 1e-2, 1e-5
+        ).astype(np.float32),
+    }
+    return spec, fallback, shared, tasks
+
+
+@pytest.mark.parametrize("make_backend", [TPUBackend, LocalBackend])
+def test_iterative_bitwise_at_equal_chunk(make_backend):
+    """At the SAME round size, the compacted slice loop's outputs are
+    bitwise identical to the classic fused dispatch — compaction only
+    changes where the host observes the carry."""
+    spec, fallback, shared, tasks = _toy_spec_and_tasks()
+    bk = make_backend()
+    ref = bk.batched_map(
+        fallback, tasks, shared, round_size=8,
+        cache_key=("tc", "classic", make_backend.__name__),
+    )
+    out = bk.batched_map_iterative(
+        spec, tasks, shared, round_size=8,
+        cache_key=("tc", "iter", make_backend.__name__),
+    )
+    stats = bk.last_round_stats
+    assert stats["mode"] == "compacted"
+    assert stats["slices"] >= 2
+    assert sum(stats["retired_per_slice"]) == 37
+    np.testing.assert_array_equal(ref["W"], out["W"])
+    np.testing.assert_array_equal(ref["n_iter"], out["n_iter"])
+
+
+def test_iterative_compacts_rounds(tpu_backend):
+    """On a convergence-skewed task set the round count must shrink as
+    lanes retire (the whole point of live-task compaction)."""
+    spec, _fallback, shared, tasks = _toy_spec_and_tasks()
+    # default chunk for 37 tasks on 8 slots is also 8, so this reuses
+    # the programs test_iterative_bitwise_at_equal_chunk compiled
+    tpu_backend.batched_map_iterative(
+        spec, tasks, shared, cache_key=("tc", "iter", "TPUBackend"),
+    )
+    stats = tpu_backend.last_round_stats
+    rps = stats["rounds_per_slice"]
+    assert stats["compactions"] >= 1
+    assert rps[-1] < rps[0]
+    assert sum(stats["retired_per_slice"]) == 37
+
+
+def test_iterative_oom_falls_back_to_classic(monkeypatch):
+    """A RESOURCE_EXHAUSTED inside the slice loop downgrades to the
+    classic batched path with correct results (the OOM-resume
+    contract of the compacted scheduler)."""
+    from skdist_tpu.parallel import backend as backend_mod
+
+    spec, fallback, shared, tasks = _toy_spec_and_tasks()
+    bk = TPUBackend()
+    # same round size as the fallback dispatch will use, so the
+    # comparison is bitwise (round size is a program shape; different
+    # shapes carry benign f32 noise)
+    ref = bk.batched_map(
+        fallback, tasks, shared, round_size=8,
+        cache_key=("tc", "classic", "TPUBackend"),
+    )
+
+    def exploding(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
+
+    monkeypatch.setattr(backend_mod, "_run_compacted", exploding)
+    with pytest.warns(UserWarning, match="falling back to the classic"):
+        out = bk.batched_map_iterative(
+            spec, tasks, shared, round_size=8,
+            cache_key=("tc", "iter", "TPUBackend"),
+        )
+    np.testing.assert_array_equal(ref["W"], out["W"])
+
+
+def test_iterative_no_recompile_after_warmup(tpu_backend):
+    """The flags-only slice loop adds NO programs after warmup: a
+    second identical run moves only hit counters, and the first run's
+    AOT misses are bounded by (3 programs) x (chunk shapes)."""
+    spec, _fallback, shared, tasks = _toy_spec_and_tasks()
+    tpu_backend.batched_map_iterative(
+        spec, tasks, shared, round_size=8,
+        cache_key=("tc", "iter", "TPUBackend"),
+    )
+    snap1 = compile_cache.last_stats()
+    tpu_backend.batched_map_iterative(
+        spec, tasks, shared, round_size=8,
+        cache_key=("tc", "iter", "TPUBackend"),
+    )
+    snap2 = compile_cache.last_stats()
+    assert snap2["aot_misses"] == snap1["aot_misses"]
+    assert snap2["jit_misses"] == snap1["jit_misses"]
+    assert snap2["aot_hits"] > snap1["aot_hits"]
+    # many slices ran in the warm pass; none of them compiled
+    assert tpu_backend.last_round_stats["slices"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: search path
+# ---------------------------------------------------------------------------
+
+def _skewed_grid_search(backend, X, y, **kwargs):
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+
+    grid = {
+        "C": [0.01, 0.1, 1.0, 10.0],
+        "tol": [1e-2, 1e-5],
+    }  # 8 candidates x 3 folds = 24 tasks >= the compaction floor
+    return DistGridSearchCV(
+        LogisticRegression(max_iter=40, engine="xla"), grid,
+        backend=backend, cv=3, scoring="accuracy", **kwargs,
+    ).fit(X, y)
+
+
+def test_search_compacted_matches_classic_and_generic(clf_data, monkeypatch):
+    from sklearn.metrics import accuracy_score, make_scorer
+
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = clf_data
+    bk = TPUBackend()
+    compacted = _skewed_grid_search(bk, X, y)
+    assert bk.last_round_stats["mode"] == "compacted"
+    monkeypatch.setenv("SKDIST_COMPACTION", "0")
+    bk2 = TPUBackend()
+    classic = _skewed_grid_search(bk2, X, y)
+    assert bk2.last_round_stats["mode"] in ("pipelined", "synchronous")
+    monkeypatch.delenv("SKDIST_COMPACTION")
+    generic = DistGridSearchCV(
+        LogisticRegression(max_iter=40, engine="xla"),
+        {"C": [0.01, 0.1, 1.0, 10.0], "tol": [1e-2, 1e-5]}, cv=3,
+        scoring=make_scorer(accuracy_score),
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        compacted.cv_results_["mean_test_score"],
+        classic.cv_results_["mean_test_score"],
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        compacted.cv_results_["mean_test_score"],
+        generic.cv_results_["mean_test_score"],
+        atol=1e-5,
+    )
+    assert compacted.best_params_ == classic.best_params_
+
+
+def test_cost_permutation_round_trip_pins_row_order(clf_data):
+    """Cost-ordered round packing is a scheduler detail: cv_results_
+    rows stay in candidate-enumeration order with their own values
+    (the permutation is undone before _format_results)."""
+    from sklearn.model_selection import ParameterGrid
+
+    X, y = clf_data
+    grid = {"C": [10.0, 0.01, 1.0, 0.1], "tol": [1e-5, 1e-2]}
+    bk = TPUBackend()
+    gs = _skewed_grid_search(bk, X, y)
+    # candidate order in cv_results_ == ParameterGrid enumeration order
+    expected = list(ParameterGrid(
+        {"C": [0.01, 0.1, 1.0, 10.0], "tol": [1e-2, 1e-5]}
+    ))
+    assert gs.cv_results_["params"] == expected
+    np.testing.assert_array_equal(
+        np.asarray([p["C"] for p in gs.cv_results_["params"]]),
+        np.asarray(gs.cv_results_["param_C"].compressed(), dtype=float),
+    )
+
+
+def test_search_oom_mid_compaction_parity(clf_data, monkeypatch):
+    """Forced _RoundsExhausted during the compacted search: results
+    must still match the classic path (fallback kernel takes over)."""
+    from skdist_tpu.parallel import backend as backend_mod
+
+    X, y = clf_data
+    monkeypatch.setenv("SKDIST_COMPACTION", "0")
+    classic = _skewed_grid_search(TPUBackend(), X, y)
+    monkeypatch.delenv("SKDIST_COMPACTION")
+
+    real = backend_mod._run_compacted
+    calls = []
+
+    def flaky(*a, **k):
+        if not calls:
+            calls.append(1)
+            raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
+        return real(*a, **k)
+
+    monkeypatch.setattr(backend_mod, "_run_compacted", flaky)
+    with pytest.warns(UserWarning, match="falling back to the classic"):
+        compacted = _skewed_grid_search(TPUBackend(), X, y)
+    np.testing.assert_allclose(
+        compacted.cv_results_["mean_test_score"],
+        classic.cv_results_["mean_test_score"],
+        atol=1e-6,
+    )
+
+
+def test_small_grids_stay_on_classic_path(clf_data):
+    """Below the task floor the classic fused kernel still runs (its
+    bitwise behaviour is pinned by the existing parity tests)."""
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = clf_data
+    bk = TPUBackend()
+    DistGridSearchCV(
+        LogisticRegression(max_iter=40, engine="xla"),
+        {"C": [0.1, 1.0]}, backend=bk, cv=3, scoring="accuracy",
+    ).fit(X, y)
+    assert bk.last_round_stats["mode"] in ("pipelined", "synchronous")
+
+
+def test_gate_respects_env_and_sizes(tpu_backend):
+    from skdist_tpu.models import LogisticRegression, Ridge
+
+    assert iterative_fit_supported(
+        tpu_backend, LogisticRegression, 64, 100
+    ) is not None
+    # too few tasks / no max_iter / unsupported family
+    assert iterative_fit_supported(
+        tpu_backend, LogisticRegression, 8, 100
+    ) is None
+    assert iterative_fit_supported(
+        tpu_backend, LogisticRegression, 64, None
+    ) is None
+    assert iterative_fit_supported(tpu_backend, Ridge, 64, 100) is None
+    os.environ["SKDIST_COMPACTION"] = "0"
+    try:
+        assert iterative_fit_supported(
+            tpu_backend, LogisticRegression, 64, 100
+        ) is None
+    finally:
+        del os.environ["SKDIST_COMPACTION"]
+
+
+# ---------------------------------------------------------------------------
+# OvR / OvO through the same entry point
+# ---------------------------------------------------------------------------
+
+def test_ovr_ovo_compacted_parity():
+    from skdist_tpu.distribute.multiclass import (
+        DistOneVsOneClassifier,
+        DistOneVsRestClassifier,
+    )
+    from skdist_tpu.models import LogisticRegression
+
+    rng = np.random.RandomState(1)
+    # OvR: 26 class columns >= the 24-task compaction floor
+    n, d, k = 260, 8, 26
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.argmax(X @ W + rng.normal(size=(n, k)), axis=1)
+    est = LogisticRegression(max_iter=40, tol=1e-4, engine="xla")
+
+    bk = TPUBackend()
+    ovr_c = DistOneVsRestClassifier(est, backend=bk).fit(X, y)
+    assert bk.last_round_stats["mode"] == "compacted"
+    os.environ["SKDIST_COMPACTION"] = "0"
+    try:
+        ovr_k = DistOneVsRestClassifier(est, backend=TPUBackend()).fit(X, y)
+    finally:
+        del os.environ["SKDIST_COMPACTION"]
+    assert (ovr_c.predict(X) == ovr_k.predict(X)).all()
+    np.testing.assert_allclose(
+        ovr_c.predict_proba(X), ovr_k.predict_proba(X), atol=1e-4
+    )
+
+    # OvO: 9 classes -> 36 pairs >= the floor (a host predict loop over
+    # hundreds of pairs would dominate the test for no extra coverage)
+    k2 = 9
+    y2 = np.argmax(X @ W[:, :k2] + rng.normal(size=(n, k2)), axis=1)
+    bk2 = TPUBackend()
+    ovo_c = DistOneVsOneClassifier(est, backend=bk2).fit(X, y2)
+    assert bk2.last_round_stats["mode"] == "compacted"
+    os.environ["SKDIST_COMPACTION"] = "0"
+    try:
+        ovo_k = DistOneVsOneClassifier(est, backend=TPUBackend()).fit(X, y2)
+    finally:
+        del os.environ["SKDIST_COMPACTION"]
+    assert (ovo_c.predict(X) == ovo_k.predict(X)).all()
