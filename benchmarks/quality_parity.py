@@ -69,6 +69,87 @@ def skip_row(name, why):
                  "delta": None, "note": f"skipped: {why}"})
 
 
+def add_noncomparable_row(name, ours, ref, note=""):
+    """A real-data row whose protocol deviates from the published one
+    (subsampled rows, fewer estimators): the reference number is
+    context, not a comparison — delta stays None so the readout never
+    reads as a quality regression."""
+    row = {
+        "row": name,
+        "ours": None if ours is None else round(float(ours), 4),
+        "reference": ref,
+        "delta": None,
+        "note": f"modified protocol (not comparable to ref); {note}".rstrip("; "),
+    }
+    ROWS.append(row)
+    _emit(row)
+
+
+def add_synth_row(name, ours, ref, note=""):
+    """A synthetic-stand-in row: the PROTOCOL ran and produced a score,
+    but the data is generated, so the published reference number is
+    context, not a comparison — delta stays None."""
+    row = {
+        "row": name,
+        "ours": None if ours is None else round(float(ours), 4),
+        "reference": ref,
+        "delta": None,
+        "note": f"synthetic stand-in (not comparable to ref); {note}".rstrip("; "),
+    }
+    ROWS.append(row)
+    _emit(row)
+
+
+# ------------------------------------------------- synthetic stand-ins
+# Cached generated datasets for the fetched rows (VERDICT weak #5): in
+# zero-egress environments the covtype/20news protocols RUN on shaped
+# synthetic data instead of skipping, so the harness (and its CI
+# smoke) always exercises the full pipeline — scaling, grids, the
+# Encoderizer text path, the sparse fit plane. Scores are protocol
+# health signals, not reference comparisons.
+_SYNTH_CACHE = {}
+
+
+def _synthetic_covtype(n_rows=2500, seed=0):
+    """Covtype-shaped stand-in: 54 features, 7 classes, labels 1..7."""
+    key = ("covtype", n_rows, seed)
+    if key not in _SYNTH_CACHE:
+        from bench import make_tabular
+
+        X, y = make_tabular(n_rows, 54, 7, seed=seed)
+        _SYNTH_CACHE[key] = (X, y + 1)
+    return _SYNTH_CACHE[key]
+
+
+def _synthetic_20news_docs(n_docs=1000, seed=1, k=20):
+    """20news-shaped stand-in: synthetic documents over a zipf
+    vocabulary with class-specific topic tokens, so the Encoderizer's
+    text featurisers have real signal to find."""
+    key = ("20news", n_docs, seed, k)
+    if key not in _SYNTH_CACHE:
+        rng = np.random.RandomState(seed)
+        vocab_size = 4000
+        common = 1.0 / np.arange(1, vocab_size + 1, dtype=np.float64)
+        common /= common.sum()
+        cum = np.cumsum(common)
+        topic_words = rng.choice(
+            vocab_size, size=(k, 25), replace=True
+        )
+        docs, labels = [], []
+        for i in range(n_docs):
+            c = i % k
+            n_tok = int(rng.randint(30, 120))
+            toks = np.searchsorted(cum, rng.rand(n_tok))
+            n_topic = max(4, n_tok // 5)
+            toks[:n_topic] = topic_words[c][
+                rng.randint(0, topic_words.shape[1], size=n_topic)
+            ]
+            docs.append(" ".join(f"w{t}" for t in toks))
+            labels.append(c)
+        _SYNTH_CACHE[key] = (docs, np.asarray(labels))
+    return _SYNTH_CACHE[key]
+
+
 # ----------------------------------------------------------------- builtin
 def run_digits():
     """BASELINE row 10: OvR 0.9589 / OvO 0.9805 weighted F1 on digits."""
@@ -129,16 +210,18 @@ def run_breast_cancer():
 
 
 # ----------------------------------------------------------------- fetched
-def run_covtype(data_dir, n_rows=None):
+def run_covtype(data_dir, n_rows=None, rf_estimators=100):
     """BASELINE rows 1-2: LR grid CV 0.7148 / holdout F1 0.7118;
-    RF-100 holdout F1 0.9537."""
+    RF-100 holdout F1 0.9537. Without a local covtype cache the SAME
+    protocol runs on the cached covtype-shaped synthetic stand-in
+    (rows emitted via :func:`add_synth_row`) instead of skipping."""
     from sklearn.datasets import fetch_covtype
 
+    synthetic = False
     try:
         data = fetch_covtype(data_home=data_dir, download_if_missing=False)
-    except OSError as exc:
-        skip_row("covtype LR/RF quality", f"data not found ({exc})")
-        return
+    except OSError:
+        synthetic = True
     from sklearn.metrics import f1_score
     from sklearn.model_selection import train_test_split
     from sklearn.preprocessing import StandardScaler
@@ -147,14 +230,21 @@ def run_covtype(data_dir, n_rows=None):
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression
 
-    X, y = data["data"], data["target"]
-    note = "full 581k-row protocol"
-    if n_rows is not None and n_rows < len(y):
-        keep = np.random.RandomState(0).choice(
-            len(y), size=n_rows, replace=False
-        )
-        X, y = X[keep], y[keep]
-        note = f"subsampled to {n_rows} rows (not comparable to ref)"
+    if synthetic:
+        X, y = _synthetic_covtype(n_rows or 2500)
+        note = f"covtype-shaped synthetic, {len(y)} rows"
+        emit = add_synth_row
+    else:
+        X, y = data["data"], data["target"]
+        note = "full 581k-row protocol"
+        emit = add_row
+        if n_rows is not None and n_rows < len(y):
+            keep = np.random.RandomState(0).choice(
+                len(y), size=n_rows, replace=False
+            )
+            X, y = X[keep], y[keep]
+            note = f"subsampled to {n_rows} rows"
+            emit = add_noncomparable_row
     X_train, X_test, y_train, y_test = train_test_split(
         X, y, test_size=0.2, random_state=4
     )
@@ -168,9 +258,9 @@ def run_covtype(data_dir, n_rows=None):
         {"C": [10.0, 1.0, 0.1, 0.01]}, cv=5, scoring="f1_weighted",
     ).fit(X_train, y_train)
     lr_wall = time.time() - t0
-    add_row("covtype LR grid best CV f1_weighted", lr.best_score_,
-            0.7148, note=f"{note}; train {lr_wall:.1f}s (ref 85.7s)")
-    add_row(
+    emit("covtype LR grid best CV f1_weighted", lr.best_score_,
+         0.7148, note=f"{note}; train {lr_wall:.1f}s (ref 85.7s)")
+    emit(
         "covtype LR holdout weighted F1",
         f1_score(y_test, lr.predict(X_test), average="weighted"),
         0.7118, note=note,
@@ -178,40 +268,58 @@ def run_covtype(data_dir, n_rows=None):
 
     t0 = time.time()
     rf = DistRandomForestClassifier(
-        n_estimators=100, random_state=0
+        n_estimators=rf_estimators, random_state=0
     ).fit(X_train, y_train)
     rf_wall = time.time() - t0
-    add_row(
-        "covtype RF-100 holdout weighted F1",
+    # the 0.9537 reference is RF-100: a smaller forest on real data
+    # must not bill its score against it
+    rf_emit = emit if rf_estimators == 100 else add_noncomparable_row
+    if synthetic:
+        rf_emit = emit
+    rf_emit(
+        f"covtype RF-{rf_estimators} holdout weighted F1",
         f1_score(y_test, rf.predict(X_test), average="weighted"),
         0.9537, note=f"{note}; train {rf_wall:.1f}s (ref 9.2s)",
     )
 
 
-def run_encoder_20news(data_dir):
+def run_encoder_20news(data_dir, sizes=("small", "medium", "large"),
+                       n_docs=1000):
     """BASELINE row 9: Encoderizer small/medium/large best CV f1 on the
-    first 1000 20newsgroups docs: 0.3795 / 0.4671 / 0.4503."""
+    first 1000 20newsgroups docs: 0.3795 / 0.4671 / 0.4503. Without a
+    local 20news cache the SAME protocol runs on the cached synthetic
+    document stand-in instead of skipping."""
     from sklearn.datasets import fetch_20newsgroups
 
+    synthetic = False
     try:
         dataset = fetch_20newsgroups(
             data_home=data_dir, shuffle=True, random_state=1,
             remove=("headers", "footers", "quotes"),
             download_if_missing=False,
         )
-    except OSError as exc:
-        skip_row("20news Encoderizer quality", f"data not found ({exc})")
-        return
+    except OSError:
+        synthetic = True
     import pandas as pd
 
     from skdist_tpu.distribute.encoder import Encoderizer
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression
 
-    df = pd.DataFrame({"text": dataset["data"]})[:1000]
-    y = dataset["target"][:1000]
+    if synthetic:
+        docs, y = _synthetic_20news_docs(n_docs)
+        df = pd.DataFrame({"text": docs})
+        emit, extra = add_synth_row, f"{len(y)} synthetic docs"
+    else:
+        df = pd.DataFrame({"text": dataset["data"]})[:n_docs]
+        y = dataset["target"][:n_docs]
+        emit, extra = add_row, ""
+        if n_docs != 1000:
+            # the published numbers are for the first 1000 docs
+            emit, extra = add_noncomparable_row, f"first {n_docs} docs"
     targets = {"small": 0.3795, "medium": 0.4671, "large": 0.4503}
-    for size, ref in targets.items():
+    for size in sizes:
+        ref = targets[size]
         # fit_transform WITHOUT y, exactly as the reference protocol
         # does (`encoder/basic_usage.py:57-58`: the Encoderizer is fit
         # unsupervised there)
@@ -220,8 +328,8 @@ def run_encoder_20news(data_dir):
             LogisticRegression(max_iter=100),
             {"C": [0.1, 1.0, 10.0]}, cv=5, scoring="f1_weighted",
         ).fit(X_t, y)
-        add_row(f"20news Encoderizer[{size}] best CV f1_weighted",
-                model.best_score_, ref)
+        emit(f"20news Encoderizer[{size}] best CV f1_weighted",
+             model.best_score_, ref, note=extra)
 
 
 def run_rows(data_dir=None, covtype_rows=None, skip_builtin=False):

@@ -10,6 +10,11 @@ engine, whose exactness claim (integer effective weights => f32 sums
 below 2^24 are exact => subtraction == direct summation) is exactly
 the kind of property a fuzzer should be pointed at.
 
+Also carries the packed-matvec exactness leg (sparse fit plane PR):
+gather/segment contractions of ``skdist_tpu.sparse`` vs the dense
+reference, bitwise on integer-valued inputs (f32 integer sums below
+2^24 are reduction-order-independent).
+
 Not part of the CI tier (minutes of XLA compiles for one-off shapes);
 run on demand:  python build_tools/engine_fuzz.py [--n-configs 12]
 """
@@ -57,10 +62,87 @@ def fuzz_config(rng, classification, extra):
     return Xb, y, cfg
 
 
+def packed_matvec_fuzz(n_configs=12):
+    """Packed-matvec exactness leg (sparse fit plane PR): random
+    INTEGER-VALUED sparse matrices and integer weights, gather/segment
+    contractions vs the dense reference. Integer f32 sums below 2^24
+    are exact regardless of reduction order, so gather X@W, scatter-add
+    X.T@r, the m² gram, and the scatter-rebuilt dense block are all
+    required BITWISE identical to the dense expressions — any
+    discrepancy is an indexing/padding bug, not rounding."""
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    from skdist_tpu.sparse import (
+        pack_csr_rows,
+        packed_matvec,
+        packed_rmatvec,
+        packed_to_dense,
+        packed_weighted_gram,
+    )
+
+    rng = np.random.RandomState(11)
+    bad = 0
+    for i in range(n_configs):
+        n = int(rng.choice([17, 64, 301]))
+        d = int(rng.choice([8, 33, 256]))
+        k = int(rng.choice([1, 3, 7]))
+        density = float(rng.choice([0.0, 0.02, 0.1, 0.4]))
+        X = sp.random(n, d, density=density, format="csr",
+                      random_state=rng, data_rvs=lambda s: rng.randint(
+                          1, 8, size=s).astype(np.float64))
+        X = X.astype(np.float32)
+        Xd = np.asarray(X.toarray(), np.float32)
+        idx, val = pack_csr_rows(X)
+        W = rng.randint(-5, 6, size=(d, k)).astype(np.float32)
+        w1 = W[:, 0]
+        r = rng.randint(-5, 6, size=(n, k)).astype(np.float32)
+        sw = rng.randint(0, 3, size=n).astype(np.float32)
+        checks = {
+            "matvec_1d": (packed_matvec(jnp.asarray(idx),
+                                        jnp.asarray(val),
+                                        jnp.asarray(w1)),
+                          Xd @ w1),
+            "matvec_2d": (packed_matvec(jnp.asarray(idx),
+                                        jnp.asarray(val),
+                                        jnp.asarray(W)),
+                          Xd @ W),
+            "rmatvec_1d": (packed_rmatvec(jnp.asarray(idx),
+                                          jnp.asarray(val),
+                                          jnp.asarray(r[:, 0]), d),
+                           Xd.T @ r[:, 0]),
+            "rmatvec_2d": (packed_rmatvec(jnp.asarray(idx),
+                                          jnp.asarray(val),
+                                          jnp.asarray(r), d),
+                           Xd.T @ r),
+            "to_dense": (packed_to_dense(jnp.asarray(idx),
+                                         jnp.asarray(val), d), Xd),
+            "gram": (packed_weighted_gram(jnp.asarray(idx),
+                                          jnp.asarray(val),
+                                          jnp.asarray(sw), d),
+                     Xd.T @ (Xd * sw[:, None])),
+        }
+        row = {"packed_config": i, "shape": [n, d, k],
+               "density": density, "m": int(idx.shape[1])}
+        for name, (got, want) in checks.items():
+            same = np.array_equal(np.asarray(got), np.asarray(want))
+            row[name] = "bitwise" if same else "MISMATCH"
+            bad += not same
+        print(json.dumps(row), flush=True)
+    print(json.dumps({"packed_matvec_summary": {
+        "configs": n_configs, "mismatches": bad,
+        "note": "integer-valued inputs: f32 sums < 2^24 are exact, so "
+                "bitwise identity to the dense reference is REQUIRED",
+    }}), flush=True)
+    return bad == 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-configs", type=int, default=12)
     args = ap.parse_args()
+
+    packed_ok = packed_matvec_fuzz(args.n_configs)
 
     import jax.numpy as jnp
 
@@ -133,7 +215,8 @@ def main():
     clf = stats[True]
     ok = (clf["matmul"] == clf["total"]
           and clf["matmul_sib"] == clf["total"]
-          and stats[False]["feat_agree_min"] >= 0.85)
+          and stats[False]["feat_agree_min"] >= 0.85
+          and packed_ok)
     sys.exit(0 if ok else 1)
 
 

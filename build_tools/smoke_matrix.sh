@@ -17,8 +17,15 @@
 #                             of lanes retired in slice 0, cv_results_
 #                             parity <= 1e-5, 0 compiles after warmup
 #                             (convergence-compacted scheduler PR).
+#   sparse_fit_smoke.py     — ~1%-density hashed-text OvR grid: packed
+#                             warm wall >= 2x over the densified path,
+#                             shared device bytes >= 5x smaller,
+#                             converged coefficient / cv-score parity
+#                             <= 1e-5, 0 compiles after warmup
+#                             (sparse-native fit data plane PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
 python build_tools/compile_cache_smoke.py
 python build_tools/compaction_smoke.py
+python build_tools/sparse_fit_smoke.py

@@ -348,22 +348,31 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         est = self.estimator
         if not hasattr(type(est), "_build_fit_kernel"):
             return None
-        if prefers_host_engine(backend, est):
-            # the estimator resolves to its f64 host engine on this
-            # host backend: the generic per-task path below runs that
-            # engine, instead of the XLA-CPU batched program (shared
-            # gate with search/eliminate — round-5 review)
-            return None
         # dict class_weight is keyed by original labels, which do not
         # map onto the {0,1} binary sub-problems -> generic path
         if isinstance(getattr(est, "class_weight", None), dict):
             return None
-        from ..models.linear import as_dense_f32, _freeze
+        from ..models.linear import _freeze, fit_would_pack, prepare_fit_X
         import jax
         import jax.numpy as jnp
 
+        if prefers_host_engine(backend, est) and (
+                not fit_would_pack(X, est)
+                or getattr(est, "engine", None) == "host"):
+            # the estimator resolves to its f64 host engine on this
+            # host backend: the generic per-task path below runs that
+            # engine, instead of the XLA-CPU batched program (shared
+            # gate with search/eliminate — round-5 review). Packed
+            # input has no host form and stays batched under 'auto';
+            # an EXPLICIT engine='host' pin still routes to the host
+            # per-task path. fit_would_pack is indptr-only, so the
+            # bail costs nothing before prepare_fit_X's dense copy.
+            return None
         try:
-            X_arr = as_dense_f32(X)
+            # the BASELINE config-3 shape (hashed-text OvR): packable
+            # sparse X ships packed and every class column's binary fit
+            # runs the O(nnz) contractions on the one shared pair
+            X_arr = prepare_fit_X(X, est)
         except Exception:
             return None
         n, d = X_arr.shape
@@ -713,22 +722,28 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
         est = self.estimator
         if not hasattr(type(est), "_build_fit_kernel"):
             return None
-        if prefers_host_engine(backend, est):
-            # the estimator resolves to its f64 host engine on this
-            # host backend: the generic per-task path below runs that
-            # engine, instead of the XLA-CPU batched program (shared
-            # gate with search/eliminate — round-5 review)
-            return None
         # dict class_weight is keyed by original labels, which do not
         # map onto the {0,1} binary sub-problems -> generic path
         if isinstance(getattr(est, "class_weight", None), dict):
             return None
-        from ..models.linear import as_dense_f32, _freeze
+        from ..models.linear import _freeze, fit_would_pack, prepare_fit_X
         import jax
         import jax.numpy as jnp
 
+        if prefers_host_engine(backend, est) and (
+                not fit_would_pack(X, est)
+                or getattr(est, "engine", None) == "host"):
+            # the estimator resolves to its f64 host engine on this
+            # host backend: the generic per-task path below runs that
+            # engine, instead of the XLA-CPU batched program (shared
+            # gate with search/eliminate — round-5 review). Packed
+            # input has no host form and stays batched under 'auto';
+            # an EXPLICIT engine='host' pin still routes to the host
+            # per-task path. fit_would_pack is indptr-only, so the
+            # bail costs nothing before prepare_fit_X's dense copy.
+            return None
         try:
-            X_arr = as_dense_f32(X)
+            X_arr = prepare_fit_X(X, est)
         except Exception:
             return None
         y_idx = np.searchsorted(self.classes_, y).astype(np.int32)

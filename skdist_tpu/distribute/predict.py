@@ -264,33 +264,29 @@ def batch_predict(model, X, method="predict", backend=None,
 
 
 def _is_sparse_2d(X):
-    return (hasattr(X, "toarray") and hasattr(X, "tocsr")
-            and len(X.shape) == 2)
+    from ..sparse import is_sparse_2d
+
+    return is_sparse_2d(X)
 
 
 def _max_nnz_per_row(X):
-    """Packed width m for :func:`_pack_csr_rows`, from indptr alone —
-    the budget guardrail and the pack must share ONE definition, or a
-    changed padding rule would let the guardrail undercount the pack."""
-    nnz = np.diff(np.asarray(X.indptr))
-    return max(1, int(nnz.max()) if nnz.size else 1)
+    """Packed width m from indptr alone — ONE shared definition
+    (``skdist_tpu.sparse.max_nnz_per_row``) for the budget guardrail,
+    this predict path, and the fit plane's packing, so a changed
+    padding rule can never let one undercount another."""
+    from ..sparse import max_nnz_per_row
+
+    return max_nnz_per_row(X)
 
 
 def _pack_csr_rows(X):
-    """CSR → (idx (n, m) int32, val (n, m) f32), m = max nnz per row,
-    padded with (0, 0.0). The device-side scatter reconstructs each
-    row exactly: padding adds 0.0 to column 0."""
-    indptr = np.asarray(X.indptr)
-    nnz = np.diff(indptr)
-    m = _max_nnz_per_row(X)
-    n = X.shape[0]
-    pos = indptr[:-1, None] + np.arange(m)[None, :]
-    mask = np.arange(m)[None, :] < nnz[:, None]
-    idx = np.zeros((n, m), np.int32)
-    val = np.zeros((n, m), np.float32)
-    idx[mask] = np.asarray(X.indices)[pos[mask]]
-    val[mask] = np.asarray(X.data)[pos[mask]]
-    return idx, val
+    """CSR → (idx, val) padded-row pair — the SHARED packing
+    (``skdist_tpu.sparse.pack_csr_rows``, promoted from this module's
+    former private copy): the fit plane, this predict path, and the
+    packed matvec kernels all consume one format."""
+    from ..sparse import pack_csr_rows
+
+    return pack_csr_rows(X)
 
 
 def _try_device_predict_sparse(model, X, method, backend, batch_size,
@@ -308,9 +304,6 @@ def _try_device_predict_sparse(model, X, method, backend, batch_size,
         plan = device_predict_plan(model, method)
     if plan is None:
         return None
-    import jax
-    import jax.numpy as jnp
-
     kernel = plan.kernel
 
     X = X.tocsr()
@@ -347,12 +340,10 @@ def _try_device_predict_sparse(model, X, method, backend, batch_size,
     idx = idx.reshape(n_blocks, block, m)
     val = val.reshape(n_blocks, block, m)
 
-    rows_iota = np.arange(block)
+    from ..sparse import packed_to_dense
 
     def block_kernel(shared, task):
-        dense = jnp.zeros((block, d), jnp.float32).at[
-            rows_iota[:, None], task["idx"]
-        ].add(task["val"])
+        dense = packed_to_dense(task["idx"], task["val"], d)
         return {"out": kernel(shared["params"], dense)}
 
     from ..parallel import structural_key

@@ -662,14 +662,6 @@ class DistBaseSearchCV(BaseEstimator):
             # generic path clones + set_params per task, so each fit
             # resolves its own engine correctly.
             return None
-        if prefers_host_engine(backend, estimator):
-            # a host backend whose estimator resolves to the f64 BLAS
-            # host engine (engine='auto' on a CPU platform): the host
-            # fan-out runs that engine per task — the analogue of the
-            # reference's sc=None == sklearn path — instead of paying
-            # XLA-CPU prices for the batched program (round-4 VERDICT
-            # weak #6)
-            return None
         scorer_specs = _resolve_device_scoring(estimator, self.scoring)
         if scorer_specs is None:
             return None
@@ -690,12 +682,33 @@ class DistBaseSearchCV(BaseEstimator):
             return None
 
         from ..models.linear import (
-            as_dense_f32, _freeze, extract_aux, hyper_float,
+            _freeze, extract_aux, fit_would_pack, hyper_float,
+            prepare_fit_X,
         )
         import jax.numpy as jnp
 
+        if prefers_host_engine(backend, estimator) and (
+                not fit_would_pack(X, estimator)
+                or getattr(estimator, "engine", None) == "host"):
+            # a host backend whose estimator resolves to the f64 BLAS
+            # host engine (engine='auto' on a CPU platform): the host
+            # fan-out runs that engine per task — the analogue of the
+            # reference's sc=None == sklearn path — instead of paying
+            # XLA-CPU prices for the batched program (round-4 VERDICT
+            # weak #6). Packed input has no host form: under 'auto' it
+            # stays on the batched path (densifying it to reach scipy
+            # would reintroduce the host-RAM blowup the sparse plane
+            # removes); an EXPLICIT engine='host' pin still wins and
+            # routes to the host fan-out. fit_would_pack decides from
+            # indptr alone, so this bail runs BEFORE prepare_fit_X's
+            # dense f32 copy is paid for host-routed input.
+            return None
         try:
-            X_arr = as_dense_f32(X)
+            # packable sparse input stays PACKED end to end: shared X
+            # ships as the (idx, val) pair, the fit problems run the
+            # O(nnz) contractions, and the finalize scoring runs the
+            # polymorphic decision kernels on the same packed tree
+            X_arr = prepare_fit_X(X, estimator)
         except Exception:
             return None
 

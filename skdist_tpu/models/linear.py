@@ -26,6 +26,15 @@ import jax
 import jax.numpy as jnp
 
 from ..base import BaseEstimator, ClassifierMixin, RegressorMixin
+from ..sparse import (
+    LinearOperator,
+    PackedX,
+    matvec_any,
+    pack_for_fit,
+    resolve_matvec_mode,
+    sparse_to_dense_f32,
+    would_pack,
+)
 from .solvers import (
     lbfgs_carry_init,
     lbfgs_minimize,
@@ -52,34 +61,14 @@ __all__ = [
 def as_dense_f32(X):
     """Convert input to a dense float32 ndarray (TPU-resident layout).
 
-    Sparse input is densified: TPU/XLA has no efficient general sparse
-    matmul, and the framework's hashing/encoding layers are expected to
-    bound width (see ``preprocessing.HashingVectorizerChunked``). Large
-    matrices go through the native multithreaded densifier
-    (``native/densify.c``) — the zero-fill dominates scipy's
-    single-threaded ``toarray`` at device-feeding sizes.
-
-    Guardrail: a sparse input whose densified form cannot fit
-    available host RAM (or the ``SKDIST_DENSIFY_BUDGET_BYTES``
-    override) raises an informative error up front instead of grinding
-    into an OOM — real ``HashingVectorizer`` widths (2**18+) on tall
-    inputs are exactly this case. Device-side fit is NOT bounded here
-    (a 'data' mesh axis row-shards X, so one chip's HBM is the wrong
-    ceiling); that is the job of the backend's AOT round sizing.
-    Remedies are in the message; ``batch_predict`` avoids the check
-    entirely by streaming row groups.
+    Sparse input is densified through ``sparse.sparse_to_dense_f32``
+    (budget guardrail, native multithreaded densifier at device-feeding
+    sizes, 1-D ``csr_array`` column-vector handling). Callers on the
+    FIT path should prefer :func:`prepare_fit_X`, which keeps packable
+    sparse input packed (``skdist_tpu.sparse``) instead of densifying.
     """
     if hasattr(X, "toarray"):  # scipy sparse
-        if len(X.shape) == 2:
-            _check_densify_budget(X.shape[0], X.shape[1])
-        # 1-D sparse arrays (scipy >= 1.8 csr_array) have a 1-tuple
-        # shape; only 2-D input takes the native CSR fast path
-        if (hasattr(X, "tocsr") and len(X.shape) == 2
-                and X.shape[0] * X.shape[1] >= (1 << 22)):
-            from ..native import csr_to_dense_f32
-
-            return csr_to_dense_f32(X)
-        X = X.toarray()
+        return sparse_to_dense_f32(X)
     elif hasattr(X, "values") and not isinstance(X, np.ndarray):  # pandas
         X = X.values
     X = np.asarray(X)
@@ -88,31 +77,37 @@ def as_dense_f32(X):
     return np.ascontiguousarray(X, dtype=np.float32)
 
 
-def _check_densify_budget(n_rows, n_cols):
-    """Refuse a densification that cannot fit, with remedies."""
-    from ..utils.meminfo import BUDGET_ENV, densify_budget_bytes
-
-    est = int(n_rows) * int(n_cols) * 4
-    budget, source = densify_budget_bytes()
-    if budget is None or est <= budget:
-        return
-
-    def _fmt(b):
-        return (f"{b / 1e9:.2f} GB" if b >= 1e8 else f"{b / 1e6:.1f} MB")
-
-    raise ValueError(
-        f"densifying this ({n_rows}, {n_cols}) sparse input needs "
-        f"~{_fmt(est)} as float32, but only ~{_fmt(budget)} "
-        f"is available ({source}). Hashed-text widths this large do not "
-        "belong on the device dense path. Options: (1) re-hash to a "
-        "bounded width — the Encoderizer configs cap HashingVectorizer "
-        "at 2**12..2**14 for exactly this reason (distribute/_defaults"
-        ".py); (2) for inference use distribute.batch_predict, which "
-        "streams sparse rows in groups and never materialises the full "
-        "dense matrix; (3) fit on a row subset or reduce features "
-        "first (TruncatedSVDTransformer); (4) raise the limit "
-        f"explicitly via {BUDGET_ENV} if you know better."
+def prepare_fit_X(X, est=None):
+    """Fit-plane input routing: a :class:`~skdist_tpu.sparse.PackedX`
+    when the packed-CSR sparse plane wins for this input AND the
+    estimator family consumes it (``_supports_packed_X`` — the linear
+    families), else a dense float32 ndarray. The predict-side entry
+    points route through this too, so a sparse-fit model scores sparse
+    input without ever materialising the dense matrix."""
+    cls = (
+        est if isinstance(est, type)
+        else (type(est) if est is not None else None)
     )
+    if cls is None or getattr(cls, "_supports_packed_X", False):
+        packed = pack_for_fit(X)
+        if packed is not None:
+            return packed
+    return as_dense_f32(X)
+
+
+def fit_would_pack(X, est=None):
+    """Whether :func:`prepare_fit_X` would return a ``PackedX`` for
+    this (input, estimator) pair — the same routing, decided from
+    shape/``indptr`` alone with no conversion or packing. Callers use
+    it to order bails (e.g. the host-engine gate) BEFORE paying
+    ``prepare_fit_X``'s dense f32 copy for input that will not pack."""
+    cls = (
+        est if isinstance(est, type)
+        else (type(est) if est is not None else None)
+    )
+    if cls is not None and not getattr(cls, "_supports_packed_X", False):
+        return False
+    return would_pack(X)
 
 
 def host_stage(x):
@@ -127,6 +122,8 @@ def host_stage(x):
     transfer to the placement layer, where sharding and the opt-in
     reuse cache live.
     """
+    if isinstance(x, PackedX):
+        return PackedX(host_stage(x.idx), host_stage(x.val), x.n_cols)
     if hasattr(x, "sharding"):  # already a jax array: leave it be
         return x
     return np.asarray(x)
@@ -260,6 +257,33 @@ def _meta_signature(meta):
         meta.get("n_classes"),
         tuple(cw.tolist()) if cw is not None else None,
         meta.get("y_ndim"),
+        # the sparse plane is compile-shaping: a packed-X kernel and a
+        # dense-X kernel of the same family must never share a cache
+        # entry, and neither must two packed matvec modes
+        meta.get("x_format"),
+        meta.get("x_matvec"),
+    )
+
+
+def _annotate_x_meta(meta, X):
+    """Record the fit-data representation in ``meta`` — consumed by the
+    kernel builders (packed vs dense problems) and by
+    :func:`_meta_signature` (structural compile keys)."""
+    if isinstance(X, PackedX):
+        meta["x_format"] = "packed"
+        meta["x_matvec"] = resolve_matvec_mode()
+    return meta
+
+
+def _linear_op(X, fit_intercept, meta, matmul_dtype=None):
+    """The one construction point of the fit problems' matvec
+    interface (``sparse.LinearOperator``): dense X reproduces the
+    historical expressions verbatim; packed X routes through the
+    gather/scatter kernels in the mode ``meta`` resolved at prep
+    time."""
+    return LinearOperator(
+        X, fit_intercept, matmul_dtype=matmul_dtype,
+        mode=meta.get("x_matvec", "gather"),
     )
 
 
@@ -308,10 +332,24 @@ class _LinearModelBase(BaseEstimator):
     _hyper_names = ()
     _static_names = ()
 
+    #: the linear families consume packed-CSR X (skdist_tpu.sparse)
+    #: through the fit problems' matvec interface; families without the
+    #: flag always receive dense input from :func:`prepare_fit_X`
+    _supports_packed_X = True
+
     # ---- host-facing API -------------------------------------------------
     def fit(self, X, y, sample_weight=None):
-        X = as_dense_f32(X)
-        if self._resolve_host_engine():
+        # packed input has no host (f64 BLAS) form: under engine='auto'
+        # the packed XLA path IS the sparse engine on every platform —
+        # densifying a packable hashed-text input to reach scipy would
+        # reintroduce the exact host-RAM blowup this plane removes. An
+        # EXPLICIT engine='host' pin is still honoured: it densifies
+        # (the budget guardrail speaks when that cannot work).
+        if getattr(self, "engine", None) == "host":
+            X = as_dense_f32(X)
+        else:
+            X = prepare_fit_X(X, type(self))
+        if not isinstance(X, PackedX) and self._resolve_host_engine():
             return self._host_fit(X, y, sample_weight)
         data, meta = self._prep_fit_data(X, y, sample_weight)
         static = self._static_config(meta)
@@ -385,10 +423,12 @@ class _LinearModelBase(BaseEstimator):
 
     def decision_function(self, X):
         self._check_fitted()
-        X = as_dense_f32(X)
+        # sparse predict input stays packed when packing wins — the
+        # decision kernels are representation-polymorphic (matvec_any)
+        X = prepare_fit_X(X, type(self))
         static = _freeze(self._static_config(self._meta))
         kernel = get_kernel(type(self), "decision", self._meta, static)
-        out = np.asarray(kernel(_to_jnp(self._params), jnp.asarray(X)))
+        out = np.asarray(kernel(_to_jnp(self._params), _to_jnp(X)))
         return out
 
     @property
@@ -445,13 +485,6 @@ def _to_jnp(tree):
     return jax.tree_util.tree_map(jnp.asarray, tree)
 
 
-def _augment(X, fit_intercept):
-    if fit_intercept:
-        ones = jnp.ones((X.shape[0], 1), X.dtype)
-        return jnp.concatenate([X, ones], axis=1)
-    return X
-
-
 def _split_Wb(W, d, fit_intercept, n_out):
     """W (p,) or (p,k) → (weights, bias)."""
     if W.ndim == 1:
@@ -466,12 +499,12 @@ class _LinearClassifierBase(_LinearModelBase, ClassifierMixin):
     def _prep_fit_data(self, X, y, sample_weight=None):
         y_idx, classes = encode_labels(y)
         sw = prepare_sample_weight(sample_weight, X.shape[0])
-        meta = {
+        meta = _annotate_x_meta({
             "n_features": X.shape[1],
             "classes": classes,
             "n_classes": len(classes),
             "cw_arr": class_weight_vector(getattr(self, "class_weight", None), classes),
-        }
+        }, X)
         data = {
             "X": host_stage(X),
             "y": host_stage(y_idx),
@@ -709,29 +742,21 @@ class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
 
         def problem(X, y_idx, sw, hyper):
             C = hyper["C"]
-            Xa = _augment(X, fit_intercept)
-            p = Xa.shape[1]
+            # one matvec interface over dense AND packed-CSR X: the
+            # operator reproduces the historical dense expressions
+            # verbatim (incl. the bf16 dot_general), and routes packed
+            # input through the sparse plane's gather/scatter kernels
+            # — autodiff of the gather matvec IS the scatter-add
+            # X.T @ r, so the whole L-BFGS solve runs O(nnz) per
+            # iteration with no second code path in the solver
+            op = _linear_op(X, fit_intercept, meta,
+                            matmul_dtype="bfloat16" if bf16 else None)
+            p = op.p
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
             d = meta["n_features"]
-            if bf16:
-                # bf16 operands, f32 accumulation: MXU-rate matmuls
-                # while the solver state stays f32
-                Xmm = Xa.astype(jnp.bfloat16)
-
-                def matvec(w):
-                    # precision pinned so the library-wide 'highest'
-                    # tracing default doesn't promote the bf16 pass
-                    return jax.lax.dot_general(
-                        Xmm, w.astype(jnp.bfloat16),
-                        (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                        precision=jax.lax.Precision.DEFAULT,
-                    )
-            else:
-                def matvec(w):
-                    return Xa @ w
+            matvec = op.matvec
             if binary:
-                ypm = (y_idx == (k - 1)).astype(X.dtype)  # {0,1}
+                ypm = (y_idx == (k - 1)).astype(op.dtype)  # {0,1}
 
                 def loss(w):
                     z = matvec(w)
@@ -741,14 +766,14 @@ class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
                     reg = 0.5 / C * jnp.dot(w[:d], w[:d])
                     return ce + reg
 
-                w0 = jnp.zeros(p, X.dtype)
+                w0 = jnp.zeros(p, op.dtype)
 
                 def unpack(w, n_iter):
                     return {"W": w, "n_iter": n_iter}
 
                 return loss, w0, unpack
 
-            onehot = jax.nn.one_hot(y_idx, k, dtype=X.dtype)
+            onehot = jax.nn.one_hot(y_idx, k, dtype=op.dtype)
 
             def loss(wflat):
                 W = wflat.reshape(p, k)
@@ -760,7 +785,7 @@ class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
                 reg = 0.5 / C * jnp.sum(W[:d] * W[:d])
                 return ce + reg
 
-            w0 = jnp.zeros(p * k, X.dtype)
+            w0 = jnp.zeros(p * k, op.dtype)
 
             def unpack(w, n_iter):
                 return {"W": w.reshape(p, k), "n_iter": n_iter}
@@ -777,9 +802,13 @@ class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
 
         @jax.jit
         def decision(params, X):
+            # representation-polymorphic: X may be a dense block (the
+            # predict side) OR the shared packed pair (the batched CV
+            # finalize scoring a sparse fit) — matvec_any dispatches on
+            # the pytree structure at trace time
             W = params["W"]
             w, b = _split_Wb(W, d, fit_intercept, meta["n_classes"])
-            return X @ w + b
+            return matvec_any(X, w) + b
 
         return decision
 
@@ -800,10 +829,10 @@ class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
 
     def predict_proba(self, X):
         self._check_fitted()
-        X = as_dense_f32(X)
+        X = prepare_fit_X(X, type(self))
         static = _freeze(self._static_config(self._meta))
         kernel = get_kernel(type(self), "proba", self._meta, static)
-        return np.asarray(kernel(_to_jnp(self._params), jnp.asarray(X)))
+        return np.asarray(kernel(_to_jnp(self._params), _to_jnp(X)))
 
     def predict_log_proba(self, X):
         return np.log(np.clip(self.predict_proba(X), 1e-15, None))
@@ -899,32 +928,34 @@ class LinearSVC(_LbfgsFitMixin, _LinearClassifierBase):
 
         def problem(X, y_idx, sw, hyper):
             C = hyper["C"]
-            Xa = _augment(X, fit_intercept)
-            p = Xa.shape[1]
+            # dense or packed-CSR X behind one matvec interface (see
+            # LogisticRegression._build_fit_problem)
+            op = _linear_op(X, fit_intercept, meta)
+            p = op.p
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
             if binary:
-                ypm = jnp.where(y_idx == (k - 1), 1.0, -1.0).astype(X.dtype)
+                ypm = jnp.where(y_idx == (k - 1), 1.0, -1.0).astype(op.dtype)
 
                 def loss(w):
-                    margin = jnp.maximum(0.0, 1.0 - ypm * (Xa @ w))
+                    margin = jnp.maximum(0.0, 1.0 - ypm * op.matvec(w))
                     return 0.5 * jnp.dot(w[:d], w[:d]) + C * jnp.sum(sw * margin**2)
 
-                w0 = jnp.zeros(p, X.dtype)
+                w0 = jnp.zeros(p, op.dtype)
 
                 def unpack(w, n_iter):
                     return {"W": w, "n_iter": n_iter}
 
                 return loss, w0, unpack
 
-            Ypm = jnp.where(jax.nn.one_hot(y_idx, k) > 0, 1.0, -1.0).astype(X.dtype)
+            Ypm = jnp.where(jax.nn.one_hot(y_idx, k) > 0, 1.0, -1.0).astype(op.dtype)
 
             def loss(wflat):
                 W = wflat.reshape(p, k)
-                margins = jnp.maximum(0.0, 1.0 - Ypm * (Xa @ W))
+                margins = jnp.maximum(0.0, 1.0 - Ypm * op.matvec(W))
                 hinge = jnp.sum(sw[:, None] * margins**2)
                 return 0.5 * jnp.sum(W[:d] * W[:d]) + C * hinge
 
-            w0 = jnp.zeros(p * k, X.dtype)
+            w0 = jnp.zeros(p * k, op.dtype)
 
             def unpack(w, n_iter):
                 return {"W": w.reshape(p, k), "n_iter": n_iter}
@@ -1058,14 +1089,17 @@ class SGDClassifier(_LinearClassifierBase):
             alpha = hyper["alpha"]
             eta0 = hyper["eta0"]
             l1_ratio = hyper["l1_ratio"]
-            n = X.shape[0]
-            Xa = _augment(X, fit_intercept)
-            p = Xa.shape[1]
+            # dense or packed-CSR X behind one matvec interface; the
+            # mini-batch forms gather the batch's packed rows, so each
+            # SGD step is O(batch nnz) instead of O(batch·d)
+            op = _linear_op(X, fit_intercept, meta)
+            n = op.n
+            p = op.p
             sw_full = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
             if n_out == 1:
-                Ypm = jnp.where(y_idx == (k - 1), 1.0, -1.0).astype(X.dtype)[:, None]
+                Ypm = jnp.where(y_idx == (k - 1), 1.0, -1.0).astype(op.dtype)[:, None]
             else:
-                Ypm = jnp.where(jax.nn.one_hot(y_idx, k) > 0, 1.0, -1.0).astype(X.dtype)
+                Ypm = jnp.where(jax.nn.one_hot(y_idx, k) > 0, 1.0, -1.0).astype(op.dtype)
             dloss = pointwise_grad_factory(alpha)
 
             if loss_name == "log_loss":
@@ -1085,17 +1119,18 @@ class SGDClassifier(_LinearClassifierBase):
                 # separable per-column binary losses
                 W = Wf.reshape(p, n_out)
                 wb = sw_full[idx]
-                per = ploss(Xa[idx] @ W, Ypm[idx]).sum(axis=1) * wb
+                per = ploss(op.row_matvec(idx, W), Ypm[idx]).sum(axis=1) * wb
                 return jnp.sum(per) / jnp.maximum(jnp.sum(wb), 1e-12)
 
             def grad_fn(Wf, idx):
                 W = Wf.reshape(p, n_out)
-                xb = Xa[idx]
                 yb = Ypm[idx]
                 wb = sw_full[idx][:, None]
-                z = xb @ W
+                z = op.row_matvec(idx, W)
                 g_z = dloss(z, yb) * wb
-                g = xb.T @ g_z / jnp.maximum(jnp.sum(sw_full[idx]), 1e-12)
+                g = op.row_rmatvec(idx, g_z) / jnp.maximum(
+                    jnp.sum(sw_full[idx]), 1e-12
+                )
                 if penalty in ("l2", "elasticnet"):
                     l2_mul = 1.0 if penalty == "l2" else (1.0 - l1_ratio)
                     g = g.at[:d].add(alpha * l2_mul * W[:d])
@@ -1123,7 +1158,7 @@ class SGDClassifier(_LinearClassifierBase):
                 def lr_fn(t):
                     return eta0 * jnp.ones_like(t, jnp.float32)
 
-            W0 = jnp.zeros(p * n_out, X.dtype)
+            W0 = jnp.zeros(p * n_out, op.dtype)
 
             if penalty in ("l1", "elasticnet"):
                 l1_mul = 1.0 if penalty == "l1" else l1_ratio
@@ -1243,10 +1278,10 @@ class SGDClassifier(_LinearClassifierBase):
                 "predict_proba is only available with loss='log_loss'"
             )
         self._check_fitted()
-        X = as_dense_f32(X)
+        X = prepare_fit_X(X, type(self))
         static = _freeze(self._static_config(self._meta))
         kernel = get_kernel(type(self), "proba", self._meta, static)
-        return np.asarray(kernel(_to_jnp(self._params), jnp.asarray(X)))
+        return np.asarray(kernel(_to_jnp(self._params), _to_jnp(X)))
 
 
 # --------------------------------------------------------------------------
@@ -1255,15 +1290,16 @@ class SGDClassifier(_LinearClassifierBase):
 
 class _RidgeKernelMixin:
     @staticmethod
-    def _solve(Xa, T, sw, alpha, d):
+    def _solve(op, T, sw, alpha, d):
         """Weighted ridge: solve (XᵀSX + αI₀)W = XᵀST; intercept column
-        unpenalised (I₀ has zero at the bias position)."""
-        Xw = Xa * sw[:, None]
-        G = Xa.T @ Xw                     # (p, p) gram — MXU matmul
+        unpenalised (I₀ has zero at the bias position). ``op`` is the
+        matvec interface (``_linear_op``): dense X keeps the MXU gram
+        matmul verbatim; packed X builds the gram by the m² scatter
+        (O(nnz·m) instead of O(n·d²))."""
+        G, b = op.weighted_gram_rhs(sw, T)  # (p, p), (p, k)
         p = G.shape[0]
         reg = jnp.concatenate([jnp.full((d,), alpha), jnp.zeros(p - d)])
         G = G + jnp.diag(reg)
-        b = Xw.T @ T                      # (p, k)
         # jitter for singular grams (e.g. alpha=0 OLS)
         G = G + 1e-8 * jnp.eye(p, dtype=G.dtype)
         W = jax.scipy.linalg.solve(G, b, assume_a="pos")
@@ -1284,7 +1320,9 @@ class Ridge(_LinearModelBase, RegressorMixin, _RidgeKernelMixin):
     def _prep_fit_data(self, X, y, sample_weight=None):
         y = np.asarray(y, dtype=np.float32)
         sw = prepare_sample_weight(sample_weight, X.shape[0])
-        meta = {"n_features": X.shape[1], "y_ndim": y.ndim}
+        meta = _annotate_x_meta(
+            {"n_features": X.shape[1], "y_ndim": y.ndim}, X
+        )
         data = {"X": host_stage(X), "y": host_stage(y), "sw": host_stage(sw)}
         return data, meta
 
@@ -1296,9 +1334,9 @@ class Ridge(_LinearModelBase, RegressorMixin, _RidgeKernelMixin):
 
         def kernel(X, y, sw, hyper, aux=None):
             alpha = hyper["alpha"]
-            Xa = _augment(X, fit_intercept)
+            op = _linear_op(X, fit_intercept, meta)
             T = y.reshape(y.shape[0], -1)
-            W = cls._solve(Xa, T, sw, alpha, d)
+            W = cls._solve(op, T, sw, alpha, d)
             if meta.get("y_ndim", 1) == 1:
                 W = W[:, 0]
             return {"W": W}
@@ -1315,7 +1353,7 @@ class Ridge(_LinearModelBase, RegressorMixin, _RidgeKernelMixin):
         def decision(params, X):
             W = params["W"]
             w, b = _split_Wb(W, d, fit_intercept, 1)
-            return X @ w + b
+            return matvec_any(X, w) + b
 
         return decision
 
@@ -1373,13 +1411,13 @@ class RidgeClassifier(_LinearClassifierBase, _RidgeKernelMixin):
 
         def kernel(X, y_idx, sw, hyper, aux=None):
             alpha = hyper["alpha"]
-            Xa = _augment(X, fit_intercept)
+            op = _linear_op(X, fit_intercept, meta)
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
             if k <= 2:
-                T = jnp.where(y_idx == (k - 1), 1.0, -1.0).astype(X.dtype)[:, None]
+                T = jnp.where(y_idx == (k - 1), 1.0, -1.0).astype(op.dtype)[:, None]
             else:
-                T = jnp.where(jax.nn.one_hot(y_idx, k) > 0, 1.0, -1.0).astype(X.dtype)
-            W = cls._solve(Xa, T, sw, alpha, d)
+                T = jnp.where(jax.nn.one_hot(y_idx, k) > 0, 1.0, -1.0).astype(op.dtype)
+            W = cls._solve(op, T, sw, alpha, d)
             if k <= 2:
                 W = W[:, 0]
             return {"W": W}

@@ -29,6 +29,15 @@ resume, which is what makes a sliced run *bitwise identical* to the
 unsliced solve: both apply the same traced body the same number of
 times to the same carried state — slicing only changes where the host
 observes the carry.
+
+Data-representation agnosticism: neither solver ever touches X — the
+heavy contractions live in the caller's loss/grad closures, built over
+the ``skdist_tpu.sparse.LinearOperator`` matvec interface. A packed-CSR
+X (gather ``X @ w`` forward, whose autodiff VJP is the scatter-add
+``X.T @ r``) therefore runs through BOTH solvers — and the iteration-
+sliced carry forms, and the convergence-compacted scheduler above them
+— without a single sparse-specific line here: per-iteration cost drops
+from O(n·d) to O(nnz) purely through the closures.
 """
 
 import jax

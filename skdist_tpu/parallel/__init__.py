@@ -22,6 +22,7 @@ from .backend import (
     resolve_backend,
     resolve_slice_iters,
     row_sharded_specs,
+    tree_nbytes,
 )
 from .compile_cache import enable_disk_cache, structural_key
 
@@ -41,6 +42,7 @@ __all__ = [
     "iterative_chunk_size",
     "get_value",
     "row_sharded_specs",
+    "tree_nbytes",
     "compile_cache",
     "enable_disk_cache",
     "structural_key",

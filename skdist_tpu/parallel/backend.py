@@ -61,6 +61,19 @@ def prefers_host_engine(backend, estimator):
     return bool(resolve())
 
 
+def tree_nbytes(tree):
+    """Total leaf bytes of a pytree — the placement layer's shared-data
+    byte accounting (registered pytree containers like
+    ``sparse.PackedX`` contribute their actual leaves)."""
+    import jax
+
+    return int(sum(
+        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+        for l in jax.tree_util.tree_leaves(tree)
+        if hasattr(l, "shape")
+    ))
+
+
 def parse_partitions(partitions, n_tasks):
     """Resolve a partition policy to a device-round size.
 
@@ -113,6 +126,14 @@ class TaskBackend:
     #: observability for the pipelined round scheduler
     last_round_stats = None
 
+    #: total leaf bytes of the most recently placed shared-data tree —
+    #: the placement layer's byte accounting. A packed-CSR leaf pair
+    #: (``sparse.PackedX``) contributes its idx+val bytes, NOT its
+    #: logical dense size, so this is the number that shows the sparse
+    #: plane's device-memory win (and what the sparse fit smoke
+    #: asserts shrank)
+    last_shared_bytes = None
+
     def run_tasks(self, fn, tasks, verbose=0):
         raise NotImplementedError
 
@@ -164,7 +185,10 @@ class TaskBackend:
         proactive round sizing applies after compiling, exposed so
         callers (the serving registry's shape buckets) can cap shapes
         BEFORE committing to compile them. ``bytes_per_task`` counts
-        one task's argument + output bytes; the cap budgets
+        one task's argument + output bytes — compute it with
+        :func:`tree_nbytes` so registered containers (the sparse
+        plane's packed idx/val pairs) are billed at their true leaf
+        bytes, not their logical dense size; the cap budgets
         ``_MAX_ROUNDS_IN_FLIGHT`` rounds of them inside ``headroom`` of
         free memory (temps are unknowable without compiling — callers
         wanting exactness still get the reactive backstop). Returns
@@ -396,6 +420,7 @@ class LocalBackend(TaskBackend):
 
         fn = _jit_vmapped(kernel, static_args, None, None, cache_key, False)
         shared_args = jax.tree_util.tree_map(jnp.asarray, shared_args)
+        self.last_shared_bytes = tree_nbytes(shared_args)
         return BatchedPlan(fn, shared_args, lambda t: t, n_task_slots=1)
 
     supports_iterative = True
@@ -410,6 +435,7 @@ class LocalBackend(TaskBackend):
             spec, static_args, None, None, cache_key
         )
         shared_args = jax.tree_util.tree_map(jnp.asarray, shared_args)
+        self.last_shared_bytes = tree_nbytes(shared_args)
         return IterativePlan(*fns, shared_args, lambda t: t, n_task_slots=1)
 
     def batched_map_iterative(self, spec, task_args, shared_args=(),
@@ -453,6 +479,7 @@ class LocalBackend(TaskBackend):
         # (uncommitted), which jit cannot donate — requesting it would
         # only emit unusable-donation noise
         fn = _jit_vmapped(kernel, static_args, None, None, cache_key, False)
+        self.last_shared_bytes = tree_nbytes(shared_args)
         n_tasks = _leading_dim(task_args)
         if pad_to_round and round_size:
             chunk = round_size
@@ -612,6 +639,9 @@ class TPUBackend(TaskBackend):
         put = lambda t: jax.tree_util.tree_map(
             lambda a: _put_mesh_scoped(a, task_sharding), t
         )
+        # byte-account what was just placed: packed-CSR leaves count
+        # their idx+val bytes, not their logical dense size
+        self.last_shared_bytes = tree_nbytes(shared_args)
         return task_sharding, shared_shardings, shared_args, put
 
     def prepare_batched(self, kernel, shared_args=(), static_args=None,

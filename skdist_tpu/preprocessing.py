@@ -284,9 +284,10 @@ class TruncatedSVDTransformer(BaseEstimator, TransformerMixin):
     """Randomized truncated SVD (Halko-Martinsson-Tropp) for feature
     reduction ahead of the device dense path.
 
-    The densify guardrail (``models/linear.py::_check_densify_budget``)
-    names this transformer as the remedy for hashed-text widths too
-    wide to densify: ``X`` (sparse or dense, width ``d``) is projected
+    The densify guardrail (``skdist_tpu/sparse.py::_check_densify_budget``)
+    names this transformer as a remedy for hashed-text widths too wide
+    to densify (packable sparse input now routes to the packed fit
+    plane first): ``X`` (sparse or dense, width ``d``) is projected
     onto its top ``n_components`` right-singular directions, and the
     (n, n_components) output is narrow enough for the MXU kernels.
 

@@ -1,0 +1,598 @@
+"""
+Packed-CSR shared-data plane: sparse X as a first-class fit/predict
+representation.
+
+The flagship workloads are hashed-text grids (the reference's 20news
+OvR/OvO examples, BASELINE config 3): a HashingVectorizer matrix at
+2**18 columns and ~1% density. Densifying that input — the original
+fit-path policy — inflates it ~100x in host RAM, replicates the dense
+copy into every device's HBM, and pays O(n·d) solver FLOPs on zeros.
+This module is the shared alternative, promoted from the predict-side
+CSR path (``distribute/predict.py``'s former private ``_pack_csr_rows``)
+and consumed by the fit plane, the batched search/multiclass paths, and
+batch prediction alike:
+
+- :class:`PackedX` — the device representation: ``idx (n, m) int32`` /
+  ``val (n, m) float32``, one padded row per sample, ``m`` = max nnz
+  per row. Padding entries are ``(0, 0.0)``: every kernel below treats
+  them as "add 0.0 to column 0", so the representation is EXACT. It is
+  a registered JAX pytree, which is what makes the rest of the stack
+  indifferent to it: backend placement (``_resolve_placement``), the
+  broadcast-reuse cache (keyed per host leaf), row-sharded
+  ``shared_specs``, ``shape_sig``/AOT keys, and donation all operate on
+  its two leaves like any other shared array.
+- the two contractions every linear solver needs:
+  :func:`packed_matvec` (``X @ W``: gather + row-dot, O(nnz·k)) and
+  :func:`packed_rmatvec` (``X.T @ r``: scatter-add over the packed
+  columns, O(nnz·k)) — plus :func:`packed_to_dense` (the
+  dense-matmul-on-packed variant: one device scatter rebuilds the dense
+  block, then the MXU runs ordinary matmuls; H2D still ships only the
+  packed pair) and :func:`packed_weighted_gram` (``XᵀSX`` via the m²
+  scatter, for the closed-form ridge family).
+- routing (:func:`pack_for_fit`): pack exactly when packing wins.
+  The padded pair costs ``n·m·8`` bytes vs ``n·d·4`` dense, so the
+  decision is byte-driven (``d >= 2·m·savings``; savings default 4x,
+  see :data:`PACK_MIN_SAVINGS`) with an nnz-OUTLIER guard: a few rows
+  with huge nnz inflate ``m`` — and the padding bill — for every row,
+  so heavily skewed inputs fall back to the densify path rather than
+  pay max-row padding. ``SKDIST_SPARSE_FIT=0`` disables packing
+  entirely; ``=1``/``force`` packs any 2-D sparse input.
+- matvec-mode selection (:func:`resolve_matvec_mode`): ``gather`` vs
+  ``dense`` (dense-matmul-on-packed) is a measured, persisted decision
+  per platform — the same calibration idiom as the tree kernels'
+  ``hist_mode`` (``models/hist_calib.py``): environment override, then
+  a committed ``sparse_calib.json`` table written by on-platform
+  sweeps (:func:`record_matvec_calibration`), then the heuristic
+  default (``gather`` — nnz-proportional everywhere; ``dense`` only
+  wins where an MXU makes the rebuilt matmul ~free).
+
+The 1-tuple-shape special case of scipy's 1-D sparse arrays
+(``csr_array`` of a vector) is handled ONCE here, in
+:func:`sparse_to_dense_f32` — 1-D sparse input is a column vector,
+exactly as the dense path treats a 1-D ndarray.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "PackedX",
+    "is_sparse_2d",
+    "max_nnz_per_row",
+    "pack_csr_rows",
+    "pack_decision",
+    "would_pack",
+    "pack_for_fit",
+    "sparse_to_dense_f32",
+    "packed_matvec",
+    "packed_rmatvec",
+    "packed_to_dense",
+    "packed_weighted_gram",
+    "matvec_any",
+    "LinearOperator",
+    "resolve_matvec_mode",
+    "get_matvec_calibration",
+    "record_matvec_calibration",
+]
+
+#: kill switch / force switch for the packed fit plane: "0" restores
+#: the densify-everything policy, "1"/"force" packs any 2-D sparse
+#: input regardless of the byte heuristic
+SPARSE_FIT_ENV = "SKDIST_SPARSE_FIT"
+
+#: explicit matvec-mode override: "gather" | "dense"
+SPARSE_MATVEC_ENV = "SKDIST_SPARSE_MATVEC"
+
+#: how many times smaller (bytes) the packed pair must be than the
+#: dense f32 matrix before the fit path packs — below this the MXU's
+#: dense matmul beats gather/scatter indexing
+PACK_MIN_SAVINGS = 4.0
+PACK_SAVINGS_ENV = "SKDIST_SPARSE_PACK_SAVINGS"
+
+#: nnz-outlier guard: when the max row nnz exceeds this multiple of the
+#: 95th percentile AND padding inflates the packed pair past the same
+#: multiple of the true nnz, the matrix is skew-pathological — max-row
+#: padding would bill every row for a handful of heavy ones
+OUTLIER_FACTOR = 4.0
+
+_VALID_MATVEC_MODES = ("gather", "dense")
+
+
+# ---------------------------------------------------------------------------
+# the packed representation
+# ---------------------------------------------------------------------------
+
+class PackedX:
+    """Padded-row packed CSR: ``idx (n, m) int32``, ``val (n, m) f32``.
+
+    A registered JAX pytree whose leaves are the two arrays and whose
+    static treedef carries ``n_cols`` — so the logical width ``d`` is a
+    compile-time constant wherever the pytree flows (kernels read it
+    without tracing it), and two packings of different widths can never
+    share a compiled program.
+    """
+
+    __slots__ = ("idx", "val", "n_cols")
+
+    def __init__(self, idx, val, n_cols):
+        self.idx = idx
+        self.val = val
+        self.n_cols = int(n_cols)
+
+    @property
+    def shape(self):
+        """Logical (n, d) — what shape-generic callers read."""
+        return (self.idx.shape[0], self.n_cols)
+
+    def __len__(self):
+        return int(self.idx.shape[0])
+
+    @property
+    def m(self):
+        """Packed width: max nnz per row (plus padding)."""
+        return int(self.idx.shape[1])
+
+    @property
+    def nbytes(self):
+        return int(self.idx.nbytes) + int(self.val.nbytes)
+
+    @property
+    def dense_nbytes(self):
+        """What the densified f32 matrix would cost."""
+        return int(self.shape[0]) * int(self.n_cols) * 4
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        n, d = self.shape
+        return (f"PackedX(n={n}, d={d}, m={self.m}, "
+                f"{self.nbytes >> 10} KiB packed vs "
+                f"{self.dense_nbytes >> 10} KiB dense)")
+
+
+jax.tree_util.register_pytree_node(
+    PackedX,
+    lambda x: ((x.idx, x.val), x.n_cols),
+    lambda n_cols, leaves: PackedX(leaves[0], leaves[1], n_cols),
+)
+
+
+# ---------------------------------------------------------------------------
+# host-side packing + routing
+# ---------------------------------------------------------------------------
+
+def is_sparse_2d(X):
+    """scipy-sparse duck test, 2-D only (1-D sparse arrays are column
+    vectors for the dense path — see :func:`sparse_to_dense_f32`)."""
+    return (hasattr(X, "toarray") and hasattr(X, "tocsr")
+            and len(X.shape) == 2)
+
+
+def max_nnz_per_row(X):
+    """Packed width m from ``indptr`` alone — shared by the budget
+    guardrails and the pack so they can never disagree about the
+    padding rule (a changed rule here changes both)."""
+    nnz = np.diff(np.asarray(X.indptr))
+    return max(1, int(nnz.max()) if nnz.size else 1)
+
+
+def pack_csr_rows(X):
+    """CSR → ``(idx (n, m) int32, val (n, m) f32)``, m = max nnz per
+    row, padded with ``(0, 0.0)``. Every consumer kernel treats padding
+    as "add 0.0 to column 0", so the packed form is exact."""
+    indptr = np.asarray(X.indptr)
+    nnz = np.diff(indptr)
+    m = max_nnz_per_row(X)
+    n = X.shape[0]
+    pos = indptr[:-1, None] + np.arange(m)[None, :]
+    mask = np.arange(m)[None, :] < nnz[:, None]
+    idx = np.zeros((n, m), np.int32)
+    val = np.zeros((n, m), np.float32)
+    idx[mask] = np.asarray(X.indices)[pos[mask]]
+    val[mask] = np.asarray(X.data)[pos[mask]]
+    return idx, val
+
+
+def _pack_savings():
+    env = os.environ.get(PACK_SAVINGS_ENV, "").strip()
+    if env:
+        try:
+            v = float(env)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return PACK_MIN_SAVINGS
+
+
+def pack_decision(X):
+    """Routing decision for a 2-D CSR input: ``(pack, reason, m)``.
+
+    ``pack`` is True when the packed pair beats the dense matrix by at
+    least :data:`PACK_MIN_SAVINGS` in device bytes (``n·m·8`` vs
+    ``n·d·4``) AND the nnz distribution is not outlier-skewed. All
+    statistics come from ``indptr`` alone — no data is touched before
+    the decision, so declining costs nothing.
+    """
+    env = os.environ.get(SPARSE_FIT_ENV, "").strip().lower()
+    if env in ("0", "false", "no", "off"):
+        return False, "disabled via " + SPARSE_FIT_ENV, None
+    nnz = np.diff(np.asarray(X.indptr))
+    m = max(1, int(nnz.max()) if nnz.size else 1)
+    if env in ("1", "true", "force", "on"):
+        return True, "forced via " + SPARSE_FIT_ENV, m
+    n, d = X.shape
+    if n == 0:
+        return False, "empty input", m
+    if m * 8 * _pack_savings() > d * 4:
+        return False, (
+            f"dense-competitive density (m={m} of d={d}: the packed "
+            f"pair saves < {_pack_savings()}x device bytes)"
+        ), m
+    # nnz-outlier guard: m is the MAX row nnz, and every row pays
+    # padding to it — a handful of heavy rows must not bill the rest
+    p95 = float(np.percentile(nnz, 95)) if nnz.size else 0.0
+    total = max(1, int(nnz.sum()))
+    if (m > OUTLIER_FACTOR * max(p95, 1.0)
+            and n * m > OUTLIER_FACTOR * total):
+        return False, (
+            f"nnz outlier (max row nnz {m} vs p95 {p95:.0f}: padding "
+            f"would inflate {total} nnz to {n * m} slots)"
+        ), m
+    return True, "packed", m
+
+
+def would_pack(X):
+    """Whether :func:`pack_for_fit` would return a ``PackedX`` for
+    ``X`` — the same routing decision (sparsity, byte heuristic,
+    outlier guard, pack-budget check), decided from shape and
+    ``indptr`` alone without building anything. Callers that only need
+    the routing outcome (e.g. to order a host-path bail before paying
+    a dense conversion) use this instead of packing and discarding."""
+    if not is_sparse_2d(X):
+        return False
+    X = X.tocsr()
+    pack, _reason, m = pack_decision(X)
+    if not pack:
+        return False
+    from .utils.meminfo import densify_budget_bytes
+
+    budget, _ = densify_budget_bytes()
+    n, _d = X.shape
+    if budget is not None and n * max(1, m) * 8 * 3 > budget:
+        # the pack itself is budget-checked (the pair plus its build
+        # intermediates must fit host RAM — if they don't, dense
+        # certainly doesn't either, and the densify guardrail owns the
+        # error message)
+        return False
+    return True
+
+
+def pack_for_fit(X):
+    """``PackedX`` when the fit plane should consume ``X`` packed, else
+    None (callers densify). Non-sparse and 1-D sparse inputs always
+    return None; the routing decision lives in :func:`would_pack`."""
+    if not would_pack(X):
+        return None
+    X = X.tocsr()
+    idx, val = pack_csr_rows(X)
+    return PackedX(idx, val, X.shape[1])
+
+
+def sparse_to_dense_f32(X):
+    """Densify a scipy-sparse input to float32, with the budget
+    guardrail. The sparse leg of ``models.linear.as_dense_f32``; the
+    1-tuple-shape special case of scipy's 1-D sparse arrays is handled
+    here (column vector), once, for every caller."""
+    if len(X.shape) == 1:
+        # csr_array of a vector: 1-tuple shape; a 1-D input is a
+        # single feature column, exactly like a 1-D ndarray
+        out = np.asarray(X.toarray(), dtype=np.float32)
+        return np.ascontiguousarray(out.reshape(-1, 1))
+    _check_densify_budget(X.shape[0], X.shape[1])
+    if hasattr(X, "tocsr") and X.shape[0] * X.shape[1] >= (1 << 22):
+        from .native import csr_to_dense_f32
+
+        return csr_to_dense_f32(X)
+    out = np.asarray(X.toarray())
+    if out.ndim == 1:
+        out = out.reshape(-1, 1)
+    return np.ascontiguousarray(out, dtype=np.float32)
+
+
+def _check_densify_budget(n_rows, n_cols):
+    """Refuse a densification that cannot fit, with remedies."""
+    from .utils.meminfo import BUDGET_ENV, densify_budget_bytes
+
+    est = int(n_rows) * int(n_cols) * 4
+    budget, source = densify_budget_bytes()
+    if budget is None or est <= budget:
+        return
+
+    def _fmt(b):
+        return (f"{b / 1e9:.2f} GB" if b >= 1e8 else f"{b / 1e6:.1f} MB")
+
+    raise ValueError(
+        f"densifying this ({n_rows}, {n_cols}) sparse input needs "
+        f"~{_fmt(est)} as float32, but only ~{_fmt(budget)} "
+        f"is available ({source}). Hashed-text widths this large do not "
+        "belong on the dense path. Options: (1) FIT without densifying "
+        "— the packed-CSR sparse fit plane (skdist_tpu.sparse) handles "
+        "2-D sparse input at packable density automatically for the "
+        "linear families; reaching this error means the input was "
+        "routed dense (density/nnz-outlier heuristics, or "
+        f"{SPARSE_FIT_ENV}=0) — force packing with {SPARSE_FIT_ENV}=1; "
+        "(2) for inference use distribute.batch_predict, which streams "
+        "sparse rows in groups (device models take the packed CSR "
+        "path) and never materialises the full dense matrix; (3) "
+        "re-hash to a bounded width — the Encoderizer configs cap "
+        "HashingVectorizer at 2**12..2**14 (distribute/_defaults.py) — "
+        "or reduce features first (TruncatedSVDTransformer); (4) raise "
+        f"the limit explicitly via {BUDGET_ENV} if you know better."
+    )
+
+
+# ---------------------------------------------------------------------------
+# device kernels: the two contractions + the dense-on-packed rebuild
+# ---------------------------------------------------------------------------
+
+def packed_matvec(idx, val, W):
+    """``X @ W`` on the packed pair: gather + row-dot, O(nnz·k) FLOPs.
+
+    ``W`` is ``(d[+1],)`` or ``(d[+1], k)``; padding entries gather row
+    0 of W with weight 0.0 and contribute nothing. vmap-safe (the task
+    axis may batch W)."""
+    g = W[idx]  # (n, m) or (n, m, k)
+    if g.ndim == 2:
+        return jnp.sum(val * g, axis=1)
+    return jnp.einsum("nm,nmk->nk", val, g)
+
+
+def packed_rmatvec(idx, val, r, n_cols):
+    """``X.T @ r`` on the packed pair: scatter-add over the packed
+    columns, O(nnz·k). ``r`` is ``(n,)`` or ``(n, k)``; returns
+    ``(n_cols,)`` / ``(n_cols, k)``. Padding scatters 0.0 into row 0."""
+    if r.ndim == 1:
+        out = jnp.zeros((n_cols,), r.dtype)
+        return out.at[idx].add(val * r[:, None])
+    out = jnp.zeros((n_cols, r.shape[-1]), r.dtype)
+    return out.at[idx].add(val[:, :, None] * r[:, None, :])
+
+
+def packed_to_dense(idx, val, n_cols):
+    """Scatter-rebuild the dense ``(n, n_cols)`` block on device — the
+    dense-matmul-on-packed variant's one-time cost: H2D still ships
+    only the packed pair, and the MXU then runs ordinary matmuls.
+    Duplicate (row, col) entries accumulate, matching CSR semantics."""
+    n = idx.shape[0]
+    rows = jnp.arange(n)[:, None]
+    return jnp.zeros((n, n_cols), val.dtype).at[rows, idx].add(val)
+
+
+def packed_weighted_gram(idx, val, sw, n_cols):
+    """``Xᵀ S X`` via the m² scatter: contribution
+    ``sw[n]·val[n,a]·val[n,b]`` lands at ``(idx[n,a], idx[n,b])`` —
+    O(nnz·m) scatter ops instead of the dense gram's O(n·d²) FLOPs.
+    The (n, m, m) contribution tensor is materialised, so this suits
+    the moderate-m regimes the ridge family actually runs at."""
+    vw = val * sw[:, None]
+    contrib = vw[:, :, None] * val[:, None, :]
+    out = jnp.zeros((n_cols, n_cols), val.dtype)
+    return out.at[idx[:, :, None], idx[:, None, :]].add(contrib)
+
+
+def matvec_any(X, W):
+    """``X @ W`` for either representation — the decision/proba
+    kernels' one entry point, so a model fit packed scores packed
+    shared data AND dense predict blocks through one closure."""
+    if isinstance(X, PackedX):
+        return packed_matvec(X.idx, X.val, W)
+    return X @ W
+
+
+# ---------------------------------------------------------------------------
+# the matvec interface the fit problems consume
+# ---------------------------------------------------------------------------
+
+class LinearOperator:
+    """The augmented design matrix ``X̃ = [X | 1]`` behind one matvec
+    interface, for dense ndarrays and :class:`PackedX` alike — what
+    lets the LogReg/LinearSVC/SGD/Ridge fit problems (and through them
+    the iteration-sliced solvers and the convergence-compacted
+    scheduler) run unchanged on sparse data.
+
+    Dense inputs reproduce the pre-sparse-plane expressions VERBATIM
+    (``Xa @ W``, ``Xa[i] @ W``, ``Xa.T @ (Xa * sw)``), so the dense
+    paths' pinned numerics cannot move. Packed inputs append the
+    intercept as one extra packed column (``idx=d, val=1``) and route
+    through the gather/scatter kernels above — or, in ``mode='dense'``,
+    through one :func:`packed_to_dense` rebuild followed by the exact
+    dense expressions (the MXU variant).
+
+    ``matmul_dtype='bfloat16'`` applies the LogReg bf16 contract: bf16
+    operands, f32 accumulation, solver state f32. On the gather path
+    the products round to bf16 before the f32 row-sum — same
+    opt-in-screening precision class as the dense bf16 pass.
+    """
+
+    __slots__ = ("d", "p", "n", "Xa", "pidx", "pval", "bf16", "_Xmm",
+                 "dtype")
+
+    def __init__(self, X, fit_intercept, matmul_dtype=None, mode="gather"):
+        self.bf16 = matmul_dtype == "bfloat16"
+        self._Xmm = None
+        self.dtype = X.val.dtype if isinstance(X, PackedX) else X.dtype
+        if isinstance(X, PackedX):
+            d = X.n_cols
+            idx, val = X.idx, X.val
+            n = idx.shape[0]
+            if fit_intercept:
+                idx = jnp.concatenate(
+                    [idx, jnp.full((n, 1), d, idx.dtype)], axis=1
+                )
+                val = jnp.concatenate(
+                    [val, jnp.ones((n, 1), val.dtype)], axis=1
+                )
+            self.d, self.p, self.n = d, d + int(bool(fit_intercept)), n
+            if mode == "dense":
+                # rebuild once per trace; XLA keeps the block live for
+                # every matvec of the solve (HBM returns, H2D doesn't)
+                self.Xa = packed_to_dense(idx, val, self.p)
+                self.pidx = self.pval = None
+            else:
+                self.Xa = None
+                self.pidx, self.pval = idx, val
+        else:
+            if fit_intercept:
+                ones = jnp.ones((X.shape[0], 1), X.dtype)
+                Xa = jnp.concatenate([X, ones], axis=1)
+            else:
+                Xa = X
+            self.Xa = Xa
+            self.pidx = self.pval = None
+            self.d = X.shape[1]
+            self.p = Xa.shape[1]
+            self.n = X.shape[0]
+
+    # -- X̃ @ W ---------------------------------------------------------
+    def matvec(self, W):
+        if self.Xa is not None:
+            if self.bf16:
+                if self._Xmm is None:
+                    self._Xmm = self.Xa.astype(jnp.bfloat16)
+                # precision pinned so the library-wide 'highest'
+                # tracing default doesn't promote the bf16 pass
+                return jax.lax.dot_general(
+                    self._Xmm, W.astype(jnp.bfloat16),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.DEFAULT,
+                )
+            return self.Xa @ W
+        if self.bf16:
+            g = W.astype(jnp.bfloat16)[self.pidx]
+            v = self.pval.astype(jnp.bfloat16)
+            if g.ndim == 2:
+                return jnp.sum((v * g).astype(jnp.float32), axis=1)
+            return jnp.sum(
+                (v[:, :, None] * g).astype(jnp.float32), axis=1
+            )
+        return packed_matvec(self.pidx, self.pval, W)
+
+    # -- X̃ᵀ @ r --------------------------------------------------------
+    def rmatvec(self, r):
+        if self.Xa is not None:
+            return self.Xa.T @ r
+        return packed_rmatvec(self.pidx, self.pval, r, self.p)
+
+    # -- row-batch forms (the SGD mini-batch contractions) --------------
+    def row_matvec(self, i, W):
+        if self.Xa is not None:
+            return self.Xa[i] @ W
+        return packed_matvec(self.pidx[i], self.pval[i], W)
+
+    def row_rmatvec(self, i, g):
+        if self.Xa is not None:
+            return self.Xa[i].T @ g
+        return packed_rmatvec(self.pidx[i], self.pval[i], g, self.p)
+
+    # -- closed-form ridge pieces ---------------------------------------
+    def weighted_gram_rhs(self, sw, T):
+        """``(X̃ᵀSX̃, (SX̃)ᵀT)`` — the two solves of the ridge normal
+        equations. Dense keeps the historical op order exactly."""
+        if self.Xa is not None:
+            Xw = self.Xa * sw[:, None]
+            return self.Xa.T @ Xw, Xw.T @ T
+        G = packed_weighted_gram(self.pidx, self.pval, sw, self.p)
+        b = packed_rmatvec(self.pidx, self.pval, sw[:, None] * T, self.p)
+        return G, b
+
+
+# ---------------------------------------------------------------------------
+# matvec-mode calibration (the hist_mode idiom)
+# ---------------------------------------------------------------------------
+
+_DEFAULT_CALIB_PATH = os.path.join(
+    os.path.dirname(__file__), "models", "sparse_calib.json"
+)
+#: env override so sweeps can stage candidate entries in scratch files
+CALIB_PATH_ENV = "SKDIST_SPARSE_CALIB_PATH"
+_CALIB_LOCK = threading.Lock()
+_CALIB_CACHE = {}  # path -> (mtime, table)
+
+
+def _calib_path():
+    return os.environ.get(CALIB_PATH_ENV) or _DEFAULT_CALIB_PATH
+
+
+def _load_calib():
+    path = _calib_path()
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return {}
+    with _CALIB_LOCK:
+        ent = _CALIB_CACHE.get(path)
+        if ent is None or ent[0] != mtime:
+            try:
+                with open(path) as f:
+                    ent = (mtime, json.load(f))
+                _CALIB_CACHE[path] = ent
+            except (OSError, ValueError):
+                return ent[1] if ent else {}
+        return ent[1] or {}
+
+
+def get_matvec_calibration(platform):
+    """Measured matvec-mode entry for ``platform`` or None."""
+    ent = _load_calib().get(platform)
+    if not isinstance(ent, dict) or ent.get("mode") not in _VALID_MATVEC_MODES:
+        return None
+    return ent
+
+
+def record_matvec_calibration(platform, mode, measured=None, source=None):
+    """Persist a sweep result (merging with other platforms' entries),
+    mirroring ``models/hist_calib.record_calibration``."""
+    if mode not in _VALID_MATVEC_MODES:
+        raise ValueError(
+            f"mode must be one of {_VALID_MATVEC_MODES}; got {mode!r}"
+        )
+    path = _calib_path()
+    with _CALIB_LOCK:
+        table = {}
+        try:
+            with open(path) as f:
+                table = json.load(f)
+        except (OSError, ValueError):
+            pass
+        ent = {"mode": mode}
+        if measured is not None:
+            ent["measured"] = measured
+        if source is not None:
+            ent["source"] = source
+        table[platform] = ent
+        with open(path, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        _CALIB_CACHE.pop(path, None)
+    return table[platform]
+
+
+def resolve_matvec_mode(platform=None):
+    """The packed matvec mode for this process: environment override →
+    calibration table → heuristic default (``gather`` — the
+    nnz-proportional kernels; ``dense`` is the rebuilt-MXU variant a
+    sweep may certify per platform)."""
+    env = os.environ.get(SPARSE_MATVEC_ENV, "").strip().lower()
+    if env in _VALID_MATVEC_MODES:
+        return env
+    if platform is None:
+        platform = jax.default_backend()
+    calib = get_matvec_calibration(platform)
+    if calib is not None:
+        return calib["mode"]
+    return "gather"

@@ -104,12 +104,16 @@ def test_bad_method(clf_data):
 
 
 def test_sparse_width_guardrail(monkeypatch):
-    """A sparse input whose densified form blows the budget must raise
-    an informative error up front, not OOM (round-2 VERDICT weak #7).
-    2**18 columns is a realistic HashingVectorizer width."""
+    """A DENSIFICATION whose result blows the budget must raise an
+    informative error up front, not OOM (round-2 VERDICT weak #7) —
+    and the remedies must name the packed sparse fit path. 2**18
+    columns is a realistic HashingVectorizer width; since the sparse
+    fit plane, fitting such an input SUCCEEDS (packed, never
+    densified) unless the plane is disabled."""
     import scipy.sparse as sp
 
     from skdist_tpu.models.linear import as_dense_f32
+    from skdist_tpu.sparse import SPARSE_FIT_ENV
     from skdist_tpu.utils.meminfo import BUDGET_ENV
 
     monkeypatch.setenv(BUDGET_ENV, str(1 << 20))  # 1 MB budget
@@ -119,14 +123,22 @@ def test_sparse_width_guardrail(monkeypatch):
         as_dense_f32(X)
     msg = str(exc.value)
     assert "GB" in msg and "batch_predict" in msg and BUDGET_ENV in msg
+    assert SPARSE_FIT_ENV in msg  # the sparse-fit remedy is named
 
-    # fit paths surface the same guidance
     from skdist_tpu.models import LogisticRegression as LR
 
     y = np.zeros(2000, dtype=np.int64)
     y[:1000] = 1
+    # with the sparse plane OFF, the fit path surfaces the guidance
+    monkeypatch.setenv(SPARSE_FIT_ENV, "0")
     with pytest.raises(ValueError, match="batch_predict"):
         LR(max_iter=5).fit(X, y)
+    # with the plane on (default), the SAME input fits without ever
+    # densifying — the size the framework exists to serve
+    monkeypatch.delenv(SPARSE_FIT_ENV)
+    model = LR(max_iter=5, engine="xla").fit(X, y)
+    assert model._meta.get("x_format") == "packed"
+    assert model.coef_.shape == (1, 1 << 18)
 
 
 def test_batch_predict_streams_sparse_groups(clf_data, tpu_backend,

@@ -1,7 +1,8 @@
 """CI smoke for the quality-parity harness (round-5 VERDICT task 5):
 the builtin rows must run and stay at/near the reference's published
-numbers, and the fetched rows must skip cleanly in a zero-egress
-environment instead of erroring."""
+numbers, and the fetched rows must run their protocols on the cached
+synthetic stand-ins in a zero-egress environment instead of
+skipping (VERDICT weak #5)."""
 
 import sys
 from pathlib import Path
@@ -38,10 +39,25 @@ def test_breast_cancer_row_near_reference():
     assert row["ours"] >= row["reference"] - 0.005
 
 
-def test_fetched_rows_skip_cleanly(tmp_path):
-    qp.run_covtype(str(tmp_path))
-    qp.run_encoder_20news(str(tmp_path))
-    assert len(qp.ROWS) == 2
-    assert all(r["note"].startswith("skipped") for r in qp.ROWS)
-    # the table renders with skipped rows present
+def test_fetched_rows_score_synthetic_standins(tmp_path):
+    """Without local covtype/20news caches, the fetched protocols run
+    end-to-end on the synthetic stand-ins and produce real scores —
+    in any environment, the harness exercises scaling, batched grids,
+    the forest, and the Encoderizer text path (which feeds the sparse
+    fit plane)."""
+    qp.run_covtype(str(tmp_path), n_rows=1200, rf_estimators=12)
+    qp.run_encoder_20news(str(tmp_path), sizes=("small",), n_docs=240)
+    rows = qp.ROWS
+    assert len(rows) == 4  # covtype LR-CV, LR-holdout, RF + encoder[small]
+    assert all(r["ours"] is not None for r in rows), rows
+    assert all("synthetic stand-in" in r["note"] for r in rows)
+    # stand-ins never claim reference deltas
+    assert all(r["delta"] is None for r in rows)
+    # the generated problems carry real signal: a collapsed pipeline
+    # (all-one-class predictions, dead featuriser) lands near chance
+    scores = {r["row"]: r["ours"] for r in rows}
+    assert scores["covtype LR grid best CV f1_weighted"] > 0.3
+    assert scores["covtype RF-12 holdout weighted F1"] > 0.3
+    assert scores["20news Encoderizer[small] best CV f1_weighted"] > 0.2
+    # the table renders with stand-in rows present
     qp.print_table()
