@@ -1,0 +1,388 @@
+"""Fault-tolerance smoke: the robustness PR's acceptance gate,
+standalone on the 8-virtual-device CPU mesh.
+
+Four scenarios over one deterministic grid-search workload:
+
+- **retry storm**: a transient fault injected on every 5th round
+  dispatch (20% of rounds) must leave the search COMPLETE with
+  cv_results_ bitwise identical (max diff 0.0) to the fault-free run,
+  retries within the policy bound (no exhaustion), and 0 compile-cache
+  misses added after warmup — a retry re-dispatches the SAME compiled
+  executables.
+- **NaN lane quarantine**: a poisoned lane must surface as sklearn
+  ``error_score`` semantics (FitFailedWarning + substituted score —
+  exactly what the host path records for a failed fit) with every
+  OTHER task's score untouched, instead of letting NaN rank.
+- **kill + resume**: a subprocess SIGKILLed mid-search with durable
+  checkpointing on must leave a journal a re-run resumes from, reusing
+  >= RESUME_FRAC (default 0.5) of its completed tasks and matching the
+  uninterrupted run's scores to <= 1e-5.
+- **guard overhead**: on a compaction-sized (iterative-path) grid, the
+  lane guard's warm wall with ``SKDIST_FAULT_GUARD=1`` stays within
+  OVERHEAD (default 2%, floored at 30 ms for timer noise) of the
+  guard-off wall, with 0 compile misses between the two — the fault
+  layer is host-side bookkeeping, not device work.
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/fault_smoke.py [--resume-frac 0.5] [--overhead 0.02]
+
+(The ``--child`` modes are internal: the kill/resume scenario re-execs
+this file as the victim/resumer subprocess.)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+KILL_ROUND = 3  # dispatch ordinal the victim subprocess dies at
+
+
+def _search(n_candidates=7, cv=3, partitions=7, max_iter=40):
+    import numpy as np
+
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+
+    grid = {"C": list(np.logspace(-2, 2, n_candidates))}
+    return DistGridSearchCV(
+        LogisticRegression(max_iter=max_iter, engine="xla"),
+        grid, cv=cv, partitions=partitions,
+    )
+
+
+def _data():
+    import numpy as np
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=360, n_features=12, n_informative=8, random_state=7,
+    )
+    return X.astype(np.float32), y
+
+
+def _score_cols(cv_results):
+    import numpy as np
+
+    return {
+        k: np.asarray(v) for k, v in cv_results.items()
+        if "test_score" in k and not k.startswith("rank")
+    }
+
+
+def _max_diff(a, b):
+    import numpy as np
+
+    diffs = []
+    for k in a:
+        x, y = np.asarray(a[k], float), np.asarray(b[k], float)
+        both_nan = np.isnan(x) & np.isnan(y)
+        d = np.abs(x - y)
+        d[both_nan] = 0.0
+        diffs.append(float(np.nanmax(d)) if d.size else 0.0)
+    return max(diffs)
+
+
+# ---------------------------------------------------------------------------
+# child modes (kill/resume subprocesses)
+# ---------------------------------------------------------------------------
+
+def child_main(mode, out_path):
+    from skdist_tpu.parallel import faults
+    from skdist_tpu.testing.faultinject import FaultInjector
+
+    X, y = _data()
+    gs = _search()
+    if mode == "kill":
+        with FaultInjector().at_round(KILL_ROUND, kind="kill"):
+            gs.fit(X, y)  # never returns: SIGKILL at round KILL_ROUND
+        raise SystemExit("FAIL: the kill injection never fired")
+    faults.reset_stats()
+    gs.fit(X, y)
+    with open(out_path, "w") as fh:
+        json.dump({
+            "scores": {k: list(map(float, v))
+                       for k, v in _score_cols(gs.cv_results_).items()},
+            "stats": faults.snapshot(),
+        }, fh)
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_retry_storm(failures):
+    import numpy as np
+
+    from skdist_tpu.parallel import compile_cache, faults
+    from skdist_tpu.testing.faultinject import FaultInjector
+
+    X, y = _data()
+    gs0 = _search()
+    gs0.fit(X, y)  # fault-free baseline (also the compile warmup)
+    base = _score_cols(gs0.cv_results_)
+
+    faults.reset_stats()
+    snap0 = compile_cache.last_stats()
+    with FaultInjector().every(5, kind="transient") as inj:
+        gs1 = _search()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            gs1.fit(X, y)
+    snap1 = compile_cache.last_stats()
+    stats = faults.snapshot()
+    injected = len(inj.fired)
+    diff = _max_diff(base, _score_cols(gs1.cv_results_))
+    misses = sum(
+        snap1[k] - snap0[k]
+        for k in ("aot_misses", "jit_misses", "kernel_misses")
+    )
+    if injected == 0:
+        failures.append("retry storm: no transient fault was injected")
+    if diff != 0.0:
+        failures.append(
+            f"retry storm: cv_results_ max diff {diff} != 0.0 "
+            "(a retried round must be bitwise identical)"
+        )
+    if stats["rounds_retried"] != injected:
+        failures.append(
+            f"retry storm: {stats['rounds_retried']} retries for "
+            f"{injected} injected faults"
+        )
+    if stats["retries_exhausted"]:
+        failures.append(
+            f"retry storm: {stats['retries_exhausted']} faults "
+            "exhausted the policy bound"
+        )
+    if misses:
+        failures.append(
+            f"retry storm: {misses} compile misses post-warmup "
+            "(retries must reuse the warmed executables)"
+        )
+    return {"injected": injected, "retried": stats["rounds_retried"],
+            "cv_max_diff": diff, "post_warmup_compiles": misses}
+
+
+def scenario_nan_quarantine(failures):
+    import numpy as np
+
+    from skdist_tpu.distribute.search import FitFailedWarning
+    from skdist_tpu.parallel import faults
+    from skdist_tpu.testing.faultinject import FaultInjector
+
+    X, y = _data()
+    gs0 = _search()
+    gs0.fit(X, y)
+    base = _score_cols(gs0.cv_results_)
+
+    faults.reset_stats()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with FaultInjector().at_round(0, kind="nan", lanes=[1]):
+            gs1 = _search()
+            gs1.fit(X, y)
+    got_warning = any(
+        issubclass(w.category, FitFailedWarning) for w in caught
+    )
+    stats = faults.snapshot()
+    quarantined = stats["lanes_quarantined"]
+    # the poisoned task's score must be error_score (NaN default);
+    # every other entry must be bitwise untouched. Count per-split
+    # columns only — the task's candidate legitimately propagates NaN
+    # into its mean/std aggregates, as sklearn's host path would.
+    cur = _score_cols(gs1.cv_results_)
+    n_nan = sum(
+        int(np.isnan(v).sum()) for k, v in cur.items()
+        if k.startswith("split")
+    )
+    clean_diff = max(
+        float(np.abs(np.where(np.isnan(cur[k]), base[k], cur[k])
+                     - base[k]).max())
+        for k in base
+    )
+    if not got_warning:
+        failures.append("nan quarantine: no FitFailedWarning raised")
+    if quarantined != 1:
+        failures.append(
+            f"nan quarantine: {quarantined} lanes quarantined, want 1"
+        )
+    if n_nan != 1:
+        failures.append(
+            f"nan quarantine: {n_nan} error_score entries, want exactly "
+            "the poisoned task"
+        )
+    if clean_diff != 0.0:
+        failures.append(
+            f"nan quarantine: untouched lanes moved by {clean_diff}"
+        )
+    return {"quarantined": quarantined, "error_score_entries": n_nan,
+            "clean_lane_diff": clean_diff, "warned": got_warning}
+
+
+def scenario_kill_resume(failures, resume_frac):
+    import tempfile
+
+    ckpt = tempfile.mkdtemp(prefix="skdist-fault-smoke-")
+    env = dict(os.environ)
+    env["SKDIST_CHECKPOINT_DIR"] = ckpt
+    out_json = os.path.join(ckpt, "resume.json")
+    ref_json = os.path.join(ckpt, "ref.json")
+
+    victim = subprocess.run(
+        [sys.executable, __file__, "--child", "kill", out_json],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    if victim.returncode != -signal.SIGKILL:
+        failures.append(
+            f"kill+resume: victim exited {victim.returncode}, expected "
+            f"SIGKILL ({-signal.SIGKILL}); stderr: {victim.stderr[-400:]}"
+        )
+        return {}
+    journals = [f for f in os.listdir(ckpt) if f.endswith(".jsonl")]
+    if len(journals) != 1:
+        failures.append(f"kill+resume: {len(journals)} journals, want 1")
+        return {}
+    with open(os.path.join(ckpt, journals[0])) as fh:
+        journaled = len([ln for ln in fh if ln.strip()])
+    if journaled == 0:
+        failures.append("kill+resume: the victim journaled nothing "
+                        "before dying")
+        return {}
+
+    resumer = subprocess.run(
+        [sys.executable, __file__, "--child", "resume", out_json],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    if resumer.returncode != 0:
+        failures.append(
+            f"kill+resume: resume run failed: {resumer.stderr[-400:]}"
+        )
+        return {}
+    # uninterrupted reference in a fresh process WITHOUT checkpointing
+    ref_env = dict(os.environ)
+    ref_env.pop("SKDIST_CHECKPOINT_DIR", None)
+    ref = subprocess.run(
+        [sys.executable, __file__, "--child", "resume", ref_json],
+        env=ref_env, capture_output=True, text=True, timeout=600,
+    )
+    if ref.returncode != 0:
+        failures.append(
+            f"kill+resume: reference run failed: {ref.stderr[-400:]}"
+        )
+        return {}
+    with open(out_json) as fh:
+        resumed = json.load(fh)
+    with open(ref_json) as fh:
+        reference = json.load(fh)
+    hits = resumed["stats"]["checkpoint_hits"]
+    reused = hits / journaled
+    diff = _max_diff(reference["scores"], resumed["scores"])
+    if reused < resume_frac:
+        failures.append(
+            f"kill+resume: reused {hits}/{journaled} journaled tasks "
+            f"({reused:.0%} < {resume_frac:.0%})"
+        )
+    if diff > 1e-5:
+        failures.append(
+            f"kill+resume: resumed vs uninterrupted max diff {diff} > 1e-5"
+        )
+    import shutil
+
+    shutil.rmtree(ckpt, ignore_errors=True)
+    return {"journaled": journaled, "reused": hits, "cv_max_diff": diff}
+
+
+def scenario_guard_overhead(failures, overhead):
+    from skdist_tpu.parallel import compile_cache
+
+    X, y = _data()
+
+    def warm_wall():
+        # compaction-sized grid: 8 candidates x 3 folds = 24 tasks
+        # engages the iterative (compacted) path on the 8-device mesh
+        walls = []
+        for _ in range(3):
+            gs = _search(n_candidates=8, partitions=None)
+            t0 = time.perf_counter()
+            gs.fit(X, y)
+            walls.append(time.perf_counter() - t0)
+        return min(walls)
+
+    # warmup + guard-off wall
+    os.environ["SKDIST_FAULT_GUARD"] = "0"
+    warm_wall()
+    off = warm_wall()
+    os.environ["SKDIST_FAULT_GUARD"] = "1"
+    snap0 = compile_cache.last_stats()
+    on = warm_wall()
+    snap1 = compile_cache.last_stats()
+    os.environ.pop("SKDIST_FAULT_GUARD", None)
+    misses = sum(
+        snap1[k] - snap0[k]
+        for k in ("aot_misses", "jit_misses", "kernel_misses")
+    )
+    # 30 ms floor: at sub-second walls a 2% band is inside timer noise
+    budget = max(off * (1.0 + overhead), off + 0.03)
+    if on > budget:
+        failures.append(
+            f"guard overhead: warm wall {on:.3f}s with guard vs "
+            f"{off:.3f}s without (> {overhead:.0%} + floor)"
+        )
+    if misses:
+        failures.append(
+            f"guard overhead: {misses} compile misses added by the guard"
+        )
+    return {"warm_wall_guard_on_s": round(on, 4),
+            "warm_wall_guard_off_s": round(off, 4),
+            "post_warmup_compiles": misses}
+
+
+def main(resume_frac, overhead):
+    failures = []
+    report = {}
+    report["retry_storm"] = scenario_retry_storm(failures)
+    report["nan_quarantine"] = scenario_nan_quarantine(failures)
+    report["kill_resume"] = scenario_kill_resume(failures, resume_frac)
+    report["guard_overhead"] = scenario_guard_overhead(failures, overhead)
+    print(json.dumps(report, indent=1))
+    if failures:
+        raise SystemExit("FAIL: " + "; ".join(failures))
+    print(
+        "PASS: retry storm bitwise-clean "
+        f"({report['retry_storm']['retried']} retries), quarantine "
+        "mapped 1 lane to error_score, kill+resume reused "
+        f"{report['kill_resume'].get('reused')} journaled tasks "
+        f"(diff {report['kill_resume'].get('cv_max_diff')}), guard "
+        f"overhead {report['guard_overhead']['warm_wall_guard_on_s']}s "
+        f"vs {report['guard_overhead']['warm_wall_guard_off_s']}s, "
+        "0 post-warmup compiles"
+    )
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        child_main(sys.argv[i + 1], sys.argv[i + 2])
+        raise SystemExit(0)
+    frac = 0.5
+    ovh = 0.02
+    if "--resume-frac" in sys.argv:
+        frac = float(sys.argv[sys.argv.index("--resume-frac") + 1])
+    if "--overhead" in sys.argv:
+        ovh = float(sys.argv[sys.argv.index("--overhead") + 1])
+    main(frac, ovh)

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..base import BaseEstimator, ClassifierMixin, clone, strip_runtime
 from ..parallel import (
+    faults,
     iterative_fit_supported,
     parse_partitions,
     prefers_host_engine,
@@ -56,6 +57,36 @@ __all__ = ["DistOneVsRestClassifier", "DistOneVsOneClassifier"]
 
 def _n_rows(X):
     return X.shape[0] if hasattr(X, "shape") else len(X)
+
+
+def _warn_nonfinite_lanes(stacked, describe, what):
+    """Lane-quarantine guard over a batched multiclass fit's stacked
+    params (coef/intercept leaves): a non-finite lane means that
+    sub-problem's solve diverged. Unlike the CV search there is no
+    ``error_score`` contract to map onto — the host path would carry
+    the same NaN params silently — so the guard makes the failure LOUD
+    (a ``FitFailedWarning`` naming the affected classes/pairs) instead
+    of letting predict-side argmax over NaN columns pick silently.
+    ``describe(lane_index) -> str`` labels a poisoned lane.
+    ``SKDIST_FAULT_GUARD=0`` disables."""
+    if not faults.guard_enabled():
+        return
+    bad = faults.nonfinite_lanes(stacked)
+    if bad is None or not bad.any():
+        return
+    from .search import FitFailedWarning
+
+    idxs = np.where(bad)[0]
+    names = ", ".join(describe(int(i)) for i in idxs[:5])
+    if len(idxs) > 5:
+        names += ", ..."
+    faults.record("lanes_quarantined", int(bad.sum()))
+    warnings.warn(
+        f"{int(bad.sum())} batched {what} fit(s) produced non-finite "
+        f"parameters (diverged lanes: {names}); their predictions "
+        "will be unreliable. Check hyperparameters / data scaling.",
+        FitFailedWarning,
+    )
 
 
 class _ConstantPredictor(BaseEstimator):
@@ -530,6 +561,11 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
                     lambda *xs: np.concatenate(xs, axis=0), *parts
                 )
             )
+            _warn_nonfinite_lanes(
+                stacked,
+                lambda i: f"class {self._col_label(live[i])!r}",
+                "one-vs-rest",
+            )
             for pos_idx, cls_idx in enumerate(live):
                 params = jax.tree_util.tree_map(lambda a: a[pos_idx], stacked)
                 estimators[cls_idx] = _make_fitted_binary(est, params, meta)
@@ -839,6 +875,14 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
                 shared_specs=specs,
                 cache_key=kernel_key,
             )
+        _warn_nonfinite_lanes(
+            stacked,
+            lambda t: "pair (%r, %r)" % (
+                self.classes_[self.pairs_[t][0]],
+                self.classes_[self.pairs_[t][1]],
+            ),
+            "one-vs-one",
+        )
         self.estimators_ = [
             _make_fitted_binary(
                 est, jax.tree_util.tree_map(lambda a: a[t], stacked), meta

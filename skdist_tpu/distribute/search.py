@@ -46,6 +46,7 @@ from ..metrics import (
     device_scorer_compatible,
 )
 from ..parallel import (
+    faults,
     iterative_fit_supported,
     parse_partitions,
     prefers_host_engine,
@@ -53,6 +54,7 @@ from ..parallel import (
     row_sharded_specs,
 )
 from ..utils.validation import (
+    check_error_score,
     check_estimator_backend,
     check_is_fitted,
     check_n_iter,
@@ -157,6 +159,131 @@ class FitFailedWarning(RuntimeWarning):
     """Raised-as-warning marker for failed per-task fits (the reference
     referenced sklearn's FitFailedWarning without importing it —
     search.py:248-253 — a dead path we make real)."""
+
+
+# ---------------------------------------------------------------------------
+# fault-tolerance helpers: checkpoint signature + lane quarantine
+# ---------------------------------------------------------------------------
+
+def _canonical_value(v):
+    """Address-free canonical form of one value: simple scalars by
+    repr, sequences element-wise, dicts sorted, callables by
+    module-qualified name, everything else (estimators, backends,
+    scorer objects) by type name. A plain ``repr`` of a callable
+    embeds its object address — which would make the checkpoint
+    signature differ across exactly the process restarts a resume
+    spans, silently turning kill+resume into a full re-run."""
+    if isinstance(v, (str, bytes, int, float, bool, type(None))):
+        return repr(v)
+    if isinstance(v, (list, tuple)):
+        return tuple(_canonical_value(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(
+            (repr(k), _canonical_value(x))
+            for k, x in sorted(v.items(), key=lambda kv: repr(kv[0]))
+        )
+    if callable(v) and hasattr(v, "__qualname__"):
+        return (getattr(v, "__module__", "?") or "?") + ":" + v.__qualname__
+    qual = type(v).__module__ + "." + type(v).__qualname__
+    if hasattr(v, "get_params"):
+        # nested estimators: the CONFIG matters, not just the class —
+        # a resumed search with a retuned inner estimator must not
+        # restore the old estimator's journaled scores
+        return (qual, _canonical_params(v.get_params(deep=False)))
+    if callable(v):
+        # callable instances (sklearn's make_scorer objects): the type
+        # name alone collides across every _Scorer — canonicalize the
+        # configuring attributes (score func, kwargs, sign) instead
+        attrs = getattr(v, "__dict__", None) or {}
+        return (qual, tuple(
+            (k, _canonical_value(x)) for k, x in sorted(attrs.items())
+        ))
+    return type(v).__name__
+
+
+def _canonical_params(params):
+    """Stable, process-independent signature of a param dict (see
+    :func:`_canonical_value`). Feeds the checkpoint grid signature, so
+    it must be identical across the process restarts a resume spans."""
+    return tuple(
+        (k, _canonical_value(v)) for k, v in sorted(params.items())
+    )
+
+
+def _checkpoint_signature(search, estimator, candidate_params, splits,
+                          X, y, fit_params):
+    """Structural identity of one search for the durable-checkpoint
+    journal: anything that changes what task id ``t`` MEANS
+    participates — estimator class+params, the candidate list, the
+    actual CV split indices (not just the fold count: a reshuffled cv
+    renumbers every task), scoring config, and digests of the training
+    data and array-valued fit params."""
+    split_sig = faults.data_digest(
+        np.concatenate([
+            np.concatenate([np.asarray(tr, np.int64).ravel(),
+                            np.asarray(te, np.int64).ravel()])
+            for tr, te in splits
+        ]) if splits else np.empty(0, np.int64)
+    )
+    fp_sig = tuple(
+        (k, faults.data_digest(v) if hasattr(v, "__len__")
+            and not isinstance(v, (str, bytes, dict))
+            else _canonical_value(v))
+        for k, v in sorted(fit_params.items())
+    )
+    return faults.grid_signature(
+        type(search).__name__,
+        type(estimator).__module__ + "." + type(estimator).__qualname__,
+        _canonical_params(estimator.get_params(deep=False)),
+        tuple(_canonical_params(c) for c in candidate_params),
+        len(splits), split_sig,
+        _canonical_value(search.scoring), bool(search.return_train_score),
+        faults.data_digest(X),
+        faults.data_digest(y) if y is not None else "y=None",
+        fp_sig,
+    )
+
+
+def _quarantine_nonfinite(out_rows, error_score, context="search"):
+    """The lane-quarantine guard over assembled batched-path score
+    rows: a non-finite score can only mean a numerically diverged
+    (poisoned) fit lane — the device kernels have no error path — so
+    it maps to sklearn ``error_score`` semantics exactly like a raised
+    host fit: 'raise' raises, a numeric substitutes with a
+    :class:`FitFailedWarning`. Runs host-side over already-gathered
+    floats (no device work, no compiles); ``SKDIST_FAULT_GUARD=0``
+    disables."""
+    if not faults.guard_enabled():
+        return
+    bad = []
+    for i, row in enumerate(out_rows):
+        if row is None:
+            continue
+        for k, v in row.items():
+            if k.startswith(("test_", "train_")) and not np.isfinite(v):
+                bad.append(i)
+                break
+    if not bad:
+        return
+    if error_score == "raise":
+        raise RuntimeError(
+            f"{len(bad)} batched {context} fit(s) produced non-finite "
+            f"scores (diverged lanes, e.g. task {bad[0]}) and "
+            "error_score='raise'. Set error_score to a number to "
+            "record them as failed fits instead."
+        )
+    faults.record("lanes_quarantined", len(bad))
+    warnings.warn(
+        f"{len(bad)} of {len(out_rows)} batched {context} fits "
+        f"produced non-finite scores (diverged lanes); their scores "
+        f"are set to error_score={error_score!r}.",
+        FitFailedWarning,
+    )
+    for i in bad:
+        row = out_rows[i]
+        for k in row:
+            if k.startswith(("test_", "train_")):
+                row[k] = float(error_score)
 
 
 # ---------------------------------------------------------------------------
@@ -424,9 +551,15 @@ class DistBaseSearchCV(BaseEstimator):
         raise NotImplementedError
 
     # ------------------------------------------------------------------
-    def fit(self, X, y=None, groups=None, **fit_params):
+    def fit(self, X, y=None, groups=None, checkpoint_dir=None, **fit_params):
+        """``checkpoint_dir`` (or env ``SKDIST_CHECKPOINT_DIR``) opts
+        into durable search checkpointing: completed (candidate x
+        fold) results are journaled there, keyed by the structural
+        grid signature, and a re-run of the SAME search after a
+        process kill resumes past its finished tasks."""
         from sklearn.model_selection import check_cv
 
+        check_error_score(self.error_score)
         check_estimator_backend(self, self.verbose)
         backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
         estimator = self.estimator
@@ -446,10 +579,24 @@ class DistBaseSearchCV(BaseEstimator):
         self.multimetric_ = multimetric
         refit_metric = self._refit_metric(scorers, multimetric)
 
-        out = self._run_search_tasks(
-            backend, estimator, X, y, candidate_params, splits, scorers,
-            fit_params,
-        )
+        ckpt_dir = faults.resolve_checkpoint_dir(checkpoint_dir)
+        checkpoint = None
+        if ckpt_dir is not None:
+            checkpoint = faults.SearchCheckpoint(
+                ckpt_dir,
+                _checkpoint_signature(
+                    self, estimator, candidate_params, splits, X, y,
+                    fit_params,
+                ),
+            )
+        try:
+            out = self._run_search_tasks(
+                backend, estimator, X, y, candidate_params, splits,
+                scorers, fit_params, checkpoint=checkpoint,
+            )
+        finally:
+            if checkpoint is not None:
+                checkpoint.close()
 
         results = self._format_results(
             candidate_params, scorers, n_splits, out
@@ -505,9 +652,11 @@ class DistBaseSearchCV(BaseEstimator):
 
     # ------------------------------------------------------------------
     def _run_search_tasks(self, backend, estimator, X, y, candidate_params,
-                          splits, scorers, fit_params):
+                          splits, scorers, fit_params, checkpoint=None):
         """Dispatch (candidate × fold) tasks; returns a list of per-task
-        score dicts in task order (candidate-major, split fastest)."""
+        score dicts in task order (candidate-major, split fastest).
+        With a ``checkpoint``, journaled tasks are restored instead of
+        re-fit and fresh completions are journaled as they land."""
         n_splits = len(splits)
         batched = None
         # the batched device path handles the one array-valued fit
@@ -520,38 +669,56 @@ class DistBaseSearchCV(BaseEstimator):
         if sw_ok:
             batched = self._try_batched(
                 backend, estimator, X, y, candidate_params, splits,
-                sample_weight=sw,
+                sample_weight=sw, checkpoint=checkpoint,
             )
         if batched is not None:
             return batched
 
         warm = self._try_host_linear_warm(
             backend, estimator, X, y, candidate_params, splits, scorers,
-            fit_params,
+            fit_params, checkpoint=checkpoint,
         )
         if warm is not None:
             return warm
 
         # generic host fan-out (reference joblib path, search.py:388-409)
         tasks = [
-            (cand_idx, params, train, test)
+            (cand_idx * n_splits + s, params, train, test)
             for cand_idx, params in enumerate(candidate_params)
-            for (train, test) in splits
+            for s, (train, test) in enumerate(splits)
         ]
+        out = [None] * len(tasks)
+        if checkpoint is not None and checkpoint.completed:
+            todo = []
+            for task in tasks:
+                row = checkpoint.completed.get(task[0])
+                if row is not None:
+                    out[task[0]] = dict(row)
+                else:
+                    todo.append(task)
+        else:
+            todo = tasks
 
         def run_one(task):
-            _, params, train, test = task
-            return _fit_and_score(
+            tid, params, train, test = task
+            r = _fit_and_score(
                 estimator, X, y, scorers, train, test, params,
                 fit_params=fit_params, error_score=self.error_score,
                 return_train_score=self.return_train_score,
             )
+            if checkpoint is not None:
+                checkpoint.record(tid, r)
+            return r
 
-        return backend.run_tasks(run_one, tasks, verbose=self.verbose)
+        for task, r in zip(
+            todo, backend.run_tasks(run_one, todo, verbose=self.verbose)
+        ):
+            out[task[0]] = r
+        return out
 
     def _try_host_linear_warm(self, backend, estimator, X, y,
                               candidate_params, splits, scorers,
-                              fit_params):
+                              fit_params, checkpoint=None):
         """Warm C-path runner for host-engine linear fits; None → the
         plain generic fan-out applies.
 
@@ -578,6 +745,14 @@ class DistBaseSearchCV(BaseEstimator):
         if not prefers_host_engine(backend, estimator):
             return None
         if not getattr(estimator, "_host_warm_startable", False):
+            return None
+        if checkpoint is not None and checkpoint.completed:
+            # resuming mid-grid would splice journaled results into
+            # warm chains whose seeds then depend on which tasks
+            # happened to survive the kill; the generic per-task path
+            # resumes cleanly (warm chaining is a speed path, not a
+            # semantics path — cold per-task fits score identically to
+            # solver tolerance)
             return None
         from ..models.linear import hyper_float
 
@@ -646,10 +821,12 @@ class DistBaseSearchCV(BaseEstimator):
             s = chain[3]
             for i, r in results:
                 out[i * n_splits + s] = r
+                if checkpoint is not None:
+                    checkpoint.record(i * n_splits + s, r)
         return out
 
     def _try_batched(self, backend, estimator, X, y, candidate_params, splits,
-                     sample_weight=None):
+                     sample_weight=None, checkpoint=None):
         """Attempt the batched device path; None → fall back to generic."""
         if not hasattr(type(estimator), "_build_fit_kernel"):
             return None
@@ -760,17 +937,30 @@ class DistBaseSearchCV(BaseEstimator):
                 "train_masks": train_masks,
                 "test_masks": test_masks,
             }
-            # stack task axis: bucket candidates × folds, split fastest
+            # stack task axis: bucket candidates × folds, split fastest.
+            # gids carries each lane's GLOBAL task id — the durable
+            # identity the checkpoint journal keys on; journaled tasks
+            # are restored from the journal and leave the task axis.
             task_hyper = {name: [] for name in hyper_names}
             split_ids = []
+            gids = []
             for cand_idx in cand_indices:
                 cand = candidate_params[cand_idx]
                 for s in range(n_splits):
+                    gid = cand_idx * n_splits + s
+                    if (checkpoint is not None
+                            and gid in checkpoint.completed):
+                        out[gid] = dict(checkpoint.completed[gid])
+                        continue
                     for name in hyper_names:
                         task_hyper[name].append(float(hyper_float(
                             cand.get(name, getattr(bucket_est, name))
                         )))
                     split_ids.append(s)
+                    gids.append(gid)
+            if not gids:
+                continue  # whole bucket restored from the journal
+            gids = np.asarray(gids, dtype=np.int64)
             task_args = {
                 "hyper": {
                     k: np.asarray(v, dtype=np.float32)
@@ -787,6 +977,7 @@ class DistBaseSearchCV(BaseEstimator):
                 backend, est_cls, n_bucket, static_cfg.get("max_iter")
             )
             inv = None
+            disp_gids = gids
             if n_slice is not None:
                 # cost-ordered round packing (iterative path only: the
                 # classic fused program is order-insensitive, and
@@ -803,6 +994,7 @@ class DistBaseSearchCV(BaseEstimator):
                         "split": task_args["split"][order],
                     }
                     inv = np.argsort(order)
+                    disp_gids = gids[order]
                 spec, iter_key = _cv_iterative_spec(
                     est_cls, meta, static, scorer_specs,
                     self.return_train_score, n_slice,
@@ -816,6 +1008,7 @@ class DistBaseSearchCV(BaseEstimator):
                     spec, task_args, shared, round_size=round_size,
                     shared_specs=specs, return_timings=True,
                     cache_key=iter_key,
+                    on_round=self._round_journal(checkpoint, disp_gids),
                 )
             else:
                 round_size = parse_partitions(self.partitions, n_bucket)
@@ -823,6 +1016,7 @@ class DistBaseSearchCV(BaseEstimator):
                     kernel, task_args, shared, round_size=round_size,
                     shared_specs=specs,
                     return_timings=True, cache_key=kernel_key,
+                    on_round=self._round_journal(checkpoint, disp_gids),
                 )
             # per-task fit_time = its round's measured wall / tasks in
             # that round (fit+score run fused in one kernel, so the
@@ -839,19 +1033,41 @@ class DistBaseSearchCV(BaseEstimator):
                 # is a scheduler detail, invisible in the artifact)
                 scores = {k: np.asarray(v)[inv] for k, v in scores.items()}
                 per_task_time = per_task_time[inv]
-            # unpack into global task order
-            t = 0
-            for cand_idx in cand_indices:
-                for s in range(n_splits):
-                    out[cand_idx * n_splits + s] = {
-                        k: float(v[t]) for k, v in scores.items()
-                    }
-                    out[cand_idx * n_splits + s]["fit_time"] = float(
-                        per_task_time[t]
-                    )
-                    out[cand_idx * n_splits + s]["score_time"] = 0.0
-                    t += 1
+            # unpack into global task order (gids maps the bucket's
+            # task axis — minus journal-restored lanes — back to
+            # (candidate x fold) ids)
+            for t, gid in enumerate(gids):
+                out[gid] = {k: float(v[t]) for k, v in scores.items()}
+                out[gid]["fit_time"] = float(per_task_time[t])
+                out[gid]["score_time"] = 0.0
+        # lane quarantine: non-finite scores (diverged lanes — fresh or
+        # journal-restored) map to error_score semantics, matching what
+        # the host path records for a failed fit
+        _quarantine_nonfinite(out, self.error_score)
         return out
+
+    @staticmethod
+    def _round_journal(checkpoint, disp_gids):
+        """``on_round`` callback journaling each gathered round's score
+        rows under their global task ids (``disp_gids`` is in DISPATCH
+        order — the cost permutation, when active). Times are journaled
+        as 0.0: per-round walls are only attributable after the whole
+        call, and a resumed task's fit cost was paid by the killed
+        process anyway. None checkpoint → no callback (zero overhead).
+        """
+        if checkpoint is None:
+            return None
+
+        def journal(start, round_out):
+            keys = list(round_out)
+            n = len(np.asarray(round_out[keys[0]]))
+            for i in range(n):
+                row = {k: float(np.asarray(round_out[k])[i]) for k in keys}
+                row["fit_time"] = 0.0
+                row["score_time"] = 0.0
+                checkpoint.record(int(disp_gids[start + i]), row)
+
+        return journal
 
     # ------------------------------------------------------------------
     def _format_results(self, candidate_params, scorers, n_splits, out):

@@ -5,7 +5,7 @@ every reference estimator is built on (reference ``search.py:411-437``,
 ``multiclass.py:316-331``, ``ensemble.py:304-322``).
 """
 
-from . import compile_cache
+from . import compile_cache, faults
 from .backend import (
     BatchedPlan,
     IterativeKernelSpec,
@@ -46,4 +46,5 @@ __all__ = [
     "compile_cache",
     "enable_disk_cache",
     "structural_key",
+    "faults",
 ]

@@ -23,6 +23,7 @@ mirroring the reference's ``sc=None`` joblib path (search.py:388-408) so
 unit tests need no accelerator.
 """
 
+import logging
 import math
 import os
 import time
@@ -31,7 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from . import compile_cache
+from . import compile_cache, faults
 
 
 def _env_flag(name):
@@ -154,7 +155,7 @@ class TaskBackend:
     def batched_map_iterative(self, spec, task_args, shared_args=(),
                               static_args=None, round_size=None,
                               shared_specs=None, return_timings=False,
-                              cache_key=None):
+                              cache_key=None, on_round=None):
         """Convergence-compacted execution of an iterative kernel (see
         :class:`IterativeKernelSpec`). Backends without the slice loop
         run the spec's fallback kernel through :meth:`batched_map`."""
@@ -168,6 +169,7 @@ class TaskBackend:
             static_args=static_args, round_size=round_size,
             shared_specs=shared_specs, return_timings=return_timings,
             cache_key=spec.fallback_cache_key or cache_key,
+            on_round=on_round,
         )
 
     #: task slots per round on the mapped axis (device count on mesh
@@ -441,7 +443,7 @@ class LocalBackend(TaskBackend):
     def batched_map_iterative(self, spec, task_args, shared_args=(),
                               static_args=None, round_size=None,
                               shared_specs=None, return_timings=False,
-                              cache_key=None):
+                              cache_key=None, on_round=None):
         """Convergence-compacted execution on the host device: same
         slice/compact/finalize loop as the mesh backend, single task
         slot."""
@@ -456,11 +458,12 @@ class LocalBackend(TaskBackend):
         return _dispatch_iterative(
             self, plan, spec, task_args, shared_args, static_args,
             shared_specs, n_tasks, chunk, return_timings, cache_key,
+            on_round=on_round,
         )
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
                     round_size=None, shared_specs=None, return_timings=False,
-                    pad_to_round=False, cache_key=None):
+                    pad_to_round=False, cache_key=None, on_round=None):
         """Run the stacked kernel on the host's default JAX device.
 
         Same compiled program as the TPU path minus the mesh sharding, so
@@ -473,7 +476,13 @@ class LocalBackend(TaskBackend):
         that must reuse one compiled shape. ``cache_key`` is the
         caller's structural compile-cache key (see
         ``parallel.compile_cache``): per-call kernel closures with the
-        same key share one traced/compiled program.
+        same key share one traced/compiled program. ``on_round(start,
+        out)`` observes each gathered round (checkpoint journaling).
+
+        Retryable faults (``parallel.faults`` taxonomy) re-dispatch
+        from the first unfinished task under the env-configured
+        :class:`~skdist_tpu.parallel.faults.RetryPolicy`; inputs are
+        immutable host slices, so a retried run is bitwise identical.
         """
         # no donation on the host path: task slices arrive as numpy
         # (uncommitted), which jit cannot donate — requesting it would
@@ -487,14 +496,37 @@ class LocalBackend(TaskBackend):
             chunk = min(n_tasks, round_size or n_tasks)
         timings = [] if return_timings else None
         stats = self.last_round_stats = {}
-        try:
-            out = _run_in_rounds(
-                fn, task_args, shared_args, n_tasks, chunk, timings=timings,
-                pipeline=not self.sync_rounds, stats=stats,
+        import jax
+
+        retry = _RetryState()
+        rounds_out = []
+        offset = 0
+        while offset < n_tasks or not rounds_out:
+            sub = (
+                jax.tree_util.tree_map(lambda a: a[offset:], task_args)
+                if offset else task_args
             )
-        except _RoundsExhausted as oom:
-            # no adaptive retry on host memory; surface the real error
-            raise oom.cause
+            cb = (
+                None if on_round is None
+                else (lambda start, out, _off=offset:
+                      on_round(_off + start, out))
+            )
+            try:
+                rounds_out.extend(_run_in_rounds(
+                    fn, sub, shared_args, n_tasks - offset, chunk,
+                    timings=timings, pipeline=not self.sync_rounds,
+                    stats=stats, concat=False, on_round=cb,
+                ))
+                break
+            except _RoundsExhausted as oom:
+                # no adaptive retry on host memory; surface the real error
+                raise oom.cause
+            except _RoundFault as rf:
+                rounds_out.extend(rf.completed)
+                offset += rf.consumed
+                retry.admit(rf, offset)
+        out = _concat_rounds(rounds_out)
+        stats["retries"] = retry.total
         return (out, timings) if return_timings else out
 
 
@@ -684,7 +716,7 @@ class TPUBackend(TaskBackend):
     def batched_map_iterative(self, spec, task_args, shared_args=(),
                               static_args=None, round_size=None,
                               shared_specs=None, return_timings=False,
-                              cache_key=None):
+                              cache_key=None, on_round=None):
         """Convergence-compacted execution over the mesh: slice the
         solvers, gather per-lane done flags (flags-only D2H), compact
         survivors into fewer slot-aligned rounds, finalize in original
@@ -694,15 +726,12 @@ class TPUBackend(TaskBackend):
         agreement at every slice."""
         n_tasks = _leading_dim(task_args)
         d = self.n_devices
-        multiprocess = (
-            len({dd.process_index for dd in self.mesh.devices.flat}) > 1
-        )
-        if multiprocess:
+        if self._spans_processes():
             return TaskBackend.batched_map_iterative(
                 self, spec, task_args, shared_args,
                 static_args=static_args, round_size=round_size,
                 shared_specs=shared_specs, return_timings=return_timings,
-                cache_key=cache_key,
+                cache_key=cache_key, on_round=on_round,
             )
         if round_size:
             chunk = int(math.ceil(min(n_tasks, round_size) / d) * d)
@@ -714,6 +743,7 @@ class TPUBackend(TaskBackend):
         return _dispatch_iterative(
             self, plan, spec, task_args, shared_args, static_args,
             shared_specs, n_tasks, chunk, return_timings, cache_key,
+            on_round=on_round,
         )
 
     def _mesh_min_int(self, value):
@@ -748,10 +778,14 @@ class TPUBackend(TaskBackend):
 
     def _free_device_bytes(self):
         """Free HBM on the first mesh device, or None where the backend
-        reports no stats (CPU virtual devices return None)."""
+        reports no stats (CPU virtual devices return None). A probe
+        failure is logged (once per exception type, then debug-level),
+        not silently eaten: a transport error here is often the first
+        sign of the flaky-tunnel faults the retry layer exists for."""
         try:
             stats = self.devices[0].memory_stats()
-        except Exception:
+        except Exception as exc:
+            faults.log_suppressed("TPUBackend._free_device_bytes", exc)
             return None
         if not stats or "bytes_limit" not in stats:
             return None
@@ -774,9 +808,17 @@ class TPUBackend(TaskBackend):
             )
         return _BroadcastHandle(value)
 
+    def _spans_processes(self):
+        """Whether THIS mesh's devices live in more than one process —
+        the one guard every collective-sensitive decision (chunk
+        agreement, OOM resume, round retry) keys on. Deliberately NOT
+        ``jax.process_count()``: a host-local mesh inside a larger
+        cluster runs independent per-host workloads."""
+        return len({d.process_index for d in self.mesh.devices.flat}) > 1
+
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
                     round_size=None, shared_specs=None, return_timings=False,
-                    pad_to_round=False, cache_key=None):
+                    pad_to_round=False, cache_key=None, on_round=None):
         """Stack → shard → compile once → run in rounds → gather.
 
         ``task_args``: pytree whose leaves have a leading axis of length
@@ -794,7 +836,23 @@ class TPUBackend(TaskBackend):
         ``cache_key`` is the caller's structural compile-cache key (see
         ``parallel.compile_cache``): per-call kernel closures with the
         same key share one traced/compiled program across fits.
-        Returns host numpy, leading axis n_tasks.
+        ``on_round(start, out)`` observes each gathered round
+        (checkpoint journaling). Returns host numpy, leading axis
+        n_tasks.
+
+        **Fault handling.** RESOURCE_EXHAUSTED keeps the proactive/
+        reactive shrink-and-resume below. A RETRYABLE fault
+        (``parallel.faults``: transient XLA runtime error, preemption,
+        watchdog) re-dispatches from the first unfinished task at the
+        SAME round size, under the env-configured
+        :class:`~skdist_tpu.parallel.faults.RetryPolicy`; a preemption
+        additionally re-places the shared args (device state is
+        presumed lost) through a fresh placement pass. Round inputs are
+        immutable host slices, so a retried run is bitwise identical to
+        an undisturbed one. Multi-process meshes stay FAIL-LOUD for
+        every fault kind — a locally caught exception cannot be
+        re-synchronised with peers already inside the next collective —
+        with a collective-consistent error message.
         """
         import jax
 
@@ -807,7 +865,7 @@ class TPUBackend(TaskBackend):
         plan = self.prepare_batched(
             kernel, shared_args, static_args, shared_specs, cache_key
         )
-        fn, shared_args, put = plan.fn, plan.shared, plan.put
+        fn, shared_placed, put = plan.fn, plan.shared, plan.put
         # Proactive round sizing (NOTES gap 5 closed): where the device
         # reports memory stats, AOT-compile the round program and shrink
         # the first round to fit BEFORE dispatch — a device OOM costs a
@@ -815,7 +873,7 @@ class TPUBackend(TaskBackend):
         # reactive halving below stays as the backstop for workloads
         # whose true footprint beats the linear estimate.
         exec_fn, chunk = _aot_exec_fn(
-            fn, shared_args, task_args, chunk, d,
+            fn, shared_placed, task_args, chunk, d,
             self._free_device_bytes(),
         )
         # The guard keys on whether THIS mesh spans processes — NOT on
@@ -823,9 +881,7 @@ class TPUBackend(TaskBackend):
         # cluster runs independent per-host workloads, and injecting a
         # global collective there would deadlock (and wrongly couple
         # unrelated hosts' chunk sizes).
-        multiprocess = (
-            len({d.process_index for d in self.mesh.devices.flat}) > 1
-        )
+        multiprocess = self._spans_processes()
         if multiprocess:
             # The proactive size is derived from LOCAL free HBM, which
             # can differ per host; a per-host chunk means mismatched
@@ -845,6 +901,7 @@ class TPUBackend(TaskBackend):
         # shape, so jax recompiles transparently.
         timings = [] if return_timings else None
         stats = self.last_round_stats = {}
+        retry = _RetryState()
         rounds_out = []
         offset = 0
         while offset < n_tasks:
@@ -852,11 +909,17 @@ class TPUBackend(TaskBackend):
                 jax.tree_util.tree_map(lambda a: a[offset:], task_args)
                 if offset else task_args
             )
+            cb = (
+                None if on_round is None
+                else (lambda start, out, _off=offset:
+                      on_round(_off + start, out))
+            )
             try:
                 rounds_out.extend(_run_in_rounds(
-                    exec_fn, sub, shared_args, n_tasks - offset, chunk,
+                    exec_fn, sub, shared_placed, n_tasks - offset, chunk,
                     put=put, timings=timings, concat=False,
                     pipeline=not self.sync_rounds, stats=stats,
+                    on_round=cb,
                 ))
                 break
             except _RoundsExhausted as oom:
@@ -884,7 +947,43 @@ class TPUBackend(TaskBackend):
                     f"at round_size={chunk} (pass partitions="
                     f"{-(-n_tasks // chunk)} to pick this up front)"
                 )
+            except _RoundFault as rf:
+                if multiprocess:
+                    # Same collective reality as the OOM branch: retry
+                    # is single-process only. The message carries no
+                    # process-local state (offsets, salvage counts), so
+                    # every process that raises prints the same remedy.
+                    raise RuntimeError(
+                        f"batched_map hit a {rf.kind} fault in a "
+                        "multi-process run; round retry cannot "
+                        "re-synchronise the SPMD program across "
+                        "processes. Restart the job to retry the search "
+                        "(durable checkpoints resume past completed "
+                        "tasks; see SKDIST_CHECKPOINT_DIR)."
+                    ) from rf.cause
+                rounds_out.extend(rf.completed)
+                offset += rf.consumed
+                retry.admit(rf, offset)  # raises rf.cause when spent
+                if rf.kind == faults.PREEMPTED:
+                    # device state is presumed lost with the preempted
+                    # worker: drop cached broadcasts and re-place the
+                    # shared args through a fresh placement pass (the
+                    # jit entry and its AOT executables are host-side
+                    # memos and survive)
+                    _BCAST_CACHE.clear()
+                    plan = self.prepare_batched(
+                        kernel, shared_args, static_args, shared_specs,
+                        cache_key,
+                    )
+                    fn, shared_placed, put = (
+                        plan.fn, plan.shared, plan.put
+                    )
+                    exec_fn, chunk = _aot_exec_fn(
+                        fn, shared_placed, task_args, chunk, d, None
+                    )
+                    faults.record("shared_replacements")
         out = _concat_rounds(rounds_out)
+        stats["retries"] = retry.total
         return (out, timings) if return_timings else out
 
 
@@ -1048,6 +1147,59 @@ class _RoundsExhausted(Exception):
         self.cause = cause
 
 
+class _RoundFault(Exception):
+    """Internal: a round failed with a RETRYABLE fault (transient XLA
+    runtime error, preemption, watchdog — ``faults.classify``). Same
+    salvage contract as :class:`_RoundsExhausted`: ``completed`` is a
+    contiguous task-prefix of gathered rounds covering ``consumed``
+    tasks, so the caller re-dispatches from the first unfinished task —
+    at the SAME round size (the fault was not a memory verdict)."""
+
+    def __init__(self, completed, consumed, cause, kind):
+        super().__init__(str(cause))
+        self.completed = completed
+        self.consumed = consumed
+        self.cause = cause
+        self.kind = kind
+
+
+class _RetryState:
+    """Consecutive-attempt accounting for the round-retry loops: the
+    budget is per ROUND (the counter resets whenever the task offset
+    advances — progress proves the fault really was transient), so a
+    long search tolerating one hiccup per round is not capped at
+    ``max_retries`` faults total."""
+
+    __slots__ = ("policy", "attempts", "last_offset", "total")
+
+    def __init__(self, policy=None):
+        self.policy = policy or faults.RetryPolicy()
+        self.attempts = 0
+        self.last_offset = -1
+        self.total = 0
+
+    def admit(self, rf, offset):
+        """Admit one more re-dispatch after ``rf`` salvaged up to task
+        ``offset`` — or raise ``rf.cause`` when the per-round budget is
+        spent. Sleeps the policy backoff before returning."""
+        if offset != self.last_offset:
+            self.attempts = 0
+            self.last_offset = offset
+        self.attempts += 1
+        if self.attempts > self.policy.max_retries:
+            faults.record("retries_exhausted")
+            raise rf.cause
+        self.total += 1
+        faults.record("rounds_retried")
+        warnings.warn(
+            f"batched round hit a {rf.kind} fault "
+            f"({type(rf.cause).__name__}); re-dispatching from task "
+            f"{offset} (attempt {self.attempts}/{self.policy.max_retries}, "
+            f"backoff {self.policy.delay_s(self.attempts) * 1e3:.0f} ms)"
+        )
+        self.policy.backoff(self.attempts)
+
+
 def _gather_host(tree):
     """collect(): device outputs → host numpy.
 
@@ -1117,20 +1269,24 @@ def _start_host_copy(dev_out):
     time the blocking gather reaches these arrays the bytes are already
     (or nearly) on host. Non-addressable leaves (multi-process meshes)
     are skipped; they take ``_gather_host``'s allgather leg. Errors are
-    swallowed: a poisoned async computation re-surfaces at the blocking
-    gather, where the OOM-resume machinery handles it."""
+    logged and absorbed (``faults.log_suppressed`` at debug level): a
+    poisoned async computation re-surfaces at the blocking gather,
+    where the OOM-resume/retry machinery classifies it — this early
+    echo must not pre-empt that handling."""
     import jax
 
     try:
         for leaf in jax.tree_util.tree_leaves(dev_out):
             if getattr(leaf, "is_fully_addressable", True):
                 leaf.copy_to_host_async()
-    except Exception:
-        pass
+    except Exception as exc:
+        faults.log_suppressed("_start_host_copy", exc,
+                              level=logging.DEBUG)
 
 
 def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
-                   timings=None, concat=True, pipeline=True, stats=None):
+                   timings=None, concat=True, pipeline=True, stats=None,
+                   on_round=None):
     """Shared round loop: slice task axis, pad the tail round to the
     fixed chunk shape (padding duplicates the last task; its outputs are
     sliced off), run, gather to host numpy, concatenate (or return the
@@ -1161,8 +1317,17 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
     results; with pipelining this is the unoverlapped remainder),
     ``mode``.
 
+    ``on_round``: optional callback ``on_round(start, out)`` invoked as
+    each round's outputs land on host (FIFO, so ``start`` — the round's
+    first task index relative to ``task_args`` — is contiguous with the
+    previous call). The durable-checkpoint layer journals completed
+    rounds through this; a round lost to a fault never fires it, and a
+    retried round fires it exactly once, on the attempt that gathered.
+
     A RESOURCE_EXHAUSTED failure raises :class:`_RoundsExhausted`
-    carrying the successfully gathered rounds.
+    carrying the successfully gathered rounds; a retryable fault
+    (``faults.classify``) raises :class:`_RoundFault` with the same
+    salvage contract. Other exceptions propagate untouched.
     """
     import jax
 
@@ -1177,13 +1342,11 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
     consumed = 0
     pending = []
     in_gather = False
-
-    def _oom(exc):
-        return _RoundsExhausted(outs, consumed, exc)
+    injector = faults.active_injector()
 
     def _gather_oldest():
         nonlocal t_prev, consumed, in_gather
-        dev_out, keep, pad = pending.pop(0)
+        dev_out, keep, pad, inj_round = pending.pop(0)
         in_gather = True
         t_g = time.perf_counter() if stats is not None else None
         out = _gather_host(dev_out)
@@ -1196,6 +1359,12 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
             t_prev = now
         if pad:
             out = jax.tree_util.tree_map(lambda a: a[:keep], out)
+        if inj_round is not None:
+            # deterministic NaN-lane poisoning rides the gather path so
+            # injected divergence looks exactly like a diverged kernel
+            out = injector.transform_output(inj_round, out)
+        if on_round is not None:
+            on_round(consumed, out)
         outs.append(out)
         consumed += keep
 
@@ -1228,8 +1397,15 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
             while len(pending) >= depth:
                 _gather_oldest()
             t_d = time.perf_counter() if stats is not None else None
+            # fault-injection seam: a planned transient/OOM/hang fires
+            # HERE, where a real device dispatch would fail; the
+            # returned ordinal tags this round for output poisoning
+            inj_round = (
+                injector.round_dispatched() if injector is not None
+                else None
+            )
             dev_out = fn(shared_args, sl)
-            pending.append((dev_out, stop - start, pad))
+            pending.append((dev_out, stop - start, pad, inj_round))
             if stats is not None:
                 stats["rounds"] += 1
                 stats["dispatch_s"] += time.perf_counter() - t_d
@@ -1238,9 +1414,16 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
         while pending:
             _gather_oldest()
     except Exception as exc:
-        if "RESOURCE_EXHAUSTED" not in str(exc):
+        kind = faults.classify(exc)
+        if kind == faults.OOM:
+            def wrap():
+                return _RoundsExhausted(outs, consumed, exc)
+        elif faults.is_retryable(kind):
+            def wrap():
+                return _RoundFault(outs, consumed, exc, kind)
+        else:
             raise
-        # _RoundsExhausted.completed is consumed by batched_map as a
+        # .completed is consumed by the retry/resume loops as a
         # CONTIGUOUS task prefix (offset += consumed), so what may be
         # salvaged depends on where the failure surfaced:
         if in_gather:
@@ -1254,14 +1437,22 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
         else:
             # at dispatch: everything pending precedes the failed
             # round — gather it to extend the contiguous prefix,
-            # stopping at the first round that itself fails
+            # stopping at the first round that itself fails. Only
+            # faults of the taxonomy are absorbed into the salvage
+            # (they re-surface on the resumed rounds if persistent); a
+            # FATAL drain error outranks the resume and propagates.
             while pending:
                 try:
                     _gather_oldest()
-                except Exception:
+                except Exception as drain_exc:
                     pending.clear()
+                    if faults.classify(drain_exc) == faults.FATAL:
+                        raise
+                    faults.log_suppressed(
+                        "_run_in_rounds.drain", drain_exc
+                    )
                     break
-        raise _oom(exc) from None
+        raise wrap() from None
     if not concat:
         return outs
     return _concat_rounds(outs)
@@ -1315,37 +1506,86 @@ def _pad_tail(tree, pad):
 
 def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
                         static_args, shared_specs, n_tasks, chunk,
-                        return_timings, cache_key):
-    """Run the compacted loop with the classic-path safety net: a
+                        return_timings, cache_key, on_round=None):
+    """Run the compacted loop with two safety nets. A
     RESOURCE_EXHAUSTED anywhere (a compacted round's carries do not fit,
     or the finalize pass trips the round loop's OOM machinery) downgrades
     to a plain ``batched_map`` of the spec's fallback kernel at the same
-    round size — correctness never depends on the slice loop."""
+    round size — correctness never depends on the slice loop. A
+    RETRYABLE fault (``parallel.faults`` taxonomy) re-runs the whole
+    compacted dispatch under the env-configured RetryPolicy — carries
+    live on device between slices, so a mid-slice fault has no durable
+    prefix to salvage the way the classic round loop does; a full
+    re-run is the round-granular retry at this path's granularity, and
+    it is bitwise identical (the slice loop is deterministic). When the
+    budget is spent, the classic fallback kernel (which retries per
+    round) is the last resort before failing loud."""
     stats = backend.last_round_stats = {}
     t0 = time.perf_counter()
-    try:
-        out = _run_compacted(
-            plan, spec, task_args, n_tasks, chunk, stats,
-            pipeline=not backend.sync_rounds,
-        )
-    except Exception as exc:
-        cause = exc.cause if isinstance(exc, _RoundsExhausted) else exc
-        if (not isinstance(exc, _RoundsExhausted)
-                and "RESOURCE_EXHAUSTED" not in str(exc)):
-            raise
-        if spec.fallback is None:
-            raise cause
-        warnings.warn(
-            "compacted iterative dispatch exhausted device memory; "
-            "falling back to the classic batched path at "
-            f"round_size={chunk}"
-        )
-        return backend.batched_map(
-            spec.fallback, task_args, shared_args,
-            static_args=static_args, round_size=chunk,
-            shared_specs=shared_specs, return_timings=return_timings,
-            cache_key=spec.fallback_cache_key or cache_key,
-        )
+    retry = _RetryState()
+    while True:
+        try:
+            out = _run_compacted(
+                plan, spec, task_args, n_tasks, chunk, stats,
+                pipeline=not backend.sync_rounds, on_round=on_round,
+            )
+            stats["retries"] = retry.total
+            break
+        except Exception as exc:
+            if isinstance(exc, (_RoundsExhausted, _RoundFault)):
+                cause = exc.cause
+                kind = (
+                    exc.kind if isinstance(exc, _RoundFault)
+                    else faults.OOM
+                )
+            else:
+                cause = exc
+                kind = faults.classify(exc)
+            if faults.is_retryable(kind):
+                try:
+                    retry.admit(
+                        _RoundFault([], 0, cause, kind), 0
+                    )
+                    if kind == faults.PREEMPTED:
+                        # same contract as the classic path: device
+                        # state (placed shared args, cached broadcasts)
+                        # is presumed lost with the preempted worker —
+                        # retrying against the old plan's buffers would
+                        # burn the whole budget on dead state
+                        _BCAST_CACHE.clear()
+                        plan = backend.prepare_batched_iterative(
+                            spec, shared_args, static_args,
+                            shared_specs, cache_key,
+                        )
+                        faults.record("shared_replacements")
+                    continue
+                except Exception:
+                    # budget spent: the classic fallback below is the
+                    # last resort before surfacing the fault
+                    if spec.fallback is None:
+                        raise cause from None
+                    warnings.warn(
+                        f"compacted iterative dispatch kept hitting "
+                        f"{kind} faults; falling back to the classic "
+                        f"batched path at round_size={chunk}"
+                    )
+            elif kind == faults.OOM:
+                if spec.fallback is None:
+                    raise cause
+                warnings.warn(
+                    "compacted iterative dispatch exhausted device "
+                    "memory; falling back to the classic batched path "
+                    f"at round_size={chunk}"
+                )
+            else:
+                raise
+            return backend.batched_map(
+                spec.fallback, task_args, shared_args,
+                static_args=static_args, round_size=chunk,
+                shared_specs=shared_specs, return_timings=return_timings,
+                cache_key=spec.fallback_cache_key or cache_key,
+                on_round=on_round,
+            )
     if return_timings:
         # one pseudo-round covering the whole call: per-task wall is a
         # uniform smear (slices interleave tasks, so a per-round
@@ -1369,7 +1609,7 @@ def _flags_only_gather(leaf):
 
 
 def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
-                   pipeline=True):
+                   pipeline=True, on_round=None):
     """The convergence-compacted slice loop.
 
     Phase 1 (iterate): partition the task axis into chunk-shaped rounds
@@ -1476,8 +1716,11 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
                 leaf = dev[spec.done_key]
                 if getattr(leaf, "is_fully_addressable", True):
                     leaf.copy_to_host_async()
-            except Exception:
-                pass
+            except Exception as exc:
+                # best-effort prefetch only; a real failure re-raises
+                # at the blocking flags gather where it is classified
+                faults.log_suppressed("_run_compacted.flags_prefetch",
+                                      exc, level=logging.DEBUG)
             pending.append(r)
             stats["dispatch_s"] += time.perf_counter() - t_d
             while len(pending) >= depth:
@@ -1560,7 +1803,7 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
         lambda sh, sl: fin_exec(sl),
         {"task": task_args, "carry": dict(fin_store)},
         shared, n_tasks, chunk, put=put, concat=True,
-        pipeline=pipeline, stats=fin_stats,
+        pipeline=pipeline, stats=fin_stats, on_round=on_round,
     )
     stats["finalize"] = fin_stats
     return out
@@ -1622,8 +1865,13 @@ def _aot_exec_fn(fn, shared_args, task_args, chunk, d, free_bytes,
             + _MAX_ROUNDS_IN_FLIGHT
             * (int(ma.output_size_in_bytes) + task_arg_bytes)
         )
-    except Exception:
-        return exec_fn, chunk  # no analysis on this backend: reactive only
+    except Exception as exc:
+        # no analysis on this backend: reactive backstop only. Logged
+        # (debug) rather than eaten — a compile failure surfacing here
+        # would otherwise masquerade as "analysis unsupported"
+        faults.log_suppressed("_aot_exec_fn.memory_analysis", exc,
+                              level=logging.DEBUG)
+        return exec_fn, chunk
 
     allowed = int(free_bytes * headroom)
     if needed > allowed and chunk > d:

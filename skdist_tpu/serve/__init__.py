@@ -12,7 +12,10 @@ over the same compiled block-inference programs the offline
 - :class:`ServingEngine` — submit/predict facade, multi-model routing
   (``name@version``), bounded-queue admission control with typed
   :class:`Overloaded` / :class:`DeadlineExceeded` rejections, graceful
-  drain.
+  drain; a per-version circuit breaker (typed :class:`CircuitOpen`
+  load-shedding for sick versions) and an optional dispatch watchdog
+  (``watchdog_ms`` / ``SKDIST_SERVE_WATCHDOG_MS``) built on the
+  ``parallel.faults`` taxonomy shared with the offline round loop.
 - :class:`ModelRegistry` — validated, versioned model store; stages
   parameters on device once and AOT-prewarms every shape-bucket
   program via ``parallel.compile_cache`` so the first real request
@@ -38,6 +41,7 @@ Quickstart::
 """
 
 from .batcher import (
+    CircuitOpen,
     DeadlineExceeded,
     MicroBatcher,
     Overloaded,
@@ -57,5 +61,6 @@ __all__ = [
     "ServingError",
     "Overloaded",
     "DeadlineExceeded",
+    "CircuitOpen",
     "shape_buckets",
 ]

@@ -40,6 +40,7 @@ __all__ = [
     "ServingError",
     "Overloaded",
     "DeadlineExceeded",
+    "CircuitOpen",
     "MicroBatcher",
     "shape_buckets",
 ]
@@ -57,6 +58,14 @@ class Overloaded(ServingError):
 
 class DeadlineExceeded(ServingError):
     """The request's deadline passed before its result was produced."""
+
+
+class CircuitOpen(ServingError):
+    """The target model version's circuit breaker is open: its recent
+    dispatches kept failing (``parallel.faults`` taxonomy), so requests
+    are shed at submit instead of queueing against a sick version.
+    Callers should fall back to a healthy version; the breaker
+    half-opens after its cooldown and one probe request re-tests."""
 
 
 def shape_buckets(max_rows, min_rows=1):

@@ -23,8 +23,28 @@ Requests larger than the largest shape bucket are rejected at submit
 with a pointer at ``batch_predict`` — bulk scoring is the offline
 path's job; letting one giant request ride the micro-batcher would
 stall every small request behind it.
+
+**Fault tolerance** (``parallel.faults`` taxonomy, shared with the
+offline round loop):
+
+- **dispatch watchdog**: with ``watchdog_ms`` set (or
+  ``SKDIST_SERVE_WATCHDOG_MS``), every device launch/gather runs under
+  a time budget; past it the flush's callers fail IMMEDIATELY with a
+  typed :class:`~skdist_tpu.parallel.faults.WatchdogTimeout` (the
+  taxonomy's WATCHDOG kind) instead of blocking on a hung runtime —
+  the stuck gather drains in a background thread and its late result
+  is dropped. Off by default: a watchdog budget is a latency SLO the
+  operator owns.
+- **per-version circuit breaker**: consecutive dispatch faults on one
+  ``name@version`` open its circuit; while open, ``submit`` sheds load
+  with a typed :class:`CircuitOpen` instead of queueing against a sick
+  version, and after ``breaker_cooldown_s`` a single probe request
+  re-tests. Healthy versions are untouched — the breaker is keyed per
+  version precisely so a bad rollout degrades one route, not the
+  engine.
 """
 
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -32,7 +52,9 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 
 import numpy as np
 
+from ..parallel import faults
 from .batcher import (
+    CircuitOpen,
     DeadlineExceeded,
     MicroBatcher,
     Overloaded,
@@ -65,7 +87,8 @@ class ServingEngine:
 
     def __init__(self, backend=None, registry=None, max_batch_rows=None,
                  buckets=None, max_delay_ms=2.0, max_queue_depth=1024,
-                 default_timeout_s=None):
+                 default_timeout_s=None, watchdog_ms=None,
+                 breaker_threshold=3, breaker_cooldown_s=30.0):
         self.registry = registry if registry is not None else ModelRegistry(
             backend=backend, max_batch_rows=max_batch_rows,
             buckets=buckets,
@@ -73,6 +96,26 @@ class ServingEngine:
         self.max_delay_s = float(max_delay_ms) / 1e3
         self.max_queue_depth = int(max_queue_depth)
         self.default_timeout_s = default_timeout_s
+        if watchdog_ms is None:
+            raw = os.environ.get("SKDIST_SERVE_WATCHDOG_MS", "").strip()
+            if raw:
+                try:
+                    watchdog_ms = float(raw)
+                except ValueError:
+                    faults.logger.warning(
+                        "ignoring non-numeric SKDIST_SERVE_WATCHDOG_MS=%r",
+                        raw,
+                    )
+        # <=0 means disabled, matching the repo's env-knob convention
+        # (SKDIST_FAULT_GUARD=0): a literal 0 ms budget would time out
+        # every dispatch and open every circuit
+        self.watchdog_s = (
+            None if watchdog_ms is None or float(watchdog_ms) <= 0
+            else float(watchdog_ms) / 1e3
+        )
+        self._breaker = faults.CircuitBreaker(
+            threshold=breaker_threshold, cooldown_s=breaker_cooldown_s,
+        )
         self._stats = ServingStats()
         self._batchers = {}
         self._lock = threading.Lock()
@@ -126,6 +169,13 @@ class ServingEngine:
             raise ValueError(
                 f"{entry.spec} was registered without {method!r} "
                 f"(has: {sorted(entry.methods)})"
+            )
+        if not self._breaker.allow(entry.spec):
+            self._stats.record_rejection("circuit")
+            raise CircuitOpen(
+                f"{entry.spec}'s circuit is open after repeated "
+                "dispatch faults; route to a healthy version or retry "
+                "after the cooldown"
             )
         path = entry.methods[method]
         X = self._as_request_rows(X, entry, device=path.device)
@@ -212,6 +262,9 @@ class ServingEngine:
         }
         out["max_queue_depth"] = self.max_queue_depth
         out["max_delay_ms"] = round(self.max_delay_s * 1e3, 3)
+        out["circuit_breaker"] = self._breaker.states()
+        out["watchdog_ms"] = (None if self.watchdog_s is None
+                              else round(self.watchdog_s * 1e3, 3))
         return out
 
     def queue_depth(self):
@@ -253,7 +306,7 @@ class ServingEngine:
             if b is None:
                 path = entry.methods[method]
                 b = MicroBatcher(
-                    path.dispatch,
+                    self._guard_dispatch(entry.spec, path.dispatch),
                     buckets=(entry.buckets if path.device
                              else [_HOST_MAX_ROWS]),
                     max_delay_s=self.max_delay_s,
@@ -263,6 +316,81 @@ class ServingEngine:
                 )
                 self._batchers[key] = b
             return b
+
+    def _guard_dispatch(self, key, dispatch):
+        """Wrap one model-method's dispatch with the fault layer: every
+        launch and every blocking finalize (gather) feeds the
+        per-version circuit breaker, and — when a watchdog budget is
+        configured — runs under it. A tripped watchdog fails the
+        flush's callers with a typed ``WatchdogTimeout`` NOW; the stuck
+        call keeps draining on a background thread (a blocked XLA
+        gather cannot be cancelled portably) and its late result is
+        dropped — which also means the flush's in-flight slot frees
+        early, so the budget briefly under-counts true device work.
+        ``watchdog_s=None`` (the default) adds nothing to the hot path
+        beyond the breaker's per-flush lock."""
+        breaker = self._breaker
+        watchdog_s = self.watchdog_s
+
+        def under_watchdog(fn):
+            if watchdog_s is None:
+                return fn()
+            box = {}
+            done = threading.Event()
+
+            def work():
+                try:
+                    box["out"] = fn()
+                except BaseException as exc:
+                    box["exc"] = exc
+                done.set()
+
+            t = threading.Thread(target=work, daemon=True,
+                                 name="skdist-serve-watchdog")
+            t.start()
+            if not done.wait(watchdog_s):
+                faults.record("watchdog_trips")
+                raise faults.WatchdogTimeout(
+                    f"{key} dispatch exceeded its watchdog budget "
+                    f"({watchdog_s * 1e3:.0f} ms)"
+                )
+            if "exc" in box:
+                raise box["exc"]
+            return box["out"]
+
+        def settle(exc=None):
+            if exc is None:
+                breaker.record_success(key)
+                return
+            kind = faults.classify(exc)
+            if breaker.record_failure(key, kind):
+                faults.logger.warning(
+                    "circuit for %s OPENED after repeated %s faults "
+                    "(last: %s)", key, kind, exc,
+                )
+
+        def guarded(X):
+            try:
+                out = under_watchdog(lambda: dispatch(X))
+            except Exception as exc:
+                settle(exc)
+                raise
+            if not callable(out):
+                settle()
+                return out
+
+            def finalize():
+                try:
+                    res = under_watchdog(out)
+                except Exception as exc:
+                    settle(exc)
+                    raise
+                settle()
+                return res
+
+            return finalize
+
+        return guarded
 
     @staticmethod
     def _as_request_rows(X, entry, device):

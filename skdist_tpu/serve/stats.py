@@ -50,6 +50,7 @@ class ServingStats:
         self._completed = 0
         self._rejected_overload = 0
         self._rejected_deadline = 0
+        self._rejected_circuit = 0
         self._dispatch_errors = 0
         self._queue_depths = {}  # per-batcher gauges; snapshot sums
         self._warm_snap = None
@@ -72,6 +73,11 @@ class ServingStats:
                 self._rejected_overload += 1
             elif kind == "deadline":
                 self._rejected_deadline += 1
+            elif kind == "circuit":
+                # breaker load-shed: no dispatch happened, so it must
+                # NOT count as a dispatch error (the alerting signal
+                # for real device failures)
+                self._rejected_circuit += 1
             else:
                 self._dispatch_errors += 1
 
@@ -137,6 +143,7 @@ class ServingStats:
                 "queue_depth": sum(self._queue_depths.values()),
                 "rejected_overloaded": self._rejected_overload,
                 "rejected_deadline": self._rejected_deadline,
+                "rejected_circuit": self._rejected_circuit,
                 "dispatch_errors": self._dispatch_errors,
                 "rows_served": self._rows_served,
                 "batch_fill_ratio": (

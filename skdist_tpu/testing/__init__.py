@@ -1,0 +1,13 @@
+"""
+Test-support utilities for skdist_tpu.
+
+``skdist_tpu.testing.faultinject`` is the deterministic fault-injection
+harness the fault-tolerance layer is certified with (unit tests +
+``build_tools/fault_smoke.py``). Nothing here is imported by library
+code paths except through the ``parallel.faults`` injector seam, which
+is a single ``None`` check per round when no injector is installed.
+"""
+
+from .faultinject import FaultInjector, inject
+
+__all__ = ["FaultInjector", "inject"]

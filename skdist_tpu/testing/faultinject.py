@@ -1,0 +1,190 @@
+"""
+Deterministic fault injection for the fan-out data plane.
+
+A :class:`FaultInjector` is installed into the ``parallel.faults``
+seam (``with FaultInjector().at_round(2): ...`` or :func:`inject`) and
+is consulted by the round loop at exactly two points:
+
+- **dispatch** of every round (``round_dispatched``): planned
+  transient / preemption / OOM / fatal faults RAISE here, where a real
+  device failure would surface; ``hang`` sleeps (watchdog fodder);
+  ``kill`` SIGKILLs the process (checkpoint-resume scenarios).
+- **gather** of every round (``transform_output``): planned ``nan``
+  injections poison chosen lanes of the gathered outputs — the
+  observable signature of a numerically diverged task, exercising the
+  lane-quarantine guard end to end.
+
+Rounds are numbered by a process-wide DISPATCH ordinal starting at 0
+when the injector is installed — retries consume ordinals too (the
+re-dispatch of a failed round is the next ordinal), which is what
+makes "this round fails once, then succeeds" expressible: a rule
+fires at most ``times`` times, so the retried dispatch sails through.
+Everything is host-side and deterministic: no randomness, no clocks in
+the decision path, so an injected run's task outputs are bitwise
+reproducible.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+from ..parallel import faults
+
+__all__ = ["FaultInjector", "inject"]
+
+#: fault kinds a rule may carry and the message each raises with —
+#: phrased so ``faults.classify`` maps them exactly like the real thing
+_RAISE_MESSAGES = {
+    "transient": "UNAVAILABLE: injected transient fault (skdist faultinject)",
+    "preempt": "injected fault: worker preempted (skdist faultinject)",
+    "oom": "RESOURCE_EXHAUSTED: injected allocation failure "
+           "(skdist faultinject)",
+    "fatal": "injected fatal fault (skdist faultinject)",
+}
+_KINDS = set(_RAISE_MESSAGES) | {"hang", "kill", "nan"}
+
+
+class _Rule:
+    __slots__ = ("kind", "lanes", "sleep_s", "times", "message")
+
+    def __init__(self, kind, lanes, sleep_s, times, message):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(have: {sorted(_KINDS)})")
+        self.kind = kind
+        self.lanes = tuple(int(i) for i in (lanes or (0,)))
+        self.sleep_s = float(sleep_s)
+        self.times = int(times)
+        self.message = message or _RAISE_MESSAGES.get(kind, "")
+
+
+class FaultInjector:
+    """Deterministic per-round fault plan (see module docstring).
+
+    Build a plan with :meth:`at_round` / :meth:`every` (chainable),
+    then install it as a context manager. ``fired`` records every
+    injection that actually happened as ``(ordinal, kind)`` — the
+    assertion surface for tests and the smoke gate.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._exact = {}    # ordinal -> [_Rule, ...]
+        self._every = []    # (period, _Rule)
+        self.fired = []
+
+    # ------------------------------------------------------------------
+    # plan construction
+    # ------------------------------------------------------------------
+    def at_round(self, ordinal, kind="transient", lanes=None, sleep_s=0.0,
+                 times=1, message=None):
+        """Fire ``kind`` at dispatch ordinal ``ordinal`` (at most
+        ``times`` times — with retries in play an exact ordinal fires
+        once and the re-dispatch lands on a later ordinal)."""
+        rule = _Rule(kind, lanes, sleep_s, times, message)
+        self._exact.setdefault(int(ordinal), []).append(rule)
+        return self
+
+    def every(self, period, kind="transient", lanes=None, sleep_s=0.0,
+              times=1, start=None, message=None):
+        """Fire ``kind`` on every ``period``-th dispatch (ordinals
+        ``period-1, 2*period-1, ...``, or ``start, start+period, ...``
+        when ``start`` is given), at most ``times`` times per matching
+        ordinal — the "fault on X% of rounds" knob."""
+        rule = _Rule(kind, lanes, sleep_s, times, message)
+        self._every.append((int(period), int(period) - 1 if start is None
+                            else int(start), rule))
+        return self
+
+    # ------------------------------------------------------------------
+    # runtime hooks (called by the round loop through the faults seam)
+    # ------------------------------------------------------------------
+    def _rules_for(self, ordinal):
+        for rule in self._exact.get(ordinal, ()):
+            yield rule
+        for period, start, rule in self._every:
+            if ordinal >= start and (ordinal - start) % period == 0:
+                yield rule
+
+    def round_dispatched(self):
+        """Assign this dispatch its ordinal; raise/sleep/kill per plan.
+        Returns the ordinal (the round loop tags the round with it so
+        gather-side poisoning hits the right outputs)."""
+        with self._lock:
+            ordinal = self._count
+            self._count += 1
+            todo = [r for r in self._rules_for(ordinal) if r.times > 0]
+            for rule in todo:
+                rule.times -= 1
+                self.fired.append((ordinal, rule.kind))
+        for rule in todo:
+            if rule.kind == "hang":
+                time.sleep(rule.sleep_s)
+            elif rule.kind == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif rule.kind != "nan":  # nan fires at gather instead
+                raise RuntimeError(rule.message)
+        return ordinal
+
+    def transform_output(self, ordinal, out):
+        """Poison planned lanes of a gathered round's float leaves with
+        NaN. ``ordinal`` is the tag ``round_dispatched`` returned for
+        this round; non-``nan`` rules are a no-op here."""
+        import jax
+
+        nan_rules = [
+            r for r in self._rules_for_fired(ordinal) if r.kind == "nan"
+        ]
+        if not nan_rules:
+            return out
+        lanes = sorted({i for r in nan_rules for i in r.lanes})
+
+        def poison(leaf):
+            arr = np.array(leaf)
+            if not np.issubdtype(arr.dtype, np.floating):
+                return arr
+            for i in lanes:
+                if i < arr.shape[0]:
+                    arr[i] = np.nan
+            return arr
+
+        return jax.tree_util.tree_map(poison, out)
+
+    def _rules_for_fired(self, ordinal):
+        """nan rules consume their budget at DISPATCH (so ``times``
+        means dispatches, consistently across kinds) — at gather we
+        match the fired log, not the live budget."""
+        with self._lock:
+            fired_here = {k for o, k in self.fired if o == ordinal}
+            if "nan" not in fired_here:
+                return []
+            return [r for r in self._rules_for(ordinal) if r.kind == "nan"]
+
+    # ------------------------------------------------------------------
+    @property
+    def dispatch_count(self):
+        with self._lock:
+            return self._count
+
+    def fired_kinds(self):
+        with self._lock:
+            return [k for _o, k in self.fired]
+
+    def __enter__(self):
+        self._prev = faults.set_injector(self)
+        return self
+
+    def __exit__(self, *exc):
+        faults.set_injector(self._prev)
+        return False
+
+
+def inject(**kwargs):
+    """One-rule convenience: ``with inject(ordinal=3, kind="nan",
+    lanes=[1]): ...`` — sugar over ``FaultInjector().at_round``."""
+    ordinal = kwargs.pop("ordinal", 0)
+    return FaultInjector().at_round(ordinal, **kwargs)

@@ -75,6 +75,29 @@ def check_is_fitted(estimator, attributes=None):
         )
 
 
+def check_error_score(error_score):
+    """Validate the ``error_score`` policy AT ``fit()`` ENTRY: 'raise'
+    or a real number (NaN included — sklearn's default). Validating
+    lazily — only when the first fit actually fails — meant a typo'd
+    ``error_score="nan"`` surfaced mid-search and discarded hours of
+    completed work; this is the front-door check. Returns the value
+    unchanged so call sites can inline it."""
+    if isinstance(error_score, str):
+        if error_score == "raise":
+            return error_score
+        raise ValueError(
+            f"error_score must be 'raise' or a number, got "
+            f"{error_score!r} (did you mean numpy.nan?)"
+        )
+    if isinstance(error_score, bool) or not isinstance(
+            error_score, numbers.Number):
+        raise ValueError(
+            f"error_score must be 'raise' or a number, got "
+            f"{error_score!r}"
+        )
+    return error_score
+
+
 def check_n_iter(n_iter, param_distributions):
     """Cap n_iter at the size of a fully-enumerable grid (reference
     ``_check_n_iter``, validation.py:99-110)."""
