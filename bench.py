@@ -557,6 +557,112 @@ def compaction_aux(quick=False):
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+def asha_workload(quick=False, seed=0):
+    """Quality-skewed grid for the ASHA (adaptive halving) readout: a
+    wide log-C sweep at tight tol and a deep iteration budget — WITHOUT
+    adaptive elimination every lane runs to (or near) ``max_iter``, so
+    exhaustive wall scales with the full candidate count, while
+    candidate QUALITY is strongly C-dependent and readable from the
+    first slices. quick: 96 candidates x 5 folds = 480 tasks (the smoke
+    gate's grid); full: 1040 x 5 = 5200 tasks (the >=1000-candidate
+    acceptance capture)."""
+    rng = np.random.RandomState(seed)
+    n, d, k = 600, 48, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.argmax(X @ W + 1.5 * rng.normal(size=(n, k)), axis=1)
+    n_cand = 96 if quick else 1040
+    grid = {"C": list(np.logspace(-7, 3, n_cand)), "tol": [1e-6]}
+    return X, y, grid, n_cand * 5
+
+
+def asha_aux(quick=False, eta=3, min_slices=1, slice_iters=8):
+    """Measured readout of ASHA-on-carries: warm wall of the adaptive
+    search vs the same grid through the exhaustive compacted path, plus
+    the acceptance evidence — identical best candidate, survivor-score
+    parity (candidates the rungs did NOT kill score identically to the
+    exhaustive run), the retirement-reason split, and the warm
+    compile-invariant. Best-effort: a dict with "error" on any
+    failure.
+
+    ``slice_iters`` pins ``SKDIST_SLICE_ITERS`` for BOTH legs (same
+    slice config, apples to apples): finer slices barely move the
+    exhaustive wall (the extra cost is a flags-only D2H per slice) but
+    let the first rung fire after fewer iterations, which is where
+    ASHA's advantage lives. None = leave the ambient default (~1/8 of
+    max_iter)."""
+    import warnings as _warnings
+
+    from skdist_tpu.distribute.search import DistGridSearchCV, HalvingSpec
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend, compile_cache
+
+    old_slice = os.environ.get("SKDIST_SLICE_ITERS")
+    if slice_iters is not None:
+        os.environ["SKDIST_SLICE_ITERS"] = str(int(slice_iters))
+    try:
+        X, y, grid, n_tasks = asha_workload(quick=quick)
+        est = LogisticRegression(max_iter=120, engine="xla")
+
+        def run_once(adaptive):
+            bk = TPUBackend(reuse_broadcast=True)
+            gs = DistGridSearchCV(
+                est, grid, backend=bk, cv=5, scoring="accuracy",
+                refit=False, adaptive=adaptive,
+            )
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore")
+                t0 = time.perf_counter()
+                gs.fit(X, y)
+                wall = time.perf_counter() - t0
+            return wall, gs, dict(bk.last_round_stats or {})
+
+        spec = HalvingSpec(eta=eta, min_slices=min_slices)
+        run_once(spec)  # cold (compiles init/step/finalize/score)
+        snap0 = compile_cache.snapshot()
+        warm_s, gs_a, stats = run_once(spec)
+        warm_delta = _cache_delta(snap0, compile_cache.snapshot())
+        run_once(None)  # exhaustive cold
+        base_s, gs_e, _ = run_once(None)
+
+        rung_col = np.asarray(gs_a.cv_results_["rung_"])
+        survivors = rung_col < 0
+        surv_parity = float(np.max(np.abs(
+            np.asarray(gs_a.cv_results_["mean_test_score"])[survivors]
+            - np.asarray(gs_e.cv_results_["mean_test_score"])[survivors]
+        ))) if survivors.any() else None
+        hist = [dict(h) for h in stats.get("rung_history", [])]
+        return {
+            "n_tasks": n_tasks,
+            "n_candidates": int(rung_col.size),
+            "eta": float(eta),
+            "min_slices": int(min_slices),
+            "slice_iters": None if slice_iters is None else int(slice_iters),
+            "adaptive_warm_wall_s": round(warm_s, 3),
+            "exhaustive_warm_wall_s": round(base_s, 3),
+            "speedup_vs_exhaustive": round(base_s / warm_s, 3),
+            "same_best_candidate": bool(
+                gs_a.best_index_ == gs_e.best_index_
+            ),
+            "best_index": int(gs_e.best_index_),
+            "n_survivor_candidates": int(survivors.sum()),
+            "survivor_score_max_diff": surv_parity,
+            "retired_rung": stats.get("retired_rung"),
+            "retired_convergence": stats.get("retired_convergence"),
+            "rung_history": hist,
+            "slices": stats.get("slices"),
+            "chunk": stats.get("chunk"),
+            "warm_compile_cache_delta": warm_delta,
+        }
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        if old_slice is None:
+            os.environ.pop("SKDIST_SLICE_ITERS", None)
+        else:
+            os.environ["SKDIST_SLICE_ITERS"] = old_slice
+
+
 def run_bench(platform, quick=False):
     from skdist_tpu.distribute.search import DistGridSearchCV
     from skdist_tpu.models import LogisticRegression
@@ -759,6 +865,7 @@ def run_bench(platform, quick=False):
             "serving": _serving_aux(gs.best_estimator_, X),
             "compaction": compaction_aux(quick=quick),
             "sparse": sparse_aux(quick=quick),
+            "asha": asha_aux(quick=quick),
             "batched_vs_generic_cv_results_max_diff": parity,
             "f32_noise_floor_wellcond": floor_well,
             "illcond_C100_diff": parity_ill,
@@ -976,6 +1083,27 @@ def _phase_main(argv):
     run_bench(platform, quick=(phase == "quick"))
 
 
+def _asha_main(quick=False):
+    """Standalone capture of the adaptive-halving readout →
+    ``BENCH_asha_r09.json`` (adaptive vs exhaustive compacted warm
+    walls on the >=1000-candidate grid, best-candidate identity,
+    survivor parity, per-rung kill histogram, compile invariant)."""
+    import jax
+
+    payload = {
+        "metric": "asha_adaptive_search",
+        "aux": asha_aux(quick=quick),
+        "platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(payload, indent=1), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_asha_r09.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
 def _sparse_main(quick=False):
     """Standalone capture of the sparse-plane readout →
     ``BENCH_sparse_r08.json`` (dense-path vs packed-path fits/s, peak
@@ -1001,5 +1129,7 @@ if __name__ == "__main__":
         _phase_main(sys.argv)
     elif "--sparse" in sys.argv:
         _sparse_main(quick="--quick" in sys.argv)
+    elif "--asha" in sys.argv:
+        _asha_main(quick="--quick" in sys.argv)
     else:
         main(quick="--quick" in sys.argv)

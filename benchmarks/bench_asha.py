@@ -1,0 +1,95 @@
+"""ASHA knob sweep: eta (reduction factor) x min_slices (rung cadence)
+on the quality-skewed grid, one JSON line per cell, plus the exhaustive
+compacted baseline.
+
+The sweep answers the tuning questions the HalvingSpec defaults bake
+in: aggressive eta kills more work earlier but risks killing the
+winner before its quality is readable; a later first rung
+(min_slices > 1) lets fits mature before judging them at the price of
+paying full fan-out for more slices. Each cell reports wall, speedup,
+whether the exhaustive best candidate survived, and the per-rung kill
+histogram.
+
+Usage (CPU mesh, like the unit tier):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/bench_asha.py [--quick] [--full-grid]
+
+``--quick`` sweeps the 480-task grid (96 candidates); ``--full-grid``
+uses the 5200-task (1040-candidate) acceptance grid per cell — slow.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def _fit(X, y, grid, adaptive):
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend
+    import warnings
+
+    backend = TPUBackend(reuse_broadcast=True)
+    gs = DistGridSearchCV(
+        LogisticRegression(max_iter=120, engine="xla"), grid,
+        backend=backend, cv=5, scoring="accuracy", refit=False,
+        adaptive=adaptive,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t0 = time.perf_counter()
+        gs.fit(X, y)
+        wall = time.perf_counter() - t0
+    return wall, gs, dict(backend.last_round_stats or {})
+
+
+def main(quick=True):
+    from bench import asha_workload
+    from skdist_tpu.distribute.search import HalvingSpec
+
+    X, y, grid, n_tasks = asha_workload(quick=quick)
+    print(json.dumps({"workload": {
+        "n_tasks": n_tasks, "shape": list(X.shape),
+        "grid": "logspace C, tight tol, max_iter=120",
+    }}), flush=True)
+
+    # warm every program once (the sweep measures execution, not
+    # compiles), then the exhaustive baseline twice (cold already paid)
+    _fit(X, y, grid, HalvingSpec(eta=3, min_slices=1))
+    _fit(X, y, grid, None)
+    base_s, gs_e, _ = _fit(X, y, grid, None)
+    print(json.dumps({"cell": "exhaustive", "wall_s": round(base_s, 3),
+                      "best_index": int(gs_e.best_index_)}), flush=True)
+
+    for eta in (2, 3, 4):
+        for min_slices in (1, 2, 3):
+            spec = HalvingSpec(eta=eta, min_slices=min_slices)
+            _fit(X, y, grid, spec)  # warm this spec's rung cadence
+            wall, gs, stats = _fit(X, y, grid, spec)
+            hist = stats.get("rung_history", [])
+            print(json.dumps({
+                "cell": {"eta": eta, "min_slices": min_slices},
+                "wall_s": round(wall, 3),
+                "speedup": round(base_s / wall, 3),
+                "same_best": bool(gs.best_index_ == gs_e.best_index_),
+                "retired_rung": stats.get("retired_rung"),
+                "retired_convergence": stats.get("retired_convergence"),
+                "kills_per_rung": [h["n_killed"] for h in hist],
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main(quick="--full-grid" not in sys.argv)

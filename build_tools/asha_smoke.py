@@ -1,0 +1,102 @@
+"""ASHA-on-carries smoke: the adaptive-search PR's acceptance gate,
+standalone on the 8-virtual-device CPU mesh.
+
+Runs the 480-task quality-skewed grid (``bench.asha_workload(quick)``:
+96 candidates x 5 folds, wide log-C sweep at tight tol and a deep
+iteration budget) through ``DistGridSearchCV(adaptive=HalvingSpec(...))``
+and the exhaustive compacted path and asserts:
+
+- adaptive warm-wall speedup >= RATIO (default 3.0) over exhaustive
+  compacted execution;
+- SAME best candidate: the rungs never killed the winner;
+- survivor-score parity <= 1e-5: candidates the rungs did not kill
+  score identically to the exhaustive run (a rung read carries, it
+  never perturbed them);
+- rungs actually fired and the retirement-reason split is coherent:
+  ``retired_rung`` + ``retired_convergence`` == n_tasks, with a
+  per-rung kill histogram (the observability satellite);
+- NO recompile after warmup: the warm adaptive run moves only hit
+  counters (the rung-score program reuses structural compile keys — at
+  most one extra program per (kernel, chunk)).
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/asha_smoke.py [--ratio 3.0]
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def main(ratio):
+    from bench import asha_aux
+
+    aux = asha_aux(quick=True)
+    print(json.dumps({"asha": aux, "target_ratio": ratio}, indent=1))
+    if "error" in aux:
+        raise SystemExit(f"FAIL: asha aux died: {aux['error']}")
+
+    failures = []
+    if aux["speedup_vs_exhaustive"] < ratio:
+        failures.append(
+            f"speedup {aux['speedup_vs_exhaustive']} < {ratio}"
+        )
+    if not aux["same_best_candidate"]:
+        failures.append(
+            "adaptive search returned a different best candidate than "
+            "exhaustive — the rungs killed the winner"
+        )
+    parity = aux["survivor_score_max_diff"]
+    if parity is None:
+        failures.append("no surviving candidates to check parity on")
+    elif parity > 1e-5:
+        failures.append(f"survivor-score parity {parity} > 1e-5")
+    hist = aux.get("rung_history") or []
+    killed = sum(h["n_killed"] for h in hist)
+    if not hist or killed == 0:
+        failures.append(
+            "no rung ever fired/killed: the adaptive path did not run "
+            "(fell back to exhaustive dispatch)"
+        )
+    if aux.get("retired_rung") != killed:
+        failures.append(
+            f"retirement split incoherent: retired_rung="
+            f"{aux.get('retired_rung')} but rung histogram kills {killed}"
+        )
+    if (aux.get("retired_rung") or 0) + (
+            aux.get("retired_convergence") or 0) != aux["n_tasks"]:
+        failures.append(
+            "retired_rung + retired_convergence != n_tasks "
+            f"({aux.get('retired_rung')} + "
+            f"{aux.get('retired_convergence')} != {aux['n_tasks']})"
+        )
+    warm = aux["warm_compile_cache_delta"]
+    if warm["aot_misses"] or warm["jit_misses"] or warm["kernel_misses"]:
+        failures.append(f"compiles_after_warmup != 0: warm delta {warm}")
+    if failures:
+        raise SystemExit("FAIL: " + "; ".join(failures))
+    print(
+        f"PASS: adaptive {aux['adaptive_warm_wall_s']}s vs exhaustive "
+        f"{aux['exhaustive_warm_wall_s']}s "
+        f"({aux['speedup_vs_exhaustive']}x >= {ratio}x), same best "
+        f"candidate #{aux['best_index']}, {killed} lanes rung-killed "
+        f"across {len(hist)} rungs, survivor parity {parity}, 0 warm "
+        "compiles"
+    )
+
+
+if __name__ == "__main__":
+    r = 3.0
+    if "--ratio" in sys.argv:
+        r = float(sys.argv[sys.argv.index("--ratio") + 1])
+    main(r)

@@ -23,6 +23,12 @@
 #                             converged coefficient / cv-score parity
 #                             <= 1e-5, 0 compiles after warmup
 #                             (sparse-native fit data plane PR).
+#   asha_smoke.py           — 480-task quality-skewed grid: adaptive
+#                             (ASHA) warm wall >= 3x over exhaustive
+#                             compacted execution, SAME best candidate,
+#                             survivor-score parity <= 1e-5, coherent
+#                             rung/convergence retirement split, 0
+#                             compiles after warmup (adaptive-search PR).
 #   fault_smoke.py          — fault-injection matrix: transient faults
 #                             on rounds retried to a bitwise-identical
 #                             cv_results_; NaN lane quarantined to
@@ -38,4 +44,5 @@ python build_tools/serving_smoke.py
 python build_tools/compile_cache_smoke.py
 python build_tools/compaction_smoke.py
 python build_tools/sparse_fit_smoke.py
+python build_tools/asha_smoke.py
 python build_tools/fault_smoke.py

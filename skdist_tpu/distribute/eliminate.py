@@ -18,6 +18,7 @@ reference re-broadcasts X and slices columns per executor task
 (eliminate.py:23-38,188-210).
 """
 
+import warnings
 from itertools import product
 
 import numpy as np
@@ -28,13 +29,17 @@ from ..metrics import (
     aggregate_score_dicts,
     check_multimetric_scoring,
     device_scorer_compatible,
+    resolve_rung_scorer,
 )
 from ..parallel import (
+    RungController,
+    iterative_fit_supported,
     parse_partitions,
     prefers_host_engine,
     resolve_backend,
 )
 from ..utils.validation import check_estimator_backend, check_is_fitted
+from .adaptive import RungKilledWarning, check_adaptive, warn_not_engaged
 from .search import _fit_and_score, _resolve_device_scoring
 
 __all__ = ["DistFeatureEliminator"]
@@ -57,7 +62,7 @@ class DistFeatureEliminator(BaseEstimator):
 
     def __init__(self, estimator, backend=None, partitions="auto",
                  min_features_to_select=None, step=1, cv=5, scoring=None,
-                 verbose=False, n_jobs=None, mask=True):
+                 verbose=False, n_jobs=None, mask=True, adaptive=None):
         self.estimator = estimator
         self.backend = backend
         self.partitions = partitions
@@ -68,12 +73,21 @@ class DistFeatureEliminator(BaseEstimator):
         self.verbose = verbose
         self.n_jobs = n_jobs
         self.mask = mask
+        # adaptive=HalvingSpec(...): feature sets ride the SAME ASHA
+        # rungs as the CV search — every K slices the live (set x fold)
+        # lanes are scored on device and the bottom 1-1/eta sets
+        # killed; killed sets score NaN (never selected) and rung_
+        # records where each set died
+        self.adaptive = adaptive
 
     # ------------------------------------------------------------------
     def fit(self, X, y=None, groups=None, **fit_params):
         from sklearn.model_selection import check_cv
         from sklearn.utils import safe_sqr
 
+        check_adaptive(self.adaptive)
+        self._adaptive_engaged_ = False
+        self._rung_per_set_ = None
         check_estimator_backend(self, self.verbose)
         backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
         X_arr = np.asarray(X) if not hasattr(X, "iloc") else X
@@ -128,6 +142,16 @@ class DistFeatureEliminator(BaseEstimator):
             backend, X_arr, y, splits, features_to_remove, fit_params
         )
         self.scores_ = scores
+        if self.adaptive is not None:
+            if not self._adaptive_engaged_:
+                warn_not_engaged("the eliminator")
+            # rung at which each feature set died (-1 = completed)
+            self.rung_ = (
+                self._rung_per_set_
+                if self._rung_per_set_ is not None
+                else np.full(len(features_to_remove), -1, np.int32)
+            )
+        del self._adaptive_engaged_, self._rung_per_set_
         # NaN (failed folds under error_score=np.nan) must never win:
         # np.argmax treats NaN as the maximum. Rank NaN sets as -inf;
         # refuse to pick when every set failed.
@@ -232,7 +256,8 @@ class DistFeatureEliminator(BaseEstimator):
             fmasks[i, rem] = 0.0
 
         data, meta = est._prep_fit_data(X_arr, y, None)
-        static = _freeze(est._static_config(meta))
+        static_cfg = est._static_config(meta)
+        static = _freeze(static_cfg)
         base_key = _cv_kernel_key(type(est), meta, static, scorer_specs,
                                   False)
         base_kernel = _cached_cv_kernel(
@@ -243,6 +268,26 @@ class DistFeatureEliminator(BaseEstimator):
         hyper = {
             k: hyper_float(getattr(est, k)) for k in type(est)._hyper_names
         }
+        n_tasks = n_sets * n_splits
+        round_size = parse_partitions(self.partitions, n_tasks)
+        from ..parallel import row_sharded_specs
+
+        n_slice = iterative_fit_supported(
+            backend, type(est), n_tasks, static_cfg.get("max_iter")
+        )
+        if n_slice is not None:
+            # convergence-compacted (and, with adaptive=, ASHA-rung)
+            # execution: the (feature_set x fold) axis rides the SAME
+            # batched_map_iterative entry point as the CV search, with
+            # the column mask as a task leaf (mask_x) and the
+            # estimator's fixed hypers broadcast onto the task axis so
+            # the shared CV slice kernels apply verbatim
+            return self._try_batched_iterative(
+                backend, est, meta, static, static_cfg, scorer_specs,
+                base_kernel, base_key, data, hyper, train_masks,
+                test_masks, fmasks, n_sets, n_splits, n_slice,
+                round_size, np.unique(y) if y is not None else None,
+            )
 
         def kernel(shared, task):
             masked = dict(shared)
@@ -266,10 +311,6 @@ class DistFeatureEliminator(BaseEstimator):
                 np.arange(n_splits, dtype=np.int32), n_sets
             ),
         }
-        n_tasks = n_sets * n_splits
-        round_size = parse_partitions(self.partitions, n_tasks)
-        from ..parallel import row_sharded_specs
-
         scores = backend.batched_map(
             kernel, task_args, shared, round_size=round_size,
             shared_specs=row_sharded_specs(backend, shared, {
@@ -284,6 +325,102 @@ class DistFeatureEliminator(BaseEstimator):
         return np.asarray(
             scores["test_score"], dtype=np.float64
         ).reshape(n_sets, n_splits)
+
+    def _try_batched_iterative(self, backend, est, meta, static,
+                               static_cfg, scorer_specs, base_kernel,
+                               base_key, data, hyper, train_masks,
+                               test_masks, fmasks, n_sets, n_splits,
+                               n_slice, round_size, classes):
+        """Iteration-sliced (set x fold) scoring through the shared
+        ``_iterative_fit_spec``/``_cv_iterative_spec`` entry point,
+        optionally racing the sets on ASHA rungs. Killed sets score NaN
+        (the NaN-proof selection below never picks them) and their
+        rungs land in ``rung_``."""
+        from ..models.linear import extract_aux
+        from ..parallel import row_sharded_specs, structural_key
+        from .search import _cv_iterative_spec
+
+        est_cls = type(est)
+        n_tasks = n_sets * n_splits
+        task_args = {
+            "fmask": np.repeat(fmasks, n_splits, axis=0),
+            "split": np.tile(np.arange(n_splits, dtype=np.int32), n_sets),
+            # fixed hypers broadcast per task so the CV slice kernels
+            # (which read task["hyper"]) apply without a special case
+            "hyper": {
+                k: np.full(n_tasks, float(v), dtype=np.float32)
+                for k, v in hyper.items()
+            },
+        }
+        shared = {
+            "X": data["X"],
+            "y": data["y"],
+            "sw": data["sw"],
+            "aux": extract_aux(data),
+            "train_masks": train_masks,
+            "test_masks": test_masks,
+        }
+
+        def fb_kernel(shared, task):
+            masked = dict(shared)
+            masked["X"] = shared["X"] * task["fmask"]
+            return base_kernel(
+                masked, {"hyper": task["hyper"], "split": task["split"]}
+            )
+
+        fb_key = structural_key("eliminate_iter_fb", est_cls, base_key)
+        rung_ctrl = None
+        rung_spec = None
+        if self.adaptive is not None:
+            rung_spec = resolve_rung_scorer(
+                self.adaptive.metric, scorer_specs, True, classes,
+                est_cls=est_cls,
+            )
+            if rung_spec is not None:
+                rung_ctrl = RungController(
+                    self.adaptive.eta, self.adaptive.min_slices,
+                    # group = feature set: a set's fold lanes live and
+                    # die together on their mean rung score
+                    groups=np.repeat(np.arange(n_sets), n_splits),
+                )
+        spec, iter_key = _cv_iterative_spec(
+            est_cls, meta, static, scorer_specs, False, n_slice,
+            fallback=fb_kernel, fallback_key=fb_key,
+            rung_spec=rung_spec, mask_x=True,
+        )
+        scores = backend.batched_map_iterative(
+            spec, task_args, shared,
+            round_size=(
+                None if self.partitions in ("auto", None) else round_size
+            ),
+            shared_specs=row_sharded_specs(backend, shared, {
+                "X": 0, "y": 0, "sw": 0,
+                "train_masks": 1, "test_masks": 1,
+            }),
+            cache_key=iter_key, rung=rung_ctrl,
+        )
+        flat = np.asarray(scores["test_score"], dtype=np.float64)
+        if rung_ctrl is not None and rung_ctrl.active:
+            # engaged only if the compacted slice loop actually ran the
+            # rungs — a backend downgrade (multi-process mesh, OOM/
+            # fault fallback) deactivates the controller and fit's
+            # could-not-engage warning must fire
+            self._adaptive_engaged_ = True
+        if rung_ctrl is not None and rung_ctrl.killed:
+            rungs = np.full(n_sets, -1, np.int32)
+            for lane, r in rung_ctrl.killed.items():
+                flat[lane] = np.nan
+                s = int(lane) // n_splits
+                rungs[s] = max(rungs[s], int(r))
+            self._rung_per_set_ = rungs
+            warnings.warn(
+                f"{len(rung_ctrl.killed)} of {n_tasks} feature-set "
+                "fits were retired early by adaptive successive "
+                "halving; their sets score NaN and rung_ records "
+                "where each died.",
+                RungKilledWarning,
+            )
+        return flat.reshape(n_sets, n_splits)
 
     # ------------------------------------------------------------------
     def _apply_mask(self, X):

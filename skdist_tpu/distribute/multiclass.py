@@ -267,15 +267,29 @@ def _binary_confidence(est, X):
 
 
 def _iterative_fit_spec(est_cls, meta, static, n_slice, derive,
-                        fallback_kernel, fallback_key, key):
+                        fallback_kernel, fallback_key, key,
+                        outputs=None, rung_score=None):
     """Wrap an estimator's iteration-sliced fit kernels for the
-    convergence-compacted backend entry point (the SAME
-    ``batched_map_iterative`` path the CV search uses). ``derive(shared,
-    task) -> (X, y_bin, w, hyper, aux)`` supplies the per-task binary
-    sub-problem (OvR class column / OvO pair mask); ``key`` must bake in
-    everything ``derive`` depends on beyond (est_cls, static, meta).
-    Returns an ``IterativeKernelSpec`` whose kernels are memoised on
-    ``key``."""
+    convergence-compacted backend entry point — the ONE
+    ``batched_map_iterative`` spec builder shared by the CV search,
+    OvR/OvO, and the feature eliminator. ``derive(shared, task) ->
+    (X, y, w, hyper, aux)`` supplies the per-task sub-problem (CV
+    fold-masked weights, OvR class column, OvO pair mask, eliminate's
+    feature-masked X); ``key`` must bake in everything ``derive`` /
+    ``outputs`` / ``rung_score`` depend on beyond (est_cls, static,
+    meta). Returns an ``IterativeKernelSpec`` whose kernels are
+    memoised on ``key``.
+
+    ``outputs(params, shared, task)`` optionally post-processes the
+    finalized fit params into the spec's outputs (the CV search scores
+    them on the fold masks here); None returns the raw params (the
+    OvR/OvO per-class artifact). ``rung_score(params, shared, task) ->
+    scalar`` additionally equips the spec with the adaptive (ASHA)
+    rung evaluator: params are shaped from the LIVE carry through the
+    family's ``score_params`` kernel (``solvers.carry_iterate``
+    contract — the current iterate is a valid model at every slice
+    boundary), then scored; the backend compiles it as a fourth jit
+    entry so carries never leave the device."""
     from ..models.linear import maybe_exact_matmuls
     from ..parallel import IterativeKernelSpec, compile_cache
 
@@ -295,15 +309,31 @@ def _iterative_fit_spec(est_cls, meta, static, n_slice, derive,
 
         def finalize(shared, task, carry):
             X, y, w, hyper, aux = derive(shared, task)
-            return f_fin(X, y, w, hyper, carry, aux)
+            params = f_fin(X, y, w, hyper, carry, aux)
+            if outputs is None:
+                return params
+            return outputs(params, shared, task)
 
-        return {"init": init, "step": step, "finalize": finalize,
-                "keys": ks["finalize_keys"]}
+        parts = {"init": init, "step": step, "finalize": finalize,
+                 "keys": ks["finalize_keys"]}
+        if rung_score is not None:
+            f_live = maybe_exact_matmuls(
+                est_cls, ks.get("score_params", ks["finalize"])
+            )
+
+            def score(shared, task, carry):
+                X, y, w, hyper, aux = derive(shared, task)
+                params = f_live(X, y, w, hyper, carry, aux)
+                return rung_score(params, shared, task)
+
+            parts["score"] = score
+        return parts
 
     parts = compile_cache.kernel_memo(("spec",) + tuple(key), build)
     return IterativeKernelSpec(
         parts["init"], parts["step"], parts["finalize"], parts["keys"],
         fallback=fallback_kernel, fallback_cache_key=fallback_key,
+        score=parts.get("score"),
     )
 
 

@@ -44,14 +44,23 @@ from ..metrics import (
     check_multimetric_scoring,
     default_device_scorer,
     device_scorer_compatible,
+    resolve_rung_scorer,
 )
 from ..parallel import (
+    RungController,
     faults,
     iterative_fit_supported,
     parse_partitions,
     prefers_host_engine,
     resolve_backend,
     row_sharded_specs,
+)
+from .adaptive import (
+    HalvingSpec,
+    RungKilledWarning,
+    check_adaptive,
+    rung_per_candidate,
+    warn_not_engaged,
 )
 from ..utils.validation import (
     check_error_score,
@@ -69,6 +78,8 @@ __all__ = [
     "DistGridSearchCV",
     "DistRandomizedSearchCV",
     "DistMultiModelSearch",
+    "HalvingSpec",
+    "RungKilledWarning",
 ]
 
 
@@ -231,6 +242,7 @@ def _checkpoint_signature(search, estimator, candidate_params, splits,
             else _canonical_value(v))
         for k, v in sorted(fit_params.items())
     )
+    adaptive = getattr(search, "adaptive", None)
     return faults.grid_signature(
         type(search).__name__,
         type(estimator).__module__ + "." + type(estimator).__qualname__,
@@ -238,13 +250,24 @@ def _checkpoint_signature(search, estimator, candidate_params, splits,
         tuple(_canonical_params(c) for c in candidate_params),
         len(splits), split_sig,
         _canonical_value(search.scoring), bool(search.return_train_score),
+        # adaptive config participates ONLY when set: a journal written
+        # by one halving race (its rows include rung-killed error_score
+        # rows) must not resume a search with a different eta/cadence/
+        # metric — and the candidate list above is the SAMPLED list for
+        # randomized search, so a same-random_state rerun resumes past
+        # completed rungs instead of resampling a new grid. adaptive=
+        # None contributes NO element, keeping exhaustive signatures
+        # byte-identical to the pre-adaptive release (an in-flight
+        # journal survives the upgrade).
+        *(() if adaptive is None else (_canonical_value(adaptive),)),
         faults.data_digest(X),
         faults.data_digest(y) if y is not None else "y=None",
         fp_sig,
     )
 
 
-def _quarantine_nonfinite(out_rows, error_score, context="search"):
+def _quarantine_nonfinite(out_rows, error_score, context="search",
+                          exempt=()):
     """The lane-quarantine guard over assembled batched-path score
     rows: a non-finite score can only mean a numerically diverged
     (poisoned) fit lane — the device kernels have no error path — so
@@ -252,12 +275,15 @@ def _quarantine_nonfinite(out_rows, error_score, context="search"):
     host fit: 'raise' raises, a numeric substitutes with a
     :class:`FitFailedWarning`. Runs host-side over already-gathered
     floats (no device work, no compiles); ``SKDIST_FAULT_GUARD=0``
-    disables."""
+    disables. ``exempt`` rows (adaptive rung kills — already mapped to
+    error_score by :func:`_apply_rung_retirement`, with their own
+    warning) are skipped: a killed lane must not be double-reported as
+    a diverged one, nor raise under ``error_score='raise'``."""
     if not faults.guard_enabled():
         return
     bad = []
     for i, row in enumerate(out_rows):
-        if row is None:
+        if row is None or i in exempt:
             continue
         for k, v in row.items():
             if k.startswith(("test_", "train_")) and not np.isfinite(v):
@@ -284,6 +310,40 @@ def _quarantine_nonfinite(out_rows, error_score, context="search"):
         for k in row:
             if k.startswith(("test_", "train_")):
                 row[k] = float(error_score)
+
+
+def _apply_rung_retirement(out_rows, killed, error_score,
+                           checkpoint=None, context="search"):
+    """Map adaptive-rung-killed lanes to sklearn-compatible rows: the
+    PR-5 ``error_score`` semantics (a numeric substitutes for every
+    test/train score) with ONE :class:`RungKilledWarning` naming the
+    count. ``error_score='raise'`` maps to NaN instead of raising — a
+    rung kill is a scheduling decision, not a failed fit, and raising
+    would make adaptive search unusable under the strict setting (the
+    NaN rows still rank last). With a ``checkpoint``, the MAPPED row is
+    re-journaled (last-write-wins on replay) tagged ``rung_killed`` so
+    a resumed search restores the kill, not the partial fit's raw
+    finalize scores."""
+    if not killed:
+        return
+    es = float("nan") if error_score == "raise" else float(error_score)
+    warnings.warn(
+        f"{len(killed)} of {len(out_rows)} batched {context} fits were "
+        f"retired early by adaptive successive halving; their scores "
+        f"are recorded as error_score={es!r} and the rung_ column "
+        "records where each candidate died.",
+        RungKilledWarning,
+    )
+    faults.record("lanes_rung_killed", len(killed))
+    for gid, rung in killed.items():
+        row = out_rows[gid]
+        if row is None:
+            continue
+        for k in row:
+            if k.startswith(("test_", "train_")):
+                row[k] = es
+        if checkpoint is not None:
+            checkpoint.record(gid, {**row, "rung_killed": float(rung)})
 
 
 # ---------------------------------------------------------------------------
@@ -405,15 +465,25 @@ def _cost_order(est_cls, task_hyper, split_ids):
 
 def _cv_iterative_spec(est_cls, meta, static, scorer_specs,
                        return_train_score, n_slice, fallback,
-                       fallback_key):
-    """Build (memoised) the iteration-sliced CV kernels and wrap them as
-    an :class:`~skdist_tpu.parallel.IterativeKernelSpec`: init/step
+                       fallback_key, rung_spec=None, mask_x=False):
+    """Build (memoised) the iteration-sliced CV kernels: init/step
     advance the estimator's sliced fit on the fold-masked weights;
     finalize shapes params from the carry and computes the same scorer
-    outputs as the classic fused kernel. Returns ``(spec, cache_key)``.
-    """
+    outputs as the classic fused kernel. Delegates to the shared
+    ``_iterative_fit_spec`` entry point (``distribute/multiclass.py``)
+    that OvR/OvO and the feature eliminator also build on. Returns
+    ``(spec, cache_key)``.
+
+    ``rung_spec`` (an ``(out_name, metric, kernel, kind)`` device
+    scorer tuple — see :func:`~skdist_tpu.metrics.resolve_rung_scorer`)
+    additionally equips the spec with the adaptive rung evaluator:
+    params shaped from the LIVE carry, scored on the held-out fold mask
+    — the quality signal ASHA kills on. ``mask_x=True`` multiplies the
+    shared X by a per-task ``task["fmask"]`` column mask everywhere
+    (fit, scoring, rung) — the feature eliminator's task axis."""
     from ..models.linear import _meta_signature, maybe_exact_matmuls
-    from ..parallel import IterativeKernelSpec, compile_cache, structural_key
+    from ..parallel import structural_key
+    from .multiclass import _iterative_fit_spec
 
     key = structural_key(
         "cv_iter", est_cls, static,
@@ -421,64 +491,67 @@ def _cv_iterative_spec(est_cls, meta, static, scorer_specs,
         bool(return_train_score),
         _meta_signature(meta),
         int(n_slice),
+        None if rung_spec is None else (rung_spec[1], rung_spec[3]),
+        bool(mask_x),
     )
 
-    def build():
-        ks = est_cls._build_fit_slice_kernels(meta, static, n_slice)
-        fit_init = maybe_exact_matmuls(est_cls, ks["init"])
-        fit_step = maybe_exact_matmuls(est_cls, ks["step"])
-        fit_fin = maybe_exact_matmuls(est_cls, ks["finalize"])
-        decision_kernel = maybe_exact_matmuls(
-            est_cls, est_cls._build_decision_kernel(meta, static)
+    decision_kernel = maybe_exact_matmuls(
+        est_cls, est_cls._build_decision_kernel(meta, static)
+    )
+    needs_proba = any(kind == "proba" for *_, kind in scorer_specs) or (
+        rung_spec is not None and rung_spec[3] == "proba"
+    )
+    proba_kernel = (
+        maybe_exact_matmuls(
+            est_cls, est_cls._build_proba_kernel(meta, static)
         )
-        needs_proba = any(kind == "proba" for *_, kind in scorer_specs)
-        proba_kernel = (
-            maybe_exact_matmuls(
-                est_cls, est_cls._build_proba_kernel(meta, static)
+        if needs_proba else None
+    )
+
+    def task_X(shared, task):
+        return shared["X"] * task["fmask"] if mask_x else shared["X"]
+
+    def derive(shared, task):
+        fit_w = shared["sw"] * shared["train_masks"][task["split"]]
+        return (task_X(shared, task), shared["y"], fit_w, task["hyper"],
+                shared["aux"])
+
+    def model_outputs(params, shared, task):
+        X = task_X(shared, task)
+        outputs = {"decision": decision_kernel(params, X)}
+        outputs["predict"] = outputs["decision"]
+        if proba_kernel is not None:
+            outputs["proba"] = proba_kernel(params, X)
+        return outputs
+
+    def outputs(params, shared, task):
+        om = model_outputs(params, shared, task)
+        y = shared["y"]
+        train_w = shared["train_masks"][task["split"]]
+        test_w = shared["test_masks"][task["split"]]
+        scores = {}
+        for out_name, _metric, score_kernel, kind in scorer_specs:
+            scores[f"test_{out_name}"] = score_kernel(
+                y, om[kind], test_w, meta
             )
-            if needs_proba else None
-        )
-
-        def fit_args(shared, task):
-            fit_w = shared["sw"] * shared["train_masks"][task["split"]]
-            return (shared["X"], shared["y"], fit_w, task["hyper"],
-                    shared["aux"])
-
-        def init(shared, task):
-            X, y, w, hyper, aux = fit_args(shared, task)
-            return fit_init(X, y, w, hyper, aux)
-
-        def step(shared, task, carry):
-            X, y, w, hyper, aux = fit_args(shared, task)
-            return fit_step(X, y, w, hyper, carry, aux)
-
-        def finalize(shared, task, carry):
-            X, y, w, hyper, aux = fit_args(shared, task)
-            params = fit_fin(X, y, w, hyper, carry, aux)
-            train_w = shared["train_masks"][task["split"]]
-            test_w = shared["test_masks"][task["split"]]
-            outputs = {"decision": decision_kernel(params, X)}
-            outputs["predict"] = outputs["decision"]
-            if proba_kernel is not None:
-                outputs["proba"] = proba_kernel(params, X)
-            scores = {}
-            for out_name, _metric, score_kernel, kind in scorer_specs:
-                scores[f"test_{out_name}"] = score_kernel(
-                    y, outputs[kind], test_w, meta
+            if return_train_score:
+                scores[f"train_{out_name}"] = score_kernel(
+                    y, om[kind], train_w, meta
                 )
-                if return_train_score:
-                    scores[f"train_{out_name}"] = score_kernel(
-                        y, outputs[kind], train_w, meta
-                    )
-            return scores
+        return scores
 
-        return {"init": init, "step": step, "finalize": finalize,
-                "keys": ks["finalize_keys"]}
+    rung_score = None
+    if rung_spec is not None:
+        _out, _metric, rung_kernel, rung_kind = rung_spec
 
-    parts = compile_cache.kernel_memo(key, build)
-    spec = IterativeKernelSpec(
-        parts["init"], parts["step"], parts["finalize"], parts["keys"],
-        fallback=fallback, fallback_cache_key=fallback_key,
+        def rung_score(params, shared, task):
+            om = model_outputs(params, shared, task)
+            test_w = shared["test_masks"][task["split"]]
+            return rung_kernel(shared["y"], om[rung_kind], test_w, meta)
+
+    spec = _iterative_fit_spec(
+        est_cls, meta, static, n_slice, derive, fallback, fallback_key,
+        key, outputs=outputs, rung_score=rung_score,
     )
     return spec, key
 
@@ -533,7 +606,8 @@ class DistBaseSearchCV(BaseEstimator):
 
     def __init__(self, estimator, backend=None, partitions="auto", cv=5,
                  scoring=None, refit=True, return_train_score=False,
-                 error_score=np.nan, n_jobs=None, preds=False, verbose=0):
+                 error_score=np.nan, n_jobs=None, preds=False, verbose=0,
+                 adaptive=None):
         self.estimator = estimator
         self.backend = backend
         self.partitions = partitions
@@ -545,6 +619,7 @@ class DistBaseSearchCV(BaseEstimator):
         self.n_jobs = n_jobs
         self.preds = preds
         self.verbose = verbose
+        self.adaptive = adaptive
 
     # subclasses supply the candidate enumeration
     def _get_param_iterator(self):
@@ -560,6 +635,11 @@ class DistBaseSearchCV(BaseEstimator):
         from sklearn.model_selection import check_cv
 
         check_error_score(self.error_score)
+        check_adaptive(self.adaptive)
+        # per-fit adaptive bookkeeping (consumed below, deleted before
+        # the artifact is finalized)
+        self._adaptive_engaged_ = False
+        self._rung_killed_gids_ = {}
         check_estimator_backend(self, self.verbose)
         backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
         estimator = self.estimator
@@ -598,9 +678,20 @@ class DistBaseSearchCV(BaseEstimator):
             if checkpoint is not None:
                 checkpoint.close()
 
+        if self.adaptive is not None and not self._adaptive_engaged_:
+            warn_not_engaged("the search")
+
         results = self._format_results(
             candidate_params, scorers, n_splits, out
         )
+        if self.adaptive is not None:
+            # rung_ column: rung at which each candidate died (-1 = ran
+            # to completion); killed candidates' scores carry
+            # error_score per _apply_rung_retirement
+            results["rung_"] = rung_per_candidate(
+                n_candidates, n_splits, self._rung_killed_gids_
+            )
+        del self._adaptive_engaged_, self._rung_killed_gids_
         self.cv_results_ = results
         self.scorer_ = scorers if multimetric else scorers["score"]
         self.n_splits_ = n_splits
@@ -693,7 +784,16 @@ class DistBaseSearchCV(BaseEstimator):
             for task in tasks:
                 row = checkpoint.completed.get(task[0])
                 if row is not None:
-                    out[task[0]] = dict(row)
+                    row = dict(row)
+                    # rows journaled as adaptive rung kills restore as
+                    # kills here too (a resumed search may downgrade to
+                    # this path); the tag must not leak into the score
+                    # rows — aggregate_score_dicts needs uniform keys
+                    rk = row.pop("rung_killed", None)
+                    if rk is not None and hasattr(
+                            self, "_rung_killed_gids_"):
+                        self._rung_killed_gids_[task[0]] = int(rk)
+                    out[task[0]] = row
                 else:
                     todo.append(task)
         else:
@@ -902,6 +1002,16 @@ class DistBaseSearchCV(BaseEstimator):
         out = [None] * n_tasks_total
         est_cls = type(estimator)
         hyper_names = list(getattr(est_cls, "_hyper_names", ()))
+        # adaptive (ASHA) bookkeeping: lanes killed by a rung in THIS
+        # fit vs kills restored from a resumed journal (already mapped
+        # to error_score when they were journaled)
+        adaptive = getattr(self, "adaptive", None)
+        killed_gids = {}
+        restored_killed = {}
+        any_dispatched = False
+        y_classes = (
+            np.unique(y) if adaptive is not None and y is not None else None
+        )
 
         for static_overrides, cand_indices in buckets.values():
             bucket_est = clone(estimator)
@@ -950,7 +1060,14 @@ class DistBaseSearchCV(BaseEstimator):
                     gid = cand_idx * n_splits + s
                     if (checkpoint is not None
                             and gid in checkpoint.completed):
-                        out[gid] = dict(checkpoint.completed[gid])
+                        row = dict(checkpoint.completed[gid])
+                        # a journaled rung kill restores AS a kill: the
+                        # row already carries its error_score values,
+                        # and the tag feeds the rung_ column
+                        rk = row.pop("rung_killed", None)
+                        if rk is not None:
+                            restored_killed[gid] = int(rk)
+                        out[gid] = row
                         continue
                     for name in hyper_names:
                         task_hyper[name].append(float(hyper_float(
@@ -960,6 +1077,7 @@ class DistBaseSearchCV(BaseEstimator):
                     gids.append(gid)
             if not gids:
                 continue  # whole bucket restored from the journal
+            any_dispatched = True
             gids = np.asarray(gids, dtype=np.int64)
             task_args = {
                 "hyper": {
@@ -995,10 +1113,27 @@ class DistBaseSearchCV(BaseEstimator):
                     }
                     inv = np.argsort(order)
                     disp_gids = gids[order]
+                # adaptive rung evaluator: resolve the rung metric to a
+                # device scorer (None → warn-and-exhaustive via the
+                # engaged flag in fit) and group each candidate's fold
+                # lanes so they live and die together
+                rung_ctrl = None
+                rung_spec = None
+                if adaptive is not None:
+                    rung_spec = resolve_rung_scorer(
+                        adaptive.metric, scorer_specs, self.refit,
+                        y_classes, est_cls=est_cls,
+                    )
+                    if rung_spec is not None:
+                        rung_ctrl = RungController(
+                            adaptive.eta, adaptive.min_slices,
+                            groups=disp_gids // n_splits,
+                        )
                 spec, iter_key = _cv_iterative_spec(
                     est_cls, meta, static, scorer_specs,
                     self.return_train_score, n_slice,
                     fallback=kernel, fallback_key=kernel_key,
+                    rung_spec=rung_spec,
                 )
                 round_size = (
                     None if self.partitions in ("auto", None)
@@ -1008,8 +1143,24 @@ class DistBaseSearchCV(BaseEstimator):
                     spec, task_args, shared, round_size=round_size,
                     shared_specs=specs, return_timings=True,
                     cache_key=iter_key,
-                    on_round=self._round_journal(checkpoint, disp_gids),
+                    on_round=self._round_journal(
+                        checkpoint, disp_gids, rung_ctrl=rung_ctrl
+                    ),
+                    rung=rung_ctrl,
                 )
+                if rung_ctrl is not None:
+                    # engaged only if the compacted slice loop actually
+                    # ran the rungs — a backend downgrade (multi-process
+                    # mesh, OOM/fault fallback) deactivates the
+                    # controller, and fit's could-not-engage warning
+                    # must fire for it
+                    if rung_ctrl.active:
+                        self._adaptive_engaged_ = True
+                    # controller ids are dispatch-order task-axis
+                    # indices; disp_gids maps them back to global
+                    # (candidate x fold) ids
+                    for disp_idx, r in rung_ctrl.killed.items():
+                        killed_gids[int(disp_gids[disp_idx])] = int(r)
             else:
                 round_size = parse_partitions(self.partitions, n_bucket)
                 scores, round_timings = backend.batched_map(
@@ -1040,20 +1191,41 @@ class DistBaseSearchCV(BaseEstimator):
                 out[gid] = {k: float(v[t]) for k, v in scores.items()}
                 out[gid]["fit_time"] = float(per_task_time[t])
                 out[gid]["score_time"] = 0.0
-        # lane quarantine: non-finite scores (diverged lanes — fresh or
-        # journal-restored) map to error_score semantics, matching what
-        # the host path records for a failed fit
-        _quarantine_nonfinite(out, self.error_score)
+        # adaptive rung kills map to error_score rows (one warning, the
+        # rung recorded for the rung_ column and re-journaled so a
+        # resume restores the kill); the lane quarantine then handles
+        # genuinely diverged lanes, skipping the killed rows so they
+        # are neither double-reported nor raised on
+        _apply_rung_retirement(
+            out, killed_gids, self.error_score, checkpoint=checkpoint
+        )
+        if adaptive is not None and not any_dispatched:
+            # every task restored from the journal: the resumed results
+            # ARE the journaled adaptive race — nothing fell back, so
+            # the could-not-engage warning must not fire
+            self._adaptive_engaged_ = True
+        self._rung_killed_gids_ = {**restored_killed, **killed_gids}
+        _quarantine_nonfinite(
+            out, self.error_score, exempt=set(self._rung_killed_gids_)
+        )
         return out
 
     @staticmethod
-    def _round_journal(checkpoint, disp_gids):
+    def _round_journal(checkpoint, disp_gids, rung_ctrl=None):
         """``on_round`` callback journaling each gathered round's score
         rows under their global task ids (``disp_gids`` is in DISPATCH
         order — the cost permutation, when active). Times are journaled
         as 0.0: per-round walls are only attributable after the whole
         call, and a resumed task's fit cost was paid by the killed
         process anyway. None checkpoint → no callback (zero overhead).
+
+        Rung-killed lanes are SKIPPED here: their finalize rows carry a
+        half-trained carry's raw scores, and journaling those would let
+        a crash before :func:`_apply_rung_retirement`'s corrective
+        ``rung_killed``-tagged record resume them as legitimately
+        completed rows (the kill map is final by the time the finalize
+        phase — the only phase that fires ``on_round`` on the compacted
+        path — gathers). An unjournaled kill simply re-runs on resume.
         """
         if checkpoint is None:
             return None
@@ -1062,6 +1234,8 @@ class DistBaseSearchCV(BaseEstimator):
             keys = list(round_out)
             n = len(np.asarray(round_out[keys[0]]))
             for i in range(n):
+                if rung_ctrl is not None and (start + i) in rung_ctrl.killed:
+                    continue
                 row = {k: float(np.asarray(round_out[k])[i]) for k in keys}
                 row["fit_time"] = 0.0
                 row["score_time"] = 0.0
@@ -1204,12 +1378,13 @@ class DistGridSearchCV(DistBaseSearchCV):
 
     def __init__(self, estimator, param_grid, backend=None, partitions="auto",
                  cv=5, scoring=None, refit=True, return_train_score=False,
-                 error_score=np.nan, n_jobs=None, preds=False, verbose=0):
+                 error_score=np.nan, n_jobs=None, preds=False, verbose=0,
+                 adaptive=None):
         super().__init__(
             estimator, backend=backend, partitions=partitions, cv=cv,
             scoring=scoring, refit=refit,
             return_train_score=return_train_score, error_score=error_score,
-            n_jobs=n_jobs, preds=preds, verbose=verbose,
+            n_jobs=n_jobs, preds=preds, verbose=verbose, adaptive=adaptive,
         )
         self.param_grid = param_grid
 
@@ -1226,12 +1401,13 @@ class DistRandomizedSearchCV(DistBaseSearchCV):
     def __init__(self, estimator, param_distributions, backend=None,
                  partitions="auto", n_iter=10, random_state=None, cv=5,
                  scoring=None, refit=True, return_train_score=False,
-                 error_score=np.nan, n_jobs=None, preds=False, verbose=0):
+                 error_score=np.nan, n_jobs=None, preds=False, verbose=0,
+                 adaptive=None):
         super().__init__(
             estimator, backend=backend, partitions=partitions, cv=cv,
             scoring=scoring, refit=refit,
             return_train_score=return_train_score, error_score=error_score,
-            n_jobs=n_jobs, preds=preds, verbose=verbose,
+            n_jobs=n_jobs, preds=preds, verbose=verbose, adaptive=adaptive,
         )
         self.param_distributions = param_distributions
         self.n_iter = n_iter
@@ -1320,7 +1496,7 @@ class DistMultiModelSearch(BaseEstimator):
 
     def __init__(self, models, backend=None, partitions="auto", n=5, cv=5,
                  scoring=None, random_state=None, verbose=0, refit=True,
-                 n_jobs=None):
+                 n_jobs=None, adaptive=None):
         self.models = models
         self.backend = backend
         self.partitions = partitions
@@ -1331,10 +1507,12 @@ class DistMultiModelSearch(BaseEstimator):
         self.verbose = verbose
         self.refit = refit
         self.n_jobs = n_jobs
+        self.adaptive = adaptive
 
     def fit(self, X, y=None, groups=None, **fit_params):
         from sklearn.model_selection import check_cv
 
+        check_adaptive(self.adaptive)
         check_estimator_backend(self, self.verbose)
         backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
         models = _validate_models(self.models)
@@ -1353,6 +1531,7 @@ class DistMultiModelSearch(BaseEstimator):
         # _format_results (per-split columns, mean/std, fit/score
         # times, masked param arrays)
         per_model = []
+        adaptive_engaged = False
         for index, (name, estimator, _dists) in enumerate(models):
             cands = [p["param_set"] for p in param_sets
                      if p["model_index"] == index]
@@ -1365,19 +1544,32 @@ class DistMultiModelSearch(BaseEstimator):
                 raise ValueError(
                     "DistMultiModelSearch supports single-metric scoring"
                 )
+            # each model family races its own rungs (candidate sets of
+            # different families are not score-comparable mid-solve);
+            # the shim rides the exact grid-search scheduler, adaptive
+            # included
             shim = DistBaseSearchCV(
                 estimator, partitions=self.partitions, cv=self.cv,
                 scoring=self.scoring, error_score=np.nan,
                 n_jobs=self.n_jobs, verbose=self.verbose,
+                adaptive=self.adaptive,
             )
             out = shim._run_search_tasks(
                 backend, estimator, X, y, cands, splits, scorers, fit_params
             )
-            per_model.append((
-                index, name, cands,
-                shim._format_results(cands, scorers, n_splits, out),
-            ))
+            full = shim._format_results(cands, scorers, n_splits, out)
+            if self.adaptive is not None:
+                full["rung_"] = rung_per_candidate(
+                    len(cands), n_splits,
+                    getattr(shim, "_rung_killed_gids_", {}),
+                )
+                adaptive_engaged |= getattr(
+                    shim, "_adaptive_engaged_", False
+                )
+            per_model.append((index, name, cands, full))
 
+        if self.adaptive is not None and not adaptive_engaged:
+            warn_not_engaged("the multi-model search")
         results = self._merge_model_results(per_model, n_splits)
         score_vals = np.asarray(results["mean_test_score"], dtype=float)
         if score_vals.size == 0 or np.all(np.isnan(score_vals)):
@@ -1464,6 +1656,14 @@ class DistMultiModelSearch(BaseEstimator):
         results["params"] = params_list
         results["model_name"] = names
         results["model_index"] = model_idx
+        if any("rung_" in full for _, _, _, full in per_model):
+            results["rung_"] = np.concatenate([
+                np.asarray(
+                    full.get("rung_", np.full(len(cands), -1, np.int32)),
+                    dtype=np.int32,
+                )
+                for _, _, cands, full in per_model
+            ])
         # method="min" for sklearn-style integer ranks on ties (the base
         # search already did this; reference search.py:481-484)
         results["rank_test_score"] = np.asarray(

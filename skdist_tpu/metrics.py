@@ -223,6 +223,63 @@ def default_device_scorer(estimator):
     return "accuracy" if kind == "classifier" else "r2"
 
 
+def resolve_rung_scorer(metric, scorer_specs, refit, classes=None,
+                        est_cls=None):
+    """Resolve a ``HalvingSpec.metric`` to the device scorer spec the
+    ASHA rung evaluator compiles, or None when no device kernel can
+    serve it (the caller then warns and runs exhaustively — rung
+    decisions NEVER gather per-rung predictions for a host scorer).
+
+    ``'auto'`` follows the search's refit metric: the spec whose output
+    name matches ``refit`` among the already-resolved ``scorer_specs``
+    (single-metric searches carry one spec named 'score'). An explicit
+    metric name must have a ``DEVICE_SCORERS`` kernel whose semantics
+    hold for this label set (the same ``device_scorer_compatible``
+    guard the CV scoring path applies) AND whose output kind the
+    estimator family can produce — a proba rung metric on a family
+    without a proba kernel (e.g. ``neg_log_loss`` on LinearSVC) must
+    fall back, not crash mid-dispatch. Returns an
+    ``(out_name, metric, kernel, kind)`` tuple like
+    ``_resolve_device_scoring``'s entries, under the ``'rung'`` output
+    name for explicit metrics.
+    """
+    def producible(spec):
+        if spec is None or spec[3] != "proba" or est_cls is None:
+            return spec
+        if not hasattr(est_cls, "_build_proba_kernel"):
+            return None
+        return spec
+
+    if metric in (None, "auto"):
+        if not scorer_specs:
+            return None
+        want = refit if isinstance(refit, str) else "score"
+        for spec in scorer_specs:
+            if spec[0] == want:
+                return producible(spec)
+        # multimetric without a refit metric ('auto' has nothing to
+        # follow): kills would rank by whichever scoring entry resolved
+        # first — say so, and name the explicit escape hatch
+        if len(scorer_specs) > 1:
+            import warnings
+
+            warnings.warn(
+                "HalvingSpec(metric='auto') with multimetric scoring "
+                f"and refit={refit!r}: rung kills will rank candidates "
+                f"by {scorer_specs[0][1]!r} (the first resolved scoring "
+                "entry). Pass HalvingSpec(metric=...) to choose the "
+                "metric adaptive halving eliminates by.",
+                UserWarning,
+            )
+        return producible(scorer_specs[0])
+    if metric not in DEVICE_SCORERS:
+        return None
+    if not device_scorer_compatible(metric, classes):
+        return None
+    kernel, kind = DEVICE_SCORERS[metric]
+    return producible(("rung", metric, kernel, kind))
+
+
 # ---------------------------------------------------------------------------
 # host scorer resolution (generic path), sklearn-backed
 # ---------------------------------------------------------------------------

@@ -36,6 +36,7 @@ from ..sparse import (
     would_pack,
 )
 from .solvers import (
+    carry_iterate,
     lbfgs_carry_init,
     lbfgs_minimize,
     lbfgs_resume,
@@ -595,13 +596,19 @@ class _LbfgsFitMixin:
 
         def finalize(X, y_idx, sw, hyper, carry, aux=None):
             _, _, unpack = problem(X, y_idx, sw, hyper)
-            return unpack(carry["w"], carry["it"])
+            return unpack(carry_iterate(carry), carry["it"])
 
         return {
             "init": init, "step": step, "finalize": finalize,
             # finalize touches only these carry leaves: retired lanes'
             # S/Y/rho history never needs to leave the device
             "finalize_keys": ("w", "it"),
+            # score-from-carry: the current iterate is a valid model at
+            # every slice boundary (solvers.carry_iterate), so the ASHA
+            # rung evaluator shapes params from a LIVE carry with the
+            # same unpack the finalize uses — scoring never perturbs
+            # the trajectory, it only reads it
+            "score_params": finalize,
         }
 
 
@@ -1261,11 +1268,15 @@ class SGDClassifier(_LinearClassifierBase):
 
         def finalize(X, y_idx, sw, hyper, carry, aux=None):
             pb = problem(X, y_idx, sw, hyper)
-            return pb["unpack"](carry["w"], carry["n_done"])
+            return pb["unpack"](carry_iterate(carry), carry["n_done"])
 
         return {
             "init": init, "step": step, "finalize": finalize,
             "finalize_keys": ("w", "n_done"),
+            # live-carry params for the ASHA rung evaluator (epoch
+            # boundaries leave frozen/stopped lanes' weights intact, so
+            # the iterate is always a scoreable model)
+            "score_params": finalize,
         }
 
     _build_decision_kernel = LogisticRegression._build_decision_kernel

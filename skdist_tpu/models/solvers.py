@@ -28,7 +28,10 @@ still-running lanes, and re-dispatch. ``lbfgs_minimize`` /
 resume, which is what makes a sliced run *bitwise identical* to the
 unsliced solve: both apply the same traced body the same number of
 times to the same carried state — slicing only changes where the host
-observes the carry.
+observes the carry. The carries are also *scoreable* mid-solve: the
+current iterate (:func:`carry_iterate`) is a valid model at every slice
+boundary, which is what lets the adaptive (ASHA) scheduler evaluate
+live lanes on the validation fold without touching the trajectory.
 
 Data-representation agnosticism: neither solver ever touches X — the
 heavy contractions live in the caller's loss/grad closures, built over
@@ -48,6 +51,21 @@ _EPS = 1e-12
 
 #: order of the L-BFGS carry leaves (the ISSUE-pinned pytree contract)
 LBFGS_CARRY_KEYS = ("w", "f", "g", "S", "Y", "rho", "k", "it", "done")
+
+
+def carry_iterate(carry):
+    """Current weight iterate of a solver carry — the leaf the adaptive
+    (ASHA) rung evaluator scores MID-SOLVE.
+
+    Both solver families keep the live iterate under ``"w"`` and keep
+    it valid at every slice boundary: L-BFGS writes ``w`` only after an
+    accepted (or stalled-in-place) line-search step, and the SGD epoch
+    body freezes stopped lanes' weights in place — so ``carry["w"]`` is
+    always a usable model, never a half-updated scratch buffer. The
+    score-from-carry kernels (``models/linear.py``
+    ``_build_fit_slice_kernels[...]["score_params"]``) read it through
+    this helper so the contract has one name."""
+    return carry["w"]
 
 
 def _lbfgs_body(fun, value_and_grad, max_iter, tol, history, max_ls):

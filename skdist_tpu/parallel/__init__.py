@@ -11,6 +11,7 @@ from .backend import (
     IterativeKernelSpec,
     IterativePlan,
     LocalBackend,
+    RungController,
     TPUBackend,
     TaskBackend,
     compaction_enabled,
@@ -33,6 +34,7 @@ __all__ = [
     "BatchedPlan",
     "IterativeKernelSpec",
     "IterativePlan",
+    "RungController",
     "resolve_backend",
     "parse_partitions",
     "prefers_host_engine",

@@ -155,15 +155,20 @@ class TaskBackend:
     def batched_map_iterative(self, spec, task_args, shared_args=(),
                               static_args=None, round_size=None,
                               shared_specs=None, return_timings=False,
-                              cache_key=None, on_round=None):
+                              cache_key=None, on_round=None, rung=None):
         """Convergence-compacted execution of an iterative kernel (see
         :class:`IterativeKernelSpec`). Backends without the slice loop
-        run the spec's fallback kernel through :meth:`batched_map`."""
+        run the spec's fallback kernel through :meth:`batched_map` —
+        the fallback is EXHAUSTIVE, so an adaptive ``rung`` controller
+        is reset (its ``killed`` map must stay empty: every lane runs
+        to completion here)."""
         if spec.fallback is None:
             raise NotImplementedError(
                 f"{type(self).__name__} has no iterative slice loop and "
                 "the spec carries no fallback kernel"
             )
+        if rung is not None:
+            rung.deactivate()
         return self.batched_map(
             spec.fallback, task_args, shared_args,
             static_args=static_args, round_size=round_size,
@@ -225,6 +230,16 @@ class IterativeKernelSpec:
       are consumed — retired lanes' remaining solver state (e.g. the
       L-BFGS S/Y history) never needs to leave the device.
 
+    ``score(shared, task, carry) -> scalar`` is the OPTIONAL rung
+    evaluator of the adaptive (ASHA) scheduler: a quality readout of a
+    LIVE carry (typically: shape params from the current iterate, score
+    the held-out fold). It is compiled as a fourth jit entry next to
+    init/step/finalize — carries never leave the device; only the
+    ``(n_lanes,)`` score vector is gathered, riding the same flags-only
+    D2H path as the done flags. It must be a pure function of its
+    inputs (it runs zero or more times per slice depending on the rung
+    cadence, and never between a step and the carry it produced).
+
     ``fallback`` is the classic all-iterations kernel with the same
     outputs (and ``fallback_cache_key`` its compile-cache key): the
     scheduler downgrades to a plain :meth:`TaskBackend.batched_map` of
@@ -234,10 +249,11 @@ class IterativeKernelSpec:
     """
 
     __slots__ = ("init", "step", "finalize", "finalize_keys", "done_key",
-                 "fallback", "fallback_cache_key")
+                 "fallback", "fallback_cache_key", "score")
 
     def __init__(self, init, step, finalize, finalize_keys,
-                 done_key="done", fallback=None, fallback_cache_key=None):
+                 done_key="done", fallback=None, fallback_cache_key=None,
+                 score=None):
         self.init = init
         self.step = step
         self.finalize = finalize
@@ -245,6 +261,7 @@ class IterativeKernelSpec:
         self.done_key = done_key
         self.fallback = fallback
         self.fallback_cache_key = fallback_cache_key
+        self.score = score
 
 
 class IterativePlan:
@@ -254,14 +271,15 @@ class IterativePlan:
     by ``prepare_batched_iterative`` and driven by the compacted round
     loop (:func:`_run_compacted`)."""
 
-    __slots__ = ("init_fn", "step_fn", "fin_fn", "shared", "put",
-                 "n_task_slots", "_shared_sig")
+    __slots__ = ("init_fn", "step_fn", "fin_fn", "score_fn", "shared",
+                 "put", "n_task_slots", "_shared_sig")
 
-    def __init__(self, init_fn, step_fn, fin_fn, shared, put,
+    def __init__(self, init_fn, step_fn, fin_fn, score_fn, shared, put,
                  n_task_slots=1):
         self.init_fn = init_fn
         self.step_fn = step_fn
         self.fin_fn = fin_fn
+        self.score_fn = score_fn  # None unless the spec carries a rung
         self.shared = shared
         self.put = put
         self.n_task_slots = n_task_slots
@@ -270,11 +288,12 @@ class IterativePlan:
 
 def _iterative_jit_entries(spec, static_args, task_sharding,
                            shared_shardings, cache_key):
-    """The three memoised jit entries of an iterative kernel. The step
-    and finalize kernels see ``{"task": ..., "carry": ...}`` as their
-    task tree so the whole existing task-axis machinery (vmap, task
-    sharding, AOT-per-chunk memo) applies unchanged; the carry rides
-    the task axis like any other per-task leaf.
+    """The memoised jit entries of an iterative kernel (three, plus a
+    fourth rung-score entry when the spec carries one). The step,
+    finalize and score kernels see ``{"task": ..., "carry": ...}`` as
+    their task tree so the whole existing task-axis machinery (vmap,
+    task sharding, AOT-per-chunk memo) applies unchanged; the carry
+    rides the task axis like any other per-task leaf.
 
     Donation is deliberately OFF for these entries: the slice loop
     feeds each step's output carry back as the next step's input while
@@ -298,6 +317,14 @@ def _iterative_jit_entries(spec, static_args, task_sharding,
     def key(part):
         return ("iter", part, cache_key) if cache_key is not None else None
 
+    if spec.score is not None:
+        def score_kernel(shared, tc):
+            return spec.score(shared, tc["task"], tc["carry"])
+
+        score_fn = _jit_vmapped(score_kernel, static_args, task_sharding,
+                                shared_shardings, key("score"), False)
+    else:
+        score_fn = None
     return (
         _jit_vmapped(init_kernel, static_args, task_sharding,
                      shared_shardings, key("init"), False),
@@ -305,7 +332,118 @@ def _iterative_jit_entries(spec, static_args, task_sharding,
                      shared_shardings, key("step"), False),
         _jit_vmapped(fin_kernel, static_args, task_sharding,
                      shared_shardings, key("fin"), False),
+        score_fn,
     )
+
+
+class RungController:
+    """Host-side ASHA rung policy for the compacted slice loop
+    (asynchronous successive halving — Li et al., MLSys 2020).
+
+    Every ``every`` slices the scheduler scores all LIVE carries with
+    the spec's rung-score kernel and hands the ``(lane_id, score)``
+    pairs to :meth:`decide`, which kills the bottom ``1 - 1/eta``
+    *groups* (a group is typically one candidate's CV-fold lanes, so a
+    candidate's folds live and die together — ``groups=None`` makes
+    every lane its own group). Killed lanes are marked done and retire
+    through the ordinary done-flag/compaction path, so freed rounds
+    collapse immediately.
+
+    Scores are GREATER-IS-BETTER (the device scorers' convention; the
+    ``neg_*`` regression metrics are already negated). Non-finite
+    scores rank below every finite score — a diverged lane is the
+    first thing a rung eliminates. ``eta=inf`` scores every rung but
+    never kills (the parity-pinned "observe only" mode). Ties break
+    deterministically toward the smaller group id.
+
+    The controller is single-use per *attempt*: the fault-retry loop
+    calls :meth:`reset` before re-running (carries restart from
+    scratch, so rung history must too), and the classic-fallback path
+    resets it as well — a downgraded dispatch is exhaustive, and a
+    stale ``killed`` map would wrongly error-score lanes that ran to
+    completion.
+    """
+
+    def __init__(self, eta=3.0, every=1, groups=None):
+        eta = float(eta)
+        if not eta > 1.0:
+            raise ValueError(f"rung eta must be > 1 (got {eta!r})")
+        every = int(every)
+        if every < 1:
+            raise ValueError(f"rung cadence must be >= 1 (got {every!r})")
+        self.eta = eta
+        self.every = every
+        self.groups = None if groups is None else np.asarray(groups)
+        #: lane id -> rung index at which the lane was killed
+        self.killed = {}
+        #: per-rung observability: {"rung", "slice", "n_live",
+        #: "n_groups", "n_killed"} (lane counts)
+        self.history = []
+        #: False once a backend downgrade ran the exhaustive fallback —
+        #: the caller's "adaptive engaged" signal (a retry-loop reset
+        #: keeps it True: the re-attempt still races rungs)
+        self.active = True
+
+    def reset(self):
+        self.killed = {}
+        self.history = []
+
+    def deactivate(self):
+        """A downgrade to exhaustive execution: clear every verdict AND
+        mark the controller inactive so the caller warns instead of
+        silently reporting an adaptive race that never ran."""
+        self.reset()
+        self.active = False
+
+    def due(self, slice_idx):
+        """Whether a rung fires after slice ``slice_idx`` (1-based)."""
+        return slice_idx % self.every == 0
+
+    def decide(self, live_ids, scores, slice_idx):
+        """One rung: given the live lanes' ids and rung scores, pick the
+        lanes to kill. Returns the killed lane ids (possibly empty) and
+        records them in :attr:`killed` / :attr:`history`."""
+        live_ids = np.asarray(live_ids)
+        scores = np.asarray(scores, dtype=np.float64)
+        rung = len(self.history)
+        gids = (
+            self.groups[live_ids] if self.groups is not None else live_ids
+        )
+        uniq, inv = np.unique(gids, return_inverse=True)
+        n_groups = len(uniq)
+        entry = {
+            "rung": rung, "slice": int(slice_idx),
+            "n_live": int(live_ids.size), "n_groups": int(n_groups),
+            "n_killed": 0,
+        }
+        self.history.append(entry)
+        if live_ids.size == 0 or not math.isfinite(self.eta):
+            return live_ids[:0]
+        # group score = mean over the group's live lanes; non-finite
+        # lanes drag their group to -inf (kill divergence first)
+        s = np.where(np.isfinite(scores), scores, -np.inf)
+        gsum = np.zeros(n_groups)
+        gcnt = np.zeros(n_groups)
+        np.add.at(gsum, inv, s)
+        np.add.at(gcnt, inv, 1.0)
+        with np.errstate(invalid="ignore"):
+            gmean = gsum / gcnt
+        gmean = np.where(np.isfinite(gmean), gmean, -np.inf)
+        # ceil(n_groups / eta) in float: eta is any real > 1 (a
+        # truncating int(eta) would make eta in (1, 2) keep everything)
+        n_keep = max(1, int(math.ceil(n_groups / self.eta)))
+        if n_keep >= n_groups:
+            return live_ids[:0]
+        # deterministic: sort by (-score, group id) — lexsort, last key
+        # primary — and kill everything past the keep set
+        order = np.lexsort((uniq, -gmean))
+        killed_groups = uniq[order[n_keep:]]
+        kill_mask = np.isin(gids, killed_groups)
+        killed_ids = live_ids[kill_mask]
+        for lid in killed_ids:
+            self.killed[int(lid)] = rung
+        entry["n_killed"] = int(killed_ids.size)
+        return killed_ids
 
 
 #: smallest task set the convergence-compacted path engages for — below
@@ -443,7 +581,7 @@ class LocalBackend(TaskBackend):
     def batched_map_iterative(self, spec, task_args, shared_args=(),
                               static_args=None, round_size=None,
                               shared_specs=None, return_timings=False,
-                              cache_key=None, on_round=None):
+                              cache_key=None, on_round=None, rung=None):
         """Convergence-compacted execution on the host device: same
         slice/compact/finalize loop as the mesh backend, single task
         slot."""
@@ -458,7 +596,7 @@ class LocalBackend(TaskBackend):
         return _dispatch_iterative(
             self, plan, spec, task_args, shared_args, static_args,
             shared_specs, n_tasks, chunk, return_timings, cache_key,
-            on_round=on_round,
+            on_round=on_round, rung=rung,
         )
 
     def batched_map(self, kernel, task_args, shared_args=(), static_args=None,
@@ -716,14 +854,17 @@ class TPUBackend(TaskBackend):
     def batched_map_iterative(self, spec, task_args, shared_args=(),
                               static_args=None, round_size=None,
                               shared_specs=None, return_timings=False,
-                              cache_key=None, on_round=None):
+                              cache_key=None, on_round=None, rung=None):
         """Convergence-compacted execution over the mesh: slice the
         solvers, gather per-lane done flags (flags-only D2H), compact
         survivors into fewer slot-aligned rounds, finalize in original
-        task order. Multi-process meshes take the spec's classic
-        fallback kernel through :meth:`batched_map` — the per-slice
-        host compaction decisions would otherwise need cross-process
-        agreement at every slice."""
+        task order. An adaptive ``rung`` controller additionally
+        scores live carries every K slices and kills the losers
+        through the same done-flag path. Multi-process meshes take the
+        spec's classic fallback kernel through :meth:`batched_map` —
+        the per-slice host compaction decisions would otherwise need
+        cross-process agreement at every slice (and the fallback is
+        exhaustive: the rung is reset, never applied)."""
         n_tasks = _leading_dim(task_args)
         d = self.n_devices
         if self._spans_processes():
@@ -731,7 +872,7 @@ class TPUBackend(TaskBackend):
                 self, spec, task_args, shared_args,
                 static_args=static_args, round_size=round_size,
                 shared_specs=shared_specs, return_timings=return_timings,
-                cache_key=cache_key, on_round=on_round,
+                cache_key=cache_key, on_round=on_round, rung=rung,
             )
         if round_size:
             chunk = int(math.ceil(min(n_tasks, round_size) / d) * d)
@@ -743,7 +884,7 @@ class TPUBackend(TaskBackend):
         return _dispatch_iterative(
             self, plan, spec, task_args, shared_args, static_args,
             shared_specs, n_tasks, chunk, return_timings, cache_key,
-            on_round=on_round,
+            on_round=on_round, rung=rung,
         )
 
     def _mesh_min_int(self, value):
@@ -1506,7 +1647,8 @@ def _pad_tail(tree, pad):
 
 def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
                         static_args, shared_specs, n_tasks, chunk,
-                        return_timings, cache_key, on_round=None):
+                        return_timings, cache_key, on_round=None,
+                        rung=None):
     """Run the compacted loop with two safety nets. A
     RESOURCE_EXHAUSTED anywhere (a compacted round's carries do not fit,
     or the finalize pass trips the round loop's OOM machinery) downgrades
@@ -1525,9 +1667,15 @@ def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
     retry = _RetryState()
     while True:
         try:
+            if rung is not None:
+                # a retried attempt restarts the carries from scratch:
+                # the rung history (and any kills decided against the
+                # aborted trajectory) must restart with them
+                rung.reset()
             out = _run_compacted(
                 plan, spec, task_args, n_tasks, chunk, stats,
                 pipeline=not backend.sync_rounds, on_round=on_round,
+                rung=rung,
             )
             stats["retries"] = retry.total
             break
@@ -1579,6 +1727,12 @@ def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
                 )
             else:
                 raise
+            if rung is not None:
+                # the classic fallback runs every lane to completion;
+                # kills decided against the aborted compacted attempt
+                # must not error-score lanes that will now finish — and
+                # the caller must learn no adaptive race happened
+                rung.deactivate()
             return backend.batched_map(
                 spec.fallback, task_args, shared_args,
                 static_args=static_args, round_size=chunk,
@@ -1609,7 +1763,7 @@ def _flags_only_gather(leaf):
 
 
 def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
-                   pipeline=True, on_round=None):
+                   pipeline=True, on_round=None, rung=None):
     """The convergence-compacted slice loop.
 
     Phase 1 (iterate): partition the task axis into chunk-shaped rounds
@@ -1620,6 +1774,16 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
     COMPACT the still-running lanes into fewer dense rounds (the one
     point where surviving carries cross the host). Retired lanes store
     only their ``finalize_keys`` carry leaves.
+
+    With an adaptive ``rung`` controller (and a spec that carries a
+    rung-score kernel), every ``rung.every`` slices the live rounds'
+    carries are additionally scored ON DEVICE by the fourth jit entry
+    — one ``(chunk,)`` score vector per round is the only extra D2H —
+    and the controller's losers are marked done, so they retire
+    through the very same done-flag/compaction path as converged
+    lanes. Killed lanes still flow through phase 2 (their finalize
+    outputs are real, just early); the CALLER maps them to its
+    error-score semantics using the controller's ``killed`` record.
 
     Phase 2 (finalize): run the finalize program over ALL tasks in
     original order through the ordinary round loop — outputs come back
@@ -1653,6 +1817,10 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
     init_exec = make_exec(plan.init_fn)
     step_exec = make_exec(plan.step_fn)
     fin_exec = make_exec(plan.fin_fn)
+    score_exec = (
+        make_exec(plan.score_fn)
+        if rung is not None and plan.score_fn is not None else None
+    )
 
     rounds = []
     for start in range(0, n_tasks, chunk):
@@ -1666,11 +1834,30 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
         "mode": "compacted", "chunk": int(chunk), "slices": 0,
         "compactions": 0, "rounds_per_slice": [], "retired_per_slice": [],
         "dispatch_s": 0.0, "flags_wait_s": 0.0,
+        # retirement-reason split (satellite observability): totals by
+        # cause plus the per-rung kill histogram the smoke asserts
+        "retired_rung": 0, "retired_convergence": 0, "rung_history": [],
+        "rung_wait_s": 0.0,
     })
 
     # per-task store of the finalize-subset carry leaves, filled as
     # lanes retire; allocated lazily from the first retired leaf
     fin_store = {}
+
+    # rung kills are a HOST-side verdict: the device carry's done leaf
+    # knows nothing about them, so every fresh flags gather would
+    # resurrect a killed lane. The kill mask persists across slices and
+    # is OR-ed into each round's host flags right after every gather.
+    killed_mask = np.zeros(n_tasks, dtype=bool) if rung is not None else None
+
+    def apply_kills():
+        for r in rounds:
+            keep = len(r.idx)
+            m = killed_mask[r.idx]
+            if m.any():
+                done = np.asarray(r.done).astype(bool)
+                done[:keep][m] = True
+                r.done = done
 
     def retire(idx_arr, subset):
         for key in spec.finalize_keys:
@@ -1727,6 +1914,42 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
                 flags_pop()
         while pending:
             flags_pop()
+        if killed_mask is not None and killed_mask.any():
+            apply_kills()
+
+        if score_exec is not None and rung.due(stats["slices"]):
+            # ASHA rung: score every live lane's carry on device (the
+            # score program reads the same device-resident task/carry
+            # buffers the step program produced — no H2D at all) and
+            # gather one (chunk,) f32 vector per round next to the
+            # flags. The controller's losers are marked done HERE, on
+            # the host copy of the flags, so the retire/compaction
+            # logic below treats a rung kill exactly like convergence.
+            t_r = time.perf_counter()
+            scored = [
+                (r, score_exec({"task": r.dev_task, "carry": r.dev_carry}))
+                # an all-done round has no lane a rung could judge:
+                # scoring it would be a full discarded execution
+                for r in rounds if not r.done[:len(r.idx)].astype(bool).all()
+            ]
+            for _r, dev_s in scored:
+                _start_host_copy(dev_s)
+            live_ids = [np.empty(0, dtype=np.int64)]
+            live_scores = [np.empty(0)]
+            for r, dev_s in scored:
+                s = _flags_only_gather(dev_s)
+                keep = len(r.idx)
+                alive = ~r.done[:keep].astype(bool)
+                live_ids.append(r.idx[alive])
+                live_scores.append(np.asarray(s)[:keep][alive])
+            killed = rung.decide(
+                np.concatenate(live_ids), np.concatenate(live_scores),
+                stats["slices"],
+            )
+            if killed.size:
+                killed_mask[np.asarray(killed)] = True
+                apply_kills()
+            stats["rung_wait_s"] += time.perf_counter() - t_r
 
         # retire rounds whose real lanes are all done (the padding
         # lanes mirror a real lane and are ignored throughout)
@@ -1794,6 +2017,14 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
                 rounds.append(r)
         else:
             rounds = still
+
+    # retirement-reason accounting: every lane either converged (or hit
+    # its iteration cap) or was killed by a rung — the quality/
+    # convergence split the iterative stats dict exposes
+    if rung is not None:
+        stats["retired_rung"] = len(rung.killed)
+        stats["rung_history"] = [dict(h) for h in rung.history]
+    stats["retired_convergence"] = n_tasks - stats["retired_rung"]
 
     # phase 2: finalize everything in ORIGINAL task order through the
     # ordinary round loop (same chunk shape -> same compiled program

@@ -219,6 +219,7 @@ _STATS = {
     "retries_exhausted": 0,    # faults that ran out of policy budget
     "shared_replacements": 0,  # shared-arg re-placements (preemption)
     "lanes_quarantined": 0,    # tasks mapped to error_score by the guard
+    "lanes_rung_killed": 0,    # tasks retired early by an adaptive rung
     "suppressed": 0,           # exceptions logged instead of swallowed
     "checkpoint_hits": 0,      # tasks skipped because a journal had them
     "watchdog_trips": 0,       # dispatches past their watchdog budget

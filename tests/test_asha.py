@@ -1,0 +1,610 @@
+"""
+Adaptive (ASHA) search tests: quality-based lane retirement on the
+convergence-compacted backend.
+
+Pins the PR's contracts:
+- ``adaptive=None`` and ``HalvingSpec(eta=inf)`` (rungs scored, nothing
+  killed) both reproduce exhaustive compacted ``cv_results_``
+  byte-identically, fuzzed across slice sizes, both solver families,
+  and sparse/dense representations (satellite 1);
+- the checkpoint structural signature covers the SAMPLED candidate
+  list, so a killed adaptive randomized search with the same
+  ``random_state`` resumes past completed work instead of resampling
+  (satellite 2) — and journaled rung kills restore AS kills;
+- a host-only scorer (or any path that cannot run rungs on device)
+  warns and falls back to exhaustive execution (satellite 3);
+- ``last_round_stats`` splits retirement by convergence vs rung with a
+  per-rung histogram (satellite 4);
+- killed candidates map to sklearn-compatible error_score rows with a
+  single RungKilledWarning and a ``rung_`` column; survivors score
+  identically to the exhaustive run and the winner is preserved.
+"""
+
+import glob
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from skdist_tpu.distribute.adaptive import HalvingSpec, RungKilledWarning
+from skdist_tpu.distribute.search import (
+    DistGridSearchCV,
+    DistMultiModelSearch,
+    DistRandomizedSearchCV,
+)
+from skdist_tpu.models import LogisticRegression, SGDClassifier
+from skdist_tpu.parallel import RungController, TPUBackend, faults
+
+
+def _nontime_cols(cv):
+    return [c for c in cv if c != "params" and "_time" not in c]
+
+
+def _grid_search(backend, X, y, adaptive=None, **kw):
+    grid = kw.pop("grid", {"C": [0.01, 0.1, 1.0, 10.0],
+                           "tol": [1e-2, 1e-5]})
+    est = kw.pop("est", LogisticRegression(max_iter=40, engine="xla"))
+    return DistGridSearchCV(
+        est, grid, backend=backend, cv=3, scoring="accuracy",
+        refit=False, adaptive=adaptive, **kw,
+    ).fit(X, y)
+
+
+# ---------------------------------------------------------------------------
+# HalvingSpec / RungController units
+# ---------------------------------------------------------------------------
+
+def test_halvingspec_validation():
+    with pytest.raises(ValueError):
+        HalvingSpec(eta=1.0)
+    with pytest.raises(ValueError):
+        HalvingSpec(eta=0.5)
+    with pytest.raises(ValueError):
+        HalvingSpec(min_slices=0)
+    with pytest.raises(ValueError):
+        HalvingSpec(metric=123)
+    spec = HalvingSpec(eta=float("inf"))
+    assert spec.get_params() == {
+        "eta": float("inf"), "min_slices": 1, "metric": "auto",
+    }
+
+
+def test_adaptive_arg_validated_at_fit(clf_data):
+    X, y = clf_data
+    with pytest.raises(ValueError, match="HalvingSpec"):
+        _grid_search(TPUBackend(), X, y, adaptive="eta=3")
+
+
+def test_rung_controller_groups_and_ties():
+    # 6 groups x 2 lanes; eta=3 keeps ceil(6/3)=2 groups by mean score
+    groups = np.repeat(np.arange(6), 2)
+    ctrl = RungController(eta=3, every=1, groups=groups)
+    ids = np.arange(12)
+    scores = np.repeat([0.9, 0.1, 0.9, 0.5, 0.3, 0.2], 2)
+    killed = ctrl.decide(ids, scores, slice_idx=1)
+    # groups 0 and 2 tie at 0.9: both kept (n_keep=2); all others die
+    assert sorted(np.unique(groups[killed])) == [1, 3, 4, 5]
+    assert ctrl.history[0]["n_killed"] == 8
+    assert all(ctrl.killed[int(i)] == 0 for i in killed)
+    # a later rung over the survivors: ties break toward lower group id
+    survivors = np.array([0, 1, 4, 5])
+    killed2 = ctrl.decide(survivors, np.array([0.7, 0.7, 0.7, 0.7]), 2)
+    assert sorted(np.unique(groups[killed2])) == [2]
+    ctrl.reset()
+    assert ctrl.killed == {} and ctrl.history == []
+
+
+def test_rung_controller_fractional_eta():
+    """eta is any real > 1: eta=1.5 keeps ceil(n/1.5), it must not
+    truncate to int(1.5)=1 (which would keep everything forever)."""
+    ctrl = RungController(eta=1.5, every=1)
+    ids = np.arange(6)
+    killed = ctrl.decide(ids, np.array([0.1, 0.2, 0.3, 0.4, 0.5, 0.6]), 1)
+    # ceil(6 / 1.5) = 4 kept -> the bottom 2 die
+    assert sorted(killed.tolist()) == [0, 1]
+
+
+def test_rung_controller_nonfinite_and_inf_eta():
+    ctrl = RungController(eta=2, every=1)
+    ids = np.arange(4)
+    killed = ctrl.decide(ids, np.array([0.5, np.nan, 0.6, np.inf]), 1)
+    # NaN ranks below every finite score: lane 1 dies first
+    assert 1 in killed
+    inf_ctrl = RungController(eta=float("inf"), every=2)
+    assert not inf_ctrl.due(1) and inf_ctrl.due(2)
+    assert inf_ctrl.decide(ids, np.array([1, 2, 3, 4.0]), 2).size == 0
+    assert inf_ctrl.history[0]["n_live"] == 4  # scored, nothing killed
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: bitwise parity — adaptive=None vs eta=inf vs no-arg
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("slice_iters", ["", "3", "17"])
+@pytest.mark.parametrize("family", ["lbfgs", "sgd"])
+def test_parity_none_vs_inf_fuzz(clf_data, monkeypatch, slice_iters,
+                                 family):
+    """eta=inf scores every rung but kills nothing: cv_results_ must be
+    byte-identical to adaptive=None (non-time columns), across slice
+    sizes and both solver families — the rung evaluator READS carries,
+    it never perturbs them."""
+    X, y = clf_data
+    if slice_iters:
+        monkeypatch.setenv("SKDIST_SLICE_ITERS", slice_iters)
+    if family == "lbfgs":
+        est = LogisticRegression(max_iter=40, engine="xla")
+        grid = {"C": [0.01, 0.1, 1.0, 10.0], "tol": [1e-2, 1e-5]}
+    else:
+        est = SGDClassifier(max_iter=24, random_state=3)
+        grid = {"alpha": [1e-5, 1e-3, 1e-1, 1.0], "tol": [1e-4, 1e-2]}
+    base = _grid_search(TPUBackend(), X, y, est=est, grid=grid)
+    bk = TPUBackend()
+    inf = _grid_search(
+        bk, X, y, est=est, grid=grid,
+        adaptive=HalvingSpec(eta=float("inf")),
+    )
+    assert bk.last_round_stats["mode"] == "compacted"
+    assert bk.last_round_stats["retired_rung"] == 0
+    assert len(bk.last_round_stats["rung_history"]) >= 1
+    for col in _nontime_cols(base.cv_results_):
+        np.testing.assert_array_equal(
+            np.asarray(base.cv_results_[col]),
+            np.asarray(inf.cv_results_[col]), err_msg=col,
+        )
+    assert np.all(inf.cv_results_["rung_"] == -1)
+    assert "rung_" not in base.cv_results_
+
+
+def test_parity_none_vs_inf_sparse(tpu_backend):
+    """The rung evaluator rides the representation-polymorphic decision
+    kernels: eta=inf parity holds for packed-CSR shared data too."""
+    import scipy.sparse as sp
+
+    rng = np.random.RandomState(5)
+    X = sp.random(220, 1024, density=0.01, format="csr",
+                  random_state=rng, dtype=np.float32)
+    y = rng.randint(0, 3, 220)
+    est = LogisticRegression(max_iter=30, engine="xla")
+    grid = {"C": [0.01, 0.1, 1.0, 10.0], "tol": [1e-2, 1e-5]}
+    base = DistGridSearchCV(
+        est, grid, backend=TPUBackend(), cv=3, scoring="accuracy",
+        refit=False,
+    ).fit(X, y)
+    bk = TPUBackend()
+    inf = DistGridSearchCV(
+        est, grid, backend=bk, cv=3, scoring="accuracy", refit=False,
+        adaptive=HalvingSpec(eta=float("inf")),
+    ).fit(X, y)
+    assert bk.last_round_stats["mode"] == "compacted"
+    for col in _nontime_cols(base.cv_results_):
+        np.testing.assert_array_equal(
+            np.asarray(base.cv_results_[col]),
+            np.asarray(inf.cv_results_[col]), err_msg=col,
+        )
+
+
+# ---------------------------------------------------------------------------
+# kill semantics: error_score rows, rung_ column, survivor parity
+# ---------------------------------------------------------------------------
+
+def _skewed(clf_data_xy, eta=2, **kw):
+    X, y = clf_data_xy
+    bk = TPUBackend()
+    grid = {"C": list(np.logspace(-4, 2, 10)), "tol": [1e-6]}
+    est = LogisticRegression(max_iter=60, engine="xla")
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        gs = _grid_search(
+            bk, X, y, est=est, grid=grid,
+            adaptive=HalvingSpec(eta=eta), **kw,
+        )
+    return gs, bk, ws
+
+
+def test_kills_map_to_error_score_and_rung_column(clf_data):
+    gs, bk, ws = _skewed(clf_data)
+    rung = np.asarray(gs.cv_results_["rung_"])
+    assert (rung >= 0).any(), "expected rung kills on the skewed grid"
+    mean = np.asarray(gs.cv_results_["mean_test_score"])
+    # killed candidates carry error_score (default NaN) -> rank last;
+    # survivors carry real scores
+    assert np.all(np.isnan(mean[rung >= 0]))
+    assert np.all(np.isfinite(mean[rung == -1]))
+    assert int(np.asarray(
+        gs.cv_results_["rank_test_score"]
+    ).argmin()) == gs.best_index_
+    assert rung[gs.best_index_] == -1
+    kills = [w for w in ws if issubclass(w.category, RungKilledWarning)]
+    assert len(kills) == 1, "exactly one RungKilledWarning per fit"
+    # exhaustive reference: same winner, survivors score identically
+    ref = _grid_search(
+        TPUBackend(), clf_data[0], clf_data[1],
+        est=LogisticRegression(max_iter=60, engine="xla"),
+        grid={"C": list(np.logspace(-4, 2, 10)), "tol": [1e-6]},
+    )
+    assert gs.best_index_ == ref.best_index_
+    surv = rung == -1
+    np.testing.assert_array_equal(
+        mean[surv], np.asarray(ref.cv_results_["mean_test_score"])[surv]
+    )
+
+
+def test_kills_numeric_error_score(clf_data):
+    gs, _bk, _ws = _skewed(clf_data, error_score=0.25)
+    rung = np.asarray(gs.cv_results_["rung_"])
+    mean = np.asarray(gs.cv_results_["mean_test_score"])
+    killed = rung >= 0
+    assert killed.any()
+    np.testing.assert_allclose(mean[killed], 0.25)
+
+
+def test_kills_error_score_raise_maps_to_nan(clf_data):
+    """error_score='raise' must NOT raise for rung kills (a kill is a
+    scheduling decision, not a failed fit): killed rows record NaN."""
+    gs, _bk, ws = _skewed(clf_data, error_score="raise")
+    rung = np.asarray(gs.cv_results_["rung_"])
+    assert (rung >= 0).any()
+    assert np.all(np.isnan(
+        np.asarray(gs.cv_results_["mean_test_score"])[rung >= 0]
+    ))
+
+
+# ---------------------------------------------------------------------------
+# satellite 4: observability
+# ---------------------------------------------------------------------------
+
+def test_retirement_stats_split(clf_data):
+    gs, bk, _ws = _skewed(clf_data)
+    st = bk.last_round_stats
+    assert st["mode"] == "compacted"
+    n_tasks = 10 * 3
+    assert st["retired_rung"] + st["retired_convergence"] == n_tasks
+    assert st["retired_rung"] > 0
+    hist = st["rung_history"]
+    assert hist and sum(h["n_killed"] for h in hist) == st["retired_rung"]
+    for h in hist:
+        assert set(h) >= {"rung", "slice", "n_live", "n_groups",
+                          "n_killed"}
+    faults_killed = faults.snapshot()["lanes_rung_killed"]
+    assert faults_killed >= st["retired_rung"]
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: host-only scorer / non-engageable paths warn + exhaustive
+# ---------------------------------------------------------------------------
+
+def test_host_scorer_falls_back_exhaustive(clf_data):
+    from sklearn.metrics import accuracy_score, make_scorer
+
+    X, y = clf_data
+    grid = {"C": list(np.logspace(-3, 2, 10)), "tol": [1e-5]}
+    with pytest.warns(UserWarning, match="could not engage"):
+        gs = DistGridSearchCV(
+            LogisticRegression(max_iter=40, engine="xla"), grid,
+            backend=TPUBackend(), cv=3,
+            scoring=make_scorer(accuracy_score), refit=False,
+            adaptive=HalvingSpec(eta=2),
+        ).fit(X, y)
+    # exhaustive: every candidate completed, nothing error-scored
+    assert np.all(gs.cv_results_["rung_"] == -1)
+    assert np.all(np.isfinite(gs.cv_results_["mean_test_score"]))
+
+
+def test_incompatible_rung_metric_falls_back(clf_data):
+    """metric='roc_auc' on 3-class y has no compatible device kernel:
+    warn + exhaustive, never a crash or a host-side rung gather."""
+    X, y = clf_data
+    grid = {"C": list(np.logspace(-3, 2, 10)), "tol": [1e-5]}
+    with pytest.warns(UserWarning, match="could not engage"):
+        gs = DistGridSearchCV(
+            LogisticRegression(max_iter=40, engine="xla"), grid,
+            backend=TPUBackend(), cv=3, scoring="accuracy", refit=False,
+            adaptive=HalvingSpec(eta=2, metric="roc_auc"),
+        ).fit(X, y)
+    assert np.all(gs.cv_results_["rung_"] == -1)
+    assert np.all(np.isfinite(gs.cv_results_["mean_test_score"]))
+
+
+def test_small_grid_falls_back(clf_data):
+    X, y = clf_data
+    with pytest.warns(UserWarning, match="could not engage"):
+        gs = _grid_search(
+            TPUBackend(), X, y, grid={"C": [0.1, 1.0]},
+            adaptive=HalvingSpec(eta=2),
+        )
+    assert np.all(gs.cv_results_["rung_"] == -1)
+
+
+def test_multimetric_auto_rung_warns_which_metric(clf_data):
+    """metric='auto' with multimetric scoring and refit=False has no
+    refit metric to follow: the rung ranks by the first resolved
+    scoring entry, and must SAY so (the user inspects cv_results_ by
+    whichever metric they care about — kills driven by a different one
+    silently would be a trap)."""
+    X, y = clf_data
+    grid = {"C": list(np.logspace(-4, 2, 10)), "tol": [1e-6]}
+    with pytest.warns(UserWarning, match="rung kills will rank"):
+        gs = DistGridSearchCV(
+            LogisticRegression(max_iter=60, engine="xla"), grid,
+            backend=TPUBackend(), cv=3,
+            scoring=["f1_weighted", "accuracy"], refit=False,
+            adaptive=HalvingSpec(eta=2),
+        ).fit(X, y)
+    assert (np.asarray(gs.cv_results_["rung_"]) >= 0).any()
+
+
+def test_proba_rung_metric_without_proba_family_falls_back(clf_data):
+    """An explicit proba rung metric on a family without a proba kernel
+    (neg_log_loss on LinearSVC) must warn + run exhaustively, not crash
+    building a kernel the estimator cannot provide."""
+    from skdist_tpu.models import LinearSVC
+
+    X, y = clf_data
+    grid = {"C": list(np.logspace(-3, 2, 10)), "tol": [1e-5]}
+    with pytest.warns(UserWarning, match="could not engage"):
+        gs = DistGridSearchCV(
+            LinearSVC(max_iter=40, engine="xla"), grid,
+            backend=TPUBackend(), cv=3, scoring="accuracy", refit=False,
+            adaptive=HalvingSpec(eta=2, metric="neg_log_loss"),
+        ).fit(X, y)
+    assert np.all(gs.cv_results_["rung_"] == -1)
+    assert np.all(np.isfinite(gs.cv_results_["mean_test_score"]))
+
+
+def test_backend_downgrade_deactivates_rung_and_warns(clf_data,
+                                                      monkeypatch):
+    """A mid-dispatch backend downgrade to the classic fallback (the
+    multi-process-mesh / OOM path: TaskBackend.batched_map_iterative)
+    runs EXHAUSTIVELY — the controller must come back deactivated so
+    fit's could-not-engage warning fires and no lane is error-scored
+    from a stale kill map."""
+    from skdist_tpu.parallel.backend import TaskBackend
+
+    X, y = clf_data
+    bk = TPUBackend()
+
+    def downgraded(self, *a, **kw):
+        return TaskBackend.batched_map_iterative(self, *a, **kw)
+
+    monkeypatch.setattr(
+        type(bk), "batched_map_iterative", downgraded
+    )
+    grid = {"C": list(np.logspace(-4, 2, 10)), "tol": [1e-6]}
+    with pytest.warns(UserWarning, match="could not engage"):
+        gs = _grid_search(
+            bk, X, y, est=LogisticRegression(max_iter=60, engine="xla"),
+            grid=grid, adaptive=HalvingSpec(eta=2),
+        )
+    assert np.all(gs.cv_results_["rung_"] == -1)
+    assert np.all(np.isfinite(gs.cv_results_["mean_test_score"]))
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: adaptive randomized search — resume determinism
+# ---------------------------------------------------------------------------
+
+def _rand_search(X, y, tmpdir, random_state):
+    est = LogisticRegression(max_iter=60, engine="xla")
+    dists = {"C": np.logspace(-4, 2, 50).tolist(), "tol": [1e-6]}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rs = DistRandomizedSearchCV(
+            est, dists, backend=TPUBackend(), n_iter=10, cv=3,
+            scoring="accuracy", refit=False, random_state=random_state,
+            adaptive=HalvingSpec(eta=2),
+        ).fit(X, y, checkpoint_dir=str(tmpdir))
+    return rs
+
+
+def test_randomized_resume_covers_sampled_candidates(clf_data, tmp_path):
+    """The checkpoint signature canonicalizes the SAMPLED candidate
+    list (plus the HalvingSpec config): a same-random_state rerun
+    resumes past every journaled task — including rung-killed rows,
+    which restore AS kills — while a different random_state (or a
+    different eta) starts a fresh journal."""
+    X, y = clf_data
+    r1 = _rand_search(X, y, tmp_path, random_state=7)
+    files1 = sorted(glob.glob(str(tmp_path / "*.jsonl")))
+    assert len(files1) == 1
+    hits0 = faults.snapshot()["checkpoint_hits"]
+    r2 = _rand_search(X, y, tmp_path, random_state=7)
+    assert sorted(glob.glob(str(tmp_path / "*.jsonl"))) == files1
+    # every (candidate x fold) task restored from the journal
+    assert faults.snapshot()["checkpoint_hits"] - hits0 == 10 * 3
+    for col in _nontime_cols(r1.cv_results_):
+        if col.startswith("param_"):
+            continue
+        a1 = np.asarray(r1.cv_results_[col])
+        a2 = np.asarray(r2.cv_results_[col])
+        try:
+            a1, a2 = a1.astype(np.float64), a2.astype(np.float64)
+        except (TypeError, ValueError):
+            pass  # non-numeric column: exact elementwise compare
+        np.testing.assert_array_equal(a1, a2, err_msg=col)
+    # rung kills restored as kills, not as raw partial scores
+    np.testing.assert_array_equal(
+        r1.cv_results_["rung_"], r2.cv_results_["rung_"]
+    )
+    assert (np.asarray(r2.cv_results_["rung_"]) >= 0).any()
+    # different sampled grid -> different signature -> fresh journal
+    _rand_search(X, y, tmp_path, random_state=8)
+    assert len(glob.glob(str(tmp_path / "*.jsonl"))) == 2
+
+
+def test_killed_rows_journaled_once_with_tag(clf_data, tmp_path):
+    """A rung-killed lane must appear in the journal ONLY as its
+    rung_killed-tagged error_score row — never first as the raw
+    partial-fit scores of its half-trained carry (a crash between the
+    two records would otherwise resume the kill as a legitimately
+    completed row)."""
+    import json as _json
+
+    X, y = clf_data
+    r = _rand_search(X, y, tmp_path, random_state=7)
+    killed = {
+        int(i) for i in np.flatnonzero(
+            np.asarray(r.cv_results_["rung_"]) >= 0
+        )
+    }
+    assert killed, "expected rung kills"
+    n_splits = 3
+    seen = {}
+    for path in glob.glob(str(tmp_path / "*.jsonl")):
+        with open(path) as fh:
+            for line in fh:
+                row = _json.loads(line)
+                seen.setdefault(int(row["t"]), []).append(row["r"])
+    for gid, rows in seen.items():
+        if gid // n_splits in killed:
+            assert len(rows) == 1, (
+                f"killed task {gid} journaled {len(rows)} times"
+            )
+            assert "rung_killed" in rows[0]
+            assert np.isnan(rows[0]["test_score"])
+
+
+def test_adaptive_config_in_signature(clf_data, tmp_path):
+    """A different eta is a different race: its journal must not be
+    confused with the first one's."""
+    X, y = clf_data
+    est = LogisticRegression(max_iter=60, engine="xla")
+    grid = {"C": list(np.logspace(-3, 2, 10)), "tol": [1e-6]}
+
+    def run(spec):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            DistGridSearchCV(
+                est, grid, backend=TPUBackend(), cv=3,
+                scoring="accuracy", refit=False, adaptive=spec,
+            ).fit(X, y, checkpoint_dir=str(tmp_path))
+
+    run(HalvingSpec(eta=2))
+    assert len(glob.glob(str(tmp_path / "*.jsonl"))) == 1
+    run(HalvingSpec(eta=3))
+    assert len(glob.glob(str(tmp_path / "*.jsonl"))) == 2
+
+
+# ---------------------------------------------------------------------------
+# meta-estimators riding the rungs
+# ---------------------------------------------------------------------------
+
+def test_eliminate_adaptive():
+    from skdist_tpu.distribute.eliminate import DistFeatureEliminator
+
+    # >= 8 nested sets x 3 folds (above the compaction floor) on a
+    # problem where quality actually separates the sets: overlapping
+    # classes on 8 informative features plus 8 high-variance junk
+    # features that measurably hurt validation accuracy. (clf_data is
+    # perfectly separable — every set ties at 1.0 and the exhaustive
+    # eliminator's fewest-features tie-break picks a set a rung race
+    # has no quality signal to preserve.)
+    rng = np.random.RandomState(0)
+    X = np.vstack([
+        rng.normal(loc=c, scale=1.0, size=(60, 8)) for c in (-0.8, 0.0, 0.8)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1, 2], 60)
+    perm = rng.permutation(len(y))
+    X, y = X[perm], y[perm]
+    Xw = np.hstack(
+        [X, rng.normal(scale=3.0, size=(X.shape[0], 8)).astype(np.float32)]
+    )
+    est = LogisticRegression(max_iter=60, tol=1e-6, engine="xla")
+    ref = DistFeatureEliminator(
+        est, backend=TPUBackend(), step=1, cv=3,
+        min_features_to_select=6, scoring="accuracy",
+    ).fit(Xw, y)
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        ad = DistFeatureEliminator(
+            est, backend=TPUBackend(), step=1, cv=3,
+            min_features_to_select=6, scoring="accuracy",
+            adaptive=HalvingSpec(eta=2),
+        ).fit(Xw, y)
+    assert any(
+        issubclass(w.category, RungKilledWarning) for w in ws
+    ), "expected rung kills across the feature sets"
+    assert (ad.rung_ >= 0).any() and (ad.rung_ == -1).any()
+    # killed sets score NaN and are never selected; the surviving
+    # winner matches the exhaustive eliminator
+    assert np.isnan(np.asarray(ad.scores_)[ad.rung_ >= 0]).all()
+    np.testing.assert_array_equal(ad.best_features_, ref.best_features_)
+    assert ad.rung_[int(np.nanargmax(np.asarray(ad.scores_)))] == -1
+
+
+def test_eliminate_adaptive_not_engaged_warns(clf_data):
+    from skdist_tpu.distribute.eliminate import DistFeatureEliminator
+
+    X, y = clf_data  # only ~4 sets x 3 folds: below the compaction floor
+    with pytest.warns(UserWarning, match="could not engage"):
+        el = DistFeatureEliminator(
+            LogisticRegression(max_iter=40, engine="xla"),
+            backend=TPUBackend(), step=2, cv=3, scoring="accuracy",
+            adaptive=HalvingSpec(eta=2),
+        ).fit(X, y)
+    assert np.all(el.rung_ == -1)
+
+
+def test_multimodel_adaptive(clf_data):
+    X, y = clf_data
+    models = [
+        ("lr", LogisticRegression(max_iter=60, tol=1e-6, engine="xla"),
+         {"C": np.logspace(-4, 2, 40).tolist()}),
+    ]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref = DistMultiModelSearch(
+            models, backend=TPUBackend(), n=12, cv=3,
+            scoring="accuracy", random_state=0, refit=False,
+        ).fit(X, y)
+        ad = DistMultiModelSearch(
+            models, backend=TPUBackend(), n=12, cv=3,
+            scoring="accuracy", random_state=0, refit=False,
+            adaptive=HalvingSpec(eta=2),
+        ).fit(X, y)
+    rung = np.asarray(ad.cv_results_["rung_"])
+    assert rung.shape == (12,)
+    assert (rung >= 0).any()
+    assert ad.best_model_name_ == ref.best_model_name_
+    assert ad.best_params_ == ref.best_params_
+    assert rung[ad.best_index_] == -1
+
+
+# ---------------------------------------------------------------------------
+# local backend + SGD family kills
+# ---------------------------------------------------------------------------
+
+def test_adaptive_on_local_backend(clf_data):
+    """The slice loop (and its rung hook) also runs on LocalBackend —
+    backend=None engages the same machinery with one task slot."""
+    X, y = clf_data
+    grid = {"C": list(np.logspace(-4, 2, 10)), "tol": [1e-6]}
+    est = LogisticRegression(max_iter=60, engine="xla")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gs = DistGridSearchCV(
+            est, grid, backend="local", cv=3, scoring="accuracy",
+            refit=False, adaptive=HalvingSpec(eta=2),
+        ).fit(X, y)
+        ref = DistGridSearchCV(
+            est, grid, backend="local", cv=3, scoring="accuracy",
+            refit=False,
+        ).fit(X, y)
+    rung = np.asarray(gs.cv_results_["rung_"])
+    assert (rung >= 0).any()
+    assert gs.best_index_ == ref.best_index_
+
+
+def test_adaptive_sgd_family(clf_data):
+    X, y = clf_data
+    grid = {"alpha": np.logspace(-6, 2, 10).tolist(), "tol": [-np.inf]}
+    est = SGDClassifier(max_iter=32, random_state=1)
+    bk = TPUBackend()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gs = DistGridSearchCV(
+            est, grid, backend=bk, cv=3, scoring="accuracy",
+            refit=False, adaptive=HalvingSpec(eta=2),
+        ).fit(X, y)
+    assert bk.last_round_stats["retired_rung"] > 0
+    assert (np.asarray(gs.cv_results_["rung_"]) >= 0).any()
