@@ -234,6 +234,95 @@ def _sparse_text_real(quick):
         return None
 
 
+def streaming_aux(quick=False):
+    """Measured readout of the out-of-core streaming data plane: a
+    disk-backed ChunkedDataset fit through the streamed SGD search with
+    the double-buffered feed vs the serial feed (overlap = hidden feed
+    time), the same grid on the materialised matrix through the
+    resident batched path (streamed-vs-resident wall + cv parity; the
+    grid runs shuffle=False/aligned so both paths execute the same
+    visit order), streamed batch_predict rows/s, and the streamed byte
+    accounting. Best-effort: a dict with "error" on any failure."""
+    import tempfile
+
+    from sklearn.model_selection import KFold
+
+    from skdist_tpu.data import ChunkedDataset
+    from skdist_tpu.distribute.predict import batch_predict
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models.linear import SGDClassifier
+    from skdist_tpu.parallel import LocalBackend, compile_cache
+
+    try:
+        d, R = 64, 8192
+        n = R * (6 if quick else 24)
+        rng = np.random.RandomState(11)
+        w_true = rng.randn(d).astype(np.float32)
+        X = rng.randn(n, d).astype(np.float32)
+        y = (X @ w_true > 0).astype(np.int64)
+        tmp = tempfile.mkdtemp(prefix="skdist_bench_stream_")
+        ChunkedDataset.from_arrays(X, y, block_rows=R).save(tmp)
+        ds = ChunkedDataset.load(tmp)
+        est_kw = dict(loss="log_loss", max_iter=2, batch_size=512,
+                      shuffle=False, tol=None, random_state=0)
+        grid = {"alpha": [1e-4, 1e-3]}
+
+        def run(sync):
+            bk = LocalBackend(sync_rounds=sync)
+            t0 = time.perf_counter()
+            gs = DistGridSearchCV(
+                SGDClassifier(**est_kw), grid, cv=KFold(2),
+                backend=bk, refit=False,
+            ).fit(ds)
+            return (time.perf_counter() - t0, gs,
+                    dict(bk.last_round_stats or {}))
+
+        run(False)  # cold (compiles)
+        snap0 = compile_cache.snapshot()
+        wall_pipe, gs_pipe, st_pipe = run(False)
+        warm_delta = _cache_delta(snap0, compile_cache.snapshot())
+        wall_serial, _gs_serial, st_serial = run(True)
+
+        t0 = time.perf_counter()
+        gs_res = DistGridSearchCV(
+            SGDClassifier(**est_kw), grid, cv=KFold(2), refit=False,
+        ).fit(X, y)
+        wall_resident = time.perf_counter() - t0
+        parity = float(np.abs(
+            np.asarray(gs_pipe.cv_results_["mean_test_score"])
+            - np.asarray(gs_res.cv_results_["mean_test_score"])
+        ).max())
+
+        model = SGDClassifier(**est_kw).fit(ds)
+        batch_predict(model, ds)  # warm
+        t0 = time.perf_counter()
+        batch_predict(model, ds)
+        predict_wall = time.perf_counter() - t0
+
+        wait_pipe = st_pipe.get("feed_wait_s", 0.0)
+        wait_serial = st_serial.get("feed_wait_s", 0.0)
+        return {
+            "n_rows": n, "n_features": d, "block_rows": R,
+            "n_blocks": ds.n_blocks,
+            "data_mib": ds.nbytes_estimate >> 20,
+            "stream_warm_wall_s": round(wall_pipe, 3),
+            "stream_serial_wall_s": round(wall_serial, 3),
+            "resident_warm_wall_s": round(wall_resident, 3),
+            "feed_wait_pipelined_s": round(wait_pipe, 4),
+            "feed_wait_serial_s": round(wait_serial, 4),
+            "feed_hidden_frac": round(
+                1.0 - wait_pipe / max(wait_serial, 1e-9), 4
+            ),
+            "streamed_bytes_per_search": st_pipe.get("streamed_bytes"),
+            "peak_block_bytes": st_pipe.get("peak_block_bytes"),
+            "cv_parity_max_diff": parity,
+            "predict_rows_per_s": int(n / max(predict_wall, 1e-9)),
+            "compiles_after_warmup": warm_delta,
+        }
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def sparse_aux(quick=False):
     """Measured readout of the packed-CSR sparse fit plane on the
     BASELINE config-3 shape (OvR LinearSVC over hashed text, real
@@ -866,6 +955,7 @@ def run_bench(platform, quick=False):
             "compaction": compaction_aux(quick=quick),
             "sparse": sparse_aux(quick=quick),
             "asha": asha_aux(quick=quick),
+            "streaming": streaming_aux(quick=quick),
             "batched_vs_generic_cv_results_max_diff": parity,
             "f32_noise_floor_wellcond": floor_well,
             "illcond_C100_diff": parity_ill,
@@ -1124,6 +1214,27 @@ def _sparse_main(quick=False):
     return payload
 
 
+def _streaming_main(quick=False):
+    """Standalone capture of the out-of-core streaming readout →
+    ``BENCH_streaming_r10.json`` (streamed vs serial-feed vs resident
+    walls, feed-overlap fraction, predict rows/s, byte accounting,
+    parity, compile invariant)."""
+    import jax
+
+    payload = {
+        "metric": "streaming_data_plane",
+        "aux": streaming_aux(quick=quick),
+        "platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(payload, indent=1), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_streaming_r10.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
 if __name__ == "__main__":
     if "--phase" in sys.argv:
         _phase_main(sys.argv)
@@ -1131,5 +1242,7 @@ if __name__ == "__main__":
         _sparse_main(quick="--quick" in sys.argv)
     elif "--asha" in sys.argv:
         _asha_main(quick="--quick" in sys.argv)
+    elif "--streaming" in sys.argv:
+        _streaming_main(quick="--quick" in sys.argv)
     else:
         main(quick="--quick" in sys.argv)

@@ -29,6 +29,17 @@
 #                             survivor-score parity <= 1e-5, coherent
 #                             rung/convergence retirement split, 0
 #                             compiles after warmup (adaptive-search PR).
+#   streaming_smoke.py      — out-of-core data plane: disk-backed
+#                             dataset >= 4x an enforced host budget fit
+#                             STREAMED with warmed peak-RSS delta under
+#                             budget, streamed-vs-resident cv_results_
+#                             parity <= 1e-5 (aligned SGD), the
+#                             double-buffered feed hiding >= 50% of
+#                             measured read+H2D time vs the serial
+#                             feed, streamed batch_predict
+#                             byte-identical to the blocked resident
+#                             path with bounded RSS, 0 post-warmup
+#                             compiles (streaming data plane PR).
 #   fault_smoke.py          — fault-injection matrix: transient faults
 #                             on rounds retried to a bitwise-identical
 #                             cv_results_; NaN lane quarantined to
@@ -46,3 +57,4 @@ python build_tools/compaction_smoke.py
 python build_tools/sparse_fit_smoke.py
 python build_tools/asha_smoke.py
 python build_tools/fault_smoke.py
+python build_tools/streaming_smoke.py

@@ -54,6 +54,7 @@ _EXPORTS = {
         "DistExtraTreesRegressor": "skdist_tpu.distribute.ensemble",
         "DistRandomTreesEmbedding": "skdist_tpu.distribute.ensemble",
         "DistFeatureEliminator": "skdist_tpu.distribute.eliminate",
+        "ChunkedDataset": "skdist_tpu.data",
         "Encoderizer": "skdist_tpu.distribute.encoder",
         "EncoderizerExtractor": "skdist_tpu.distribute.encoder",
         "get_prediction_udf": "skdist_tpu.distribute.predict",

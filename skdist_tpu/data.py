@@ -1,0 +1,592 @@
+"""
+Out-of-core chunked datasets: the host side of the streaming data
+plane.
+
+Every fit and predict path used to require X host-resident: the sparse
+plane (``skdist_tpu.sparse``) bought ~100x on density but nothing on
+total size, and ``batch_predict`` staged through a fixed row ceiling.
+The reference needed a Spark cluster precisely for data that fits no
+single machine; :class:`ChunkedDataset` is the TPU-native answer — the
+long row axis is cut into uniform row blocks that live on disk (or any
+lazily-sliceable source) and stream through the backend's
+double-buffered host→device block pipeline
+(``parallel.backend.BlockFeeder``), the same prefetch discipline
+tf.data / Petastorm use to keep accelerators fed from storage.
+
+A dataset is a list of *block readers*: zero-arg views that produce one
+block's host arrays on demand. Blocks are uniform (``block_rows`` rows;
+the tail padded on read with zero-weight rows) so every block of a
+dataset executes ONE compiled program. Two X representations:
+
+- **dense**: ``X`` blocks are ``(block_rows, d) float32``;
+- **packed**: blocks are :class:`~skdist_tpu.sparse.PackedX` pairs
+  packed to one dataset-wide width ``m`` (max nnz per row across ALL
+  blocks), so the packed shapes — and therefore the compiled programs —
+  are identical across blocks.
+
+Alongside X, a dataset may carry per-row ``y`` and ``sample_weight``;
+the streaming fit drivers additionally slice their own per-row arrays
+(encoded labels, CV fold ids) by each block's ``[start, stop)`` range.
+Labels and weights are O(n) bytes — bounded host state by design; only
+X (O(n·d)) ever needs to stay out of core.
+
+Consumers: the streamed solver drivers (``models/streaming.py``), the
+streamed CV search (``distribute/search.py``), ``batch_predict``
+(``distribute/predict.py``), and ``Encoderizer.transform``'s
+block-by-block pass-through.
+"""
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["ChunkedDataset", "Block", "is_chunked", "default_block_rows"]
+
+#: target bytes per block when no block_rows is given — big enough to
+#: amortise dispatch overhead, small enough that two in-flight blocks
+#: (the pipeline's double-buffer depth) stay far below any host budget
+DEFAULT_BLOCK_BYTES = 64 << 20
+
+_META_NAME = "chunked_meta.json"
+
+
+def is_chunked(X):
+    """Duck test used by every entry point that routes ChunkedDataset
+    input to a streaming path."""
+    return isinstance(X, ChunkedDataset)
+
+
+def packed_block_dense(packed, n_real=None):
+    """Densify ONE packed block on host (duplicate indices accumulate,
+    matching CSR semantics) — the single definition shared by
+    ``materialize`` and the host-model predict fallback, bounded by one
+    block's rows by construction."""
+    idx = np.asarray(packed.idx)
+    val = np.asarray(packed.val)
+    if n_real is not None:
+        idx, val = idx[:n_real], val[:n_real]
+    dense = np.zeros((idx.shape[0], packed.n_cols), np.float32)
+    np.add.at(dense, (np.arange(idx.shape[0])[:, None], idx), val)
+    return dense
+
+
+def default_block_rows(n_rows, row_bytes, target_bytes=DEFAULT_BLOCK_BYTES):
+    """Rows per block targeting ``target_bytes`` per block, clamped to
+    the dataset and floored at 1."""
+    rows = max(1, int(target_bytes) // max(1, int(row_bytes)))
+    return int(min(max(1, n_rows), rows))
+
+
+class Block:
+    """One materialised host block: ``X`` (dense ``(rows, d) f32`` or
+    ``PackedX``), optional ``y``/``sw``, the global row range
+    ``[start, stop)`` it covers, and ``n_real`` (< ``rows`` only on a
+    padded tail — padding rows carry ``sw == 0`` so fit contractions
+    ignore them; predict consumers slice outputs to ``n_real``)."""
+
+    __slots__ = ("X", "y", "sw", "start", "n_real")
+
+    def __init__(self, X, y, sw, start, n_real):
+        self.X = X
+        self.y = y
+        self.sw = sw
+        self.start = start
+        self.n_real = n_real
+
+    @property
+    def stop(self):
+        return self.start + self.n_real
+
+
+class ChunkedDataset:
+    """Row blocks behind lazy readers — see module docstring.
+
+    Build with :meth:`from_arrays` (any sliceable source: ndarray,
+    ``np.memmap``, scipy CSR), :meth:`load` (a directory written by
+    :meth:`save`, memory-mapped), or :meth:`from_readers` (arbitrary
+    lazily-produced blocks). The dataset itself holds only readers and
+    O(1) metadata; reading block ``i`` materialises ~``block_nbytes``
+    of host memory, which the streaming pipeline bounds at its
+    double-buffer depth.
+    """
+
+    def __init__(self, readers, n_rows, n_features, block_rows,
+                 x_format="dense", packed_m=None, has_y=False,
+                 has_sw=False, source=None):
+        if block_rows < 1:
+            raise ValueError(f"block_rows must be >= 1; got {block_rows}")
+        self._readers = list(readers)
+        self.n_rows = int(n_rows)
+        self.n_features = int(n_features)
+        self.block_rows = int(block_rows)
+        self.x_format = x_format
+        self.packed_m = packed_m if packed_m is None else int(packed_m)
+        self.has_y = bool(has_y)
+        self.has_sw = bool(has_sw)
+        #: provenance string (paths for load(); None for in-memory) —
+        #: diagnostic only
+        self.source = source
+        # direct y/sw handles (the whole array or memmap), set by the
+        # constructors that have them: load_y/load_sw then read labels
+        # WITHOUT invoking the block readers, whose X slice-and-convert
+        # would otherwise cost two full passes over the on-disk matrix
+        self._y_direct = None
+        self._sw_direct = None
+        expect = -(-self.n_rows // self.block_rows)
+        if len(self._readers) != expect:
+            raise ValueError(
+                f"{len(self._readers)} readers for {self.n_rows} rows at "
+                f"block_rows={self.block_rows} (expected {expect})"
+            )
+
+    # ------------------------------------------------------------------
+    # shape surface (what shape-generic callers read)
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return (self.n_rows, self.n_features)
+
+    def __len__(self):
+        return self.n_rows
+
+    @property
+    def n_blocks(self):
+        return len(self._readers)
+
+    def block_range(self, i):
+        """Global ``[start, stop)`` row range of block ``i`` (stop
+        excludes tail padding)."""
+        start = i * self.block_rows
+        return start, min(start + self.block_rows, self.n_rows)
+
+    @property
+    def block_nbytes(self):
+        """Host/device bytes of ONE padded block's X (+y+sw) — what the
+        pipeline bills per resident block and what HBM capping sizes
+        against."""
+        if self.x_format == "packed":
+            x = self.block_rows * self.packed_m * 8  # idx i32 + val f32
+        else:
+            x = self.block_rows * self.n_features * 4
+        per_row_extra = (4 if self.has_y else 0) + (4 if self.has_sw else 0)
+        return int(x + self.block_rows * per_row_extra)
+
+    @property
+    def nbytes_estimate(self):
+        """Logical total X bytes across all blocks (unpadded rows)."""
+        if self.x_format == "packed":
+            return int(self.n_rows) * int(self.packed_m) * 8
+        return int(self.n_rows) * int(self.n_features) * 4
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return (
+            f"ChunkedDataset(n={self.n_rows}, d={self.n_features}, "
+            f"{self.n_blocks} x {self.block_rows}-row {self.x_format} "
+            f"blocks, ~{self.block_nbytes >> 20} MiB/block)"
+        )
+
+    # ------------------------------------------------------------------
+    # block access
+    # ------------------------------------------------------------------
+    def read_block(self, i, pad=True):
+        """Materialise block ``i`` as a :class:`Block`.
+
+        ``pad=True`` (the streaming-fit default) pads the tail block to
+        ``block_rows`` rows — zeros for X, repeated-last for y, ZERO
+        weights for sw — so all blocks share one compiled shape and
+        padding can never influence a weighted contraction. ``pad=False``
+        returns the tail at its real length (the SGD epoch plan and
+        predict's exact row accounting use this).
+        """
+        from .sparse import PackedX
+
+        if not 0 <= i < self.n_blocks:
+            raise IndexError(f"block {i} of {self.n_blocks}")
+        raw = self._readers[i]()
+        start, stop = self.block_range(i)
+        n_real = stop - start
+        X = raw["X"]
+        y = raw.get("y")
+        sw = raw.get("sw")
+        if sw is None:
+            sw = np.ones(n_real, dtype=np.float32)
+        else:
+            sw = np.ascontiguousarray(np.asarray(sw).reshape(-1),
+                                      dtype=np.float32)
+        if y is not None:
+            y = np.asarray(y).reshape(-1)
+        pad_rows = self.block_rows - n_real if pad else 0
+        if pad_rows:
+            if isinstance(X, PackedX):
+                X = PackedX(
+                    _pad0(X.idx, pad_rows), _pad0(X.val, pad_rows),
+                    X.n_cols,
+                )
+            else:
+                X = _pad0(np.asarray(X), pad_rows)
+            sw = np.concatenate(
+                [sw, np.zeros(pad_rows, dtype=np.float32)]
+            )
+            if y is not None:
+                y = np.concatenate([y, np.repeat(y[-1:], pad_rows)])
+        return Block(X, y, sw, start, n_real)
+
+    def load_y(self):
+        """Concatenated per-row labels (``(n_rows,)`` host array —
+        O(n) bytes, bounded by design; see module docstring). Reads the
+        direct handle where a constructor kept one; only
+        ``from_readers`` datasets pay a block-reader pass."""
+        if not self.has_y:
+            return None
+        if self._y_direct is not None:
+            return np.asarray(self._y_direct).reshape(-1)[: self.n_rows]
+        parts = [
+            np.asarray(self._readers[i]()["y"]).reshape(-1)
+            for i in range(self.n_blocks)
+        ]
+        return np.concatenate(parts)
+
+    def load_sw(self):
+        """Concatenated per-row sample weights, or None when the
+        dataset carries none."""
+        if not self.has_sw:
+            return None
+        if self._sw_direct is not None:
+            return np.ascontiguousarray(
+                np.asarray(self._sw_direct).reshape(-1)[: self.n_rows],
+                dtype=np.float32,
+            )
+        parts = [
+            np.ascontiguousarray(
+                np.asarray(self._readers[i]()["sw"]).reshape(-1),
+                dtype=np.float32,
+            )
+            for i in range(self.n_blocks)
+        ]
+        return np.concatenate(parts)
+
+    def materialize(self):
+        """Concatenated dense X (budget-guarded BEFORE any block is
+        read — the guard exists to refuse the allocation, not to
+        post-mortem it) — the resident comparison leg of parity tests
+        and the refit escape hatch for data that DOES fit after all.
+        Packed datasets materialise to scipy CSR."""
+        if self.x_format == "packed":
+            from scipy import sparse as sp
+
+            rows = []
+            for i in range(self.n_blocks):
+                b = self.read_block(i, pad=False)
+                rows.append(sp.csr_matrix(
+                    packed_block_dense(b.X, b.n_real)
+                ))
+            return sp.vstack(rows).tocsr()
+        from .sparse import _check_densify_budget
+
+        _check_densify_budget(self.n_rows, self.n_features)
+        return np.concatenate([
+            np.asarray(self.read_block(i, pad=False).X)
+            for i in range(self.n_blocks)
+        ], axis=0)
+
+    # ------------------------------------------------------------------
+    # constructors
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_readers(cls, readers, n_rows, n_features, block_rows,
+                     **kwargs):
+        """Low-level constructor over arbitrary block readers (each a
+        zero-arg callable returning ``{"X": ..., "y":?, "sw":?}`` for
+        its block's real rows)."""
+        return cls(readers, n_rows, n_features, block_rows, **kwargs)
+
+    @classmethod
+    def from_arrays(cls, X, y=None, sample_weight=None, block_rows=None,
+                    pack=None):
+        """Wrap sliceable arrays (ndarray, ``np.memmap``, pandas,
+        scipy CSR) as lazily-read blocks.
+
+        Nothing is copied up front: readers slice-and-convert per block,
+        so an ``np.memmap`` X streams from disk with bounded host
+        memory. Sparse input packs to a dataset-wide width ``m`` when
+        the sparse plane's routing says packing wins (``pack=None``);
+        ``pack=True``/``False`` force the decision.
+        """
+        from .sparse import is_sparse_2d, would_pack
+
+        if is_sparse_2d(X):
+            X = X.tocsr()
+            if pack is None:
+                pack = would_pack(X)
+            if pack:
+                return cls._from_csr_packed(
+                    X, y, sample_weight, block_rows
+                )
+            # dense routing of sparse input: densify block-by-block
+            n, d = X.shape
+            block_rows = block_rows or default_block_rows(n, d * 4)
+            readers = [
+                _CsrDenseReader(X, y, sample_weight, s, e)
+                for s, e in _ranges(n, block_rows)
+            ]
+            ds = cls(readers, n, d, block_rows,
+                     has_y=y is not None,
+                     has_sw=sample_weight is not None)
+            ds._y_direct, ds._sw_direct = y, sample_weight
+            return ds
+        if hasattr(X, "values") and not isinstance(X, np.ndarray):
+            X = X.values
+        n, d = X.shape[0], (X.shape[1] if X.ndim > 1 else 1)
+        block_rows = block_rows or default_block_rows(n, d * 4)
+        readers = [
+            _DenseReader(X, y, sample_weight, s, e)
+            for s, e in _ranges(n, block_rows)
+        ]
+        ds = cls(readers, n, d, block_rows,
+                 has_y=y is not None,
+                 has_sw=sample_weight is not None)
+        ds._y_direct, ds._sw_direct = y, sample_weight
+        return ds
+
+    @classmethod
+    def _from_csr_packed(cls, X, y, sample_weight, block_rows):
+        from .sparse import max_nnz_per_row
+
+        n, d = X.shape
+        m = max_nnz_per_row(X)  # DATASET-wide width: uniform programs
+        block_rows = block_rows or default_block_rows(n, m * 8)
+        readers = [
+            _CsrPackedReader(X, y, sample_weight, s, e, m)
+            for s, e in _ranges(n, block_rows)
+        ]
+        ds = cls(readers, n, d, block_rows, x_format="packed",
+                 packed_m=m, has_y=y is not None,
+                 has_sw=sample_weight is not None)
+        ds._y_direct, ds._sw_direct = y, sample_weight
+        return ds
+
+    def map_blocks(self, fn, n_features, x_format="dense", packed_m=None):
+        """Lazily transformed dataset: ``fn(block_dict, start, stop) ->
+        new block dict`` runs at read time, block by block — the
+        Encoderizer pass-through's mechanism. y/sw flow through
+        untouched unless ``fn`` replaces them."""
+        parent = self
+
+        def make_reader(i):
+            def read():
+                raw = parent._readers[i]()
+                start, stop = parent.block_range(i)
+                out = fn(dict(raw), start, stop)
+                for key in ("y", "sw"):
+                    if key not in out and key in raw:
+                        out[key] = raw[key]
+                return out
+
+            return read
+
+        ds = ChunkedDataset(
+            [make_reader(i) for i in range(self.n_blocks)],
+            self.n_rows, n_features, self.block_rows,
+            x_format=x_format, packed_m=packed_m,
+            has_y=self.has_y, has_sw=self.has_sw,
+        )
+        # y/sw flow through untouched, so the parent's direct handles
+        # stay valid for the transformed view
+        ds._y_direct, ds._sw_direct = self._y_direct, self._sw_direct
+        return ds
+
+    # ------------------------------------------------------------------
+    # on-disk format
+    # ------------------------------------------------------------------
+    def save(self, dirpath):
+        """Write the dataset to ``dirpath`` as ``.npy`` shards +
+        ``chunked_meta.json``; :meth:`load` memory-maps them back. Rows
+        are written block-by-block (bounded host memory both ways)."""
+        os.makedirs(dirpath, exist_ok=True)
+        n, d = self.n_rows, self.n_features
+        if self.x_format == "packed":
+            idx_mm = np.lib.format.open_memmap(
+                os.path.join(dirpath, "idx.npy"), mode="w+",
+                dtype=np.int32, shape=(n, self.packed_m),
+            )
+            val_mm = np.lib.format.open_memmap(
+                os.path.join(dirpath, "val.npy"), mode="w+",
+                dtype=np.float32, shape=(n, self.packed_m),
+            )
+        else:
+            x_mm = np.lib.format.open_memmap(
+                os.path.join(dirpath, "X.npy"), mode="w+",
+                dtype=np.float32, shape=(n, d),
+            )
+        y_parts, sw_parts = [], []
+        for i in range(self.n_blocks):
+            b = self.read_block(i, pad=False)
+            s, e = b.start, b.stop
+            if self.x_format == "packed":
+                idx_mm[s:e] = b.X.idx
+                val_mm[s:e] = b.X.val
+            else:
+                x_mm[s:e] = b.X
+            if b.y is not None:
+                y_parts.append(np.asarray(b.y))
+            if self.has_sw:
+                sw_parts.append(b.sw[: b.n_real])
+        if self.x_format == "packed":
+            idx_mm.flush()
+            val_mm.flush()
+        else:
+            x_mm.flush()
+        if y_parts:
+            np.save(os.path.join(dirpath, "y.npy"),
+                    np.concatenate(y_parts))
+        if sw_parts:
+            np.save(os.path.join(dirpath, "sw.npy"),
+                    np.concatenate(sw_parts))
+        meta = {
+            "n_rows": n, "n_features": d, "block_rows": self.block_rows,
+            "x_format": self.x_format, "packed_m": self.packed_m,
+            "has_y": bool(y_parts), "has_sw": bool(sw_parts),
+        }
+        with open(os.path.join(dirpath, _META_NAME), "w") as f:
+            json.dump(meta, f, indent=1, sort_keys=True)
+        return dirpath
+
+    @classmethod
+    def load(cls, dirpath, block_rows=None):
+        """Memory-map a :meth:`save` directory. Block reads copy only
+        their slice out of the maps, so peak host memory is bounded by
+        the pipeline's in-flight blocks, not the dataset."""
+        with open(os.path.join(dirpath, _META_NAME)) as f:
+            meta = json.load(f)
+        block_rows = block_rows or meta["block_rows"]
+        n, d = meta["n_rows"], meta["n_features"]
+        y = (
+            np.load(os.path.join(dirpath, "y.npy"), mmap_mode="r")
+            if meta["has_y"] else None
+        )
+        sw = (
+            np.load(os.path.join(dirpath, "sw.npy"), mmap_mode="r")
+            if meta["has_sw"] else None
+        )
+        if meta["x_format"] == "packed":
+            idx = np.load(os.path.join(dirpath, "idx.npy"), mmap_mode="r")
+            val = np.load(os.path.join(dirpath, "val.npy"), mmap_mode="r")
+            readers = [
+                _PackedPairReader(idx, val, y, sw, s, e, d)
+                for s, e in _ranges(n, block_rows)
+            ]
+            ds = cls(readers, n, d, block_rows, x_format="packed",
+                     packed_m=meta["packed_m"], has_y=meta["has_y"],
+                     has_sw=meta["has_sw"], source=str(dirpath))
+            ds._y_direct, ds._sw_direct = y, sw
+            return ds
+        X = np.load(os.path.join(dirpath, "X.npy"), mmap_mode="r")
+        readers = [
+            _DenseReader(X, y, sw, s, e)
+            for s, e in _ranges(n, block_rows)
+        ]
+        ds = cls(readers, n, d, block_rows, has_y=meta["has_y"],
+                 has_sw=meta["has_sw"], source=str(dirpath))
+        ds._y_direct, ds._sw_direct = y, sw
+        return ds
+
+
+# ---------------------------------------------------------------------------
+# readers (picklable, closure-free — a dataset built on file paths can
+# ride to worker processes)
+# ---------------------------------------------------------------------------
+
+def _ranges(n, block_rows):
+    return [(s, min(s + block_rows, n)) for s in range(0, n, block_rows)]
+
+
+def _pad0(arr, pad_rows):
+    arr = np.asarray(arr)
+    return np.concatenate(
+        [arr, np.zeros((pad_rows,) + arr.shape[1:], arr.dtype)]
+    )
+
+
+def _slice_ysw(y, sw, s, e):
+    out = {}
+    if y is not None:
+        out["y"] = np.asarray(y[s:e])
+    if sw is not None:
+        out["sw"] = np.ascontiguousarray(
+            np.asarray(sw[s:e]).reshape(-1), dtype=np.float32
+        )
+    return out
+
+
+class _DenseReader:
+    __slots__ = ("X", "y", "sw", "s", "e")
+
+    def __init__(self, X, y, sw, s, e):
+        self.X, self.y, self.sw, self.s, self.e = X, y, sw, s, e
+
+    def __call__(self):
+        X = np.asarray(self.X[self.s:self.e])
+        if X.ndim == 1:
+            X = X.reshape(-1, 1)
+        out = {"X": np.ascontiguousarray(X, dtype=np.float32)}
+        out.update(_slice_ysw(self.y, self.sw, self.s, self.e))
+        return out
+
+
+class _CsrDenseReader:
+    __slots__ = ("X", "y", "sw", "s", "e")
+
+    def __init__(self, X, y, sw, s, e):
+        self.X, self.y, self.sw, self.s, self.e = X, y, sw, s, e
+
+    def __call__(self):
+        out = {"X": np.ascontiguousarray(
+            self.X[self.s:self.e].toarray(), dtype=np.float32
+        )}
+        out.update(_slice_ysw(self.y, self.sw, self.s, self.e))
+        return out
+
+
+class _CsrPackedReader:
+    __slots__ = ("X", "y", "sw", "s", "e", "m")
+
+    def __init__(self, X, y, sw, s, e, m):
+        self.X, self.y, self.sw, self.s, self.e, self.m = X, y, sw, s, e, m
+
+    def __call__(self):
+        from .sparse import PackedX, pack_csr_rows
+
+        sub = self.X[self.s:self.e]
+        idx, val = pack_csr_rows(sub)
+        width = idx.shape[1]
+        if width < self.m:  # pack to the DATASET-wide width
+            padw = self.m - width
+            idx = np.concatenate(
+                [idx, np.zeros((idx.shape[0], padw), idx.dtype)], axis=1
+            )
+            val = np.concatenate(
+                [val, np.zeros((val.shape[0], padw), val.dtype)], axis=1
+            )
+        out = {"X": PackedX(idx, val, self.X.shape[1])}
+        out.update(_slice_ysw(self.y, self.sw, self.s, self.e))
+        return out
+
+
+class _PackedPairReader:
+    __slots__ = ("idx", "val", "y", "sw", "s", "e", "d")
+
+    def __init__(self, idx, val, y, sw, s, e, d):
+        self.idx, self.val = idx, val
+        self.y, self.sw, self.s, self.e, self.d = y, sw, s, e, d
+
+    def __call__(self):
+        from .sparse import PackedX
+
+        out = {"X": PackedX(
+            np.ascontiguousarray(self.idx[self.s:self.e]),
+            np.ascontiguousarray(self.val[self.s:self.e]),
+            self.d,
+        )}
+        out.update(_slice_ysw(self.y, self.sw, self.s, self.e))
+        return out

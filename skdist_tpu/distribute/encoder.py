@@ -87,6 +87,10 @@ class Encoderizer(BaseEstimator, TransformerMixin):
 
     def transform(self, X):
         check_is_fitted(self, "transformer_lengths")
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            return self._transform_chunked(X)
         X = self._process_input(X, fit=False)
         weights = self.transformer_weights or {}
         Xs = []
@@ -101,6 +105,29 @@ class Encoderizer(BaseEstimator, TransformerMixin):
         if any(sparse.issparse(f) for f in Xs):
             return sparse.hstack(Xs).tocsr()
         return np.hstack([np.asarray(f) for f in Xs])
+
+    def _transform_chunked(self, dataset):
+        """ChunkedDataset pass-through: encode block by block, lazily —
+        the returned dataset's readers run this fitted encoder over
+        each raw block at stream time, so the feature-encoding step
+        never densifies (or even materialises) the whole input. Encoded
+        blocks are dense float32 (block-LOCAL densification of sparse
+        transformer output is bounded by block_rows); y/sample_weight
+        ride through untouched."""
+        fields = list(self.fields_)
+        out_width = int(np.sum(self.transformer_lengths))
+
+        def encode(block, start, stop):
+            raw = block["X"]
+            if hasattr(raw, "toarray"):
+                raw = raw.toarray()
+            frame = DataFrame(np.asarray(raw), columns=fields)
+            enc = self.transform(frame)
+            if sparse.issparse(enc):
+                enc = enc.toarray()
+            return {"X": np.ascontiguousarray(enc, dtype=np.float32)}
+
+        return dataset.map_blocks(encode, n_features=out_width)
 
     def fit_transform(self, X, y=None, **fit_params):
         return self.fit(X, y).transform(X)

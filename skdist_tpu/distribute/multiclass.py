@@ -374,13 +374,21 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
         self.n_jobs = n_jobs
         self.verbose = verbose
 
-    def fit(self, X, y, **fit_params):
+    def fit(self, X, y=None, **fit_params):
         check_estimator_backend(self, self.verbose)
         if self.method not in ("ratio", "multiplier"):
             raise ValueError(
                 "Unknown method. Options are 'ratio' or 'multiplier'."
             )
         backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            return self._fit_streamed(backend, X, y, fit_params)
+        if y is None:
+            raise TypeError(
+                "fit requires y (only a ChunkedDataset carries labels)"
+            )
         Y, classes, multilabel = _label_matrix(y)
         self.classes_ = classes
         self.multilabel_ = multilabel
@@ -400,6 +408,142 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
             done = self._try_batched(backend, X, Y, sample_weight=sw)
         if done is None:
             self._fit_generic(backend, X, Y, fit_params)
+        self.estimator = clone(self.estimator)
+        strip_runtime(self)
+        return self
+
+    # -- streamed out-of-core path --------------------------------------
+    def _fit_streamed(self, backend, dataset, y, fit_params):
+        """OvR over a ChunkedDataset: the class axis is the task axis
+        of ONE streamed fit — every class's binary problem consumes the
+        same block stream (labels binarised ON DEVICE per task from the
+        encoded label vector), so the data is read once per solver
+        pass regardless of the class count. No host fallback exists
+        for out-of-core input, so unsupported configurations raise
+        with the resident-path remedy."""
+        import jax.numpy as jnp
+
+        from ..models.linear import (
+            _annotate_stream_meta, _freeze, hyper_float,
+        )
+        from ..models.streaming import stream_fit_tasks
+
+        est = self.estimator
+        est_cls = type(est)
+        if getattr(est_cls, "_stream_fit_kind", None) is None:
+            raise ValueError(
+                f"{est_cls.__name__} has no streamed fit driver; "
+                "ChunkedDataset OvR supports the linear families"
+            )
+        if self.max_negatives is not None:
+            raise ValueError(
+                "max_negatives down-sampling needs per-class row draws "
+                "over resident X; not supported with ChunkedDataset "
+                "input"
+            )
+        if getattr(est, "class_weight", None) is not None:
+            raise ValueError(
+                "class_weight does not map onto the streamed {0,1} "
+                "binary sub-problems; fit with resident X for "
+                "class-weighted OvR"
+            )
+        if getattr(est, "engine", None) == "host":
+            raise ValueError(
+                "engine='host' cannot fit a ChunkedDataset; use "
+                "engine='auto'/'xla'"
+            )
+        if y is None:
+            y = dataset.load_y()
+        y = np.asarray(y)
+        if y.ndim != 1 and not (y.ndim == 2 and y.shape[1] == 1):
+            raise ValueError(
+                "multilabel y is not supported with ChunkedDataset "
+                "input (pass 1-D multiclass labels)"
+            )
+        y = y.reshape(-1)
+        sw, sw_ok = full_length_sample_weight(fit_params, dataset.n_rows)
+        if not sw_ok:
+            raise ValueError(
+                "streamed OvR supports only a full-length sample_weight "
+                f"fit param; got {sorted(fit_params)}"
+            )
+        if sw is None:
+            sw = dataset.load_sw()
+        classes, y_enc = np.unique(y, return_inverse=True)
+        y_enc = y_enc.astype(np.int32)
+        self.classes_ = classes
+        self.multilabel_ = False
+        k = len(classes)
+        self.binary_ = k == 2
+        from ..models.linear import prepare_sample_weight
+
+        sw_arr = prepare_sample_weight(sw, dataset.n_rows)
+        # binary sub-problem meta: classes {0, 1} exactly like the
+        # resident batched path's _binary_prep
+        meta = _annotate_stream_meta({
+            "n_features": dataset.n_features,
+            "classes": np.arange(2, dtype=np.int64),
+            "n_classes": 2,
+            "cw_arr": None,
+        }, dataset)
+        static = _freeze(est._static_config(meta))
+        # task axis = class columns (the positive column only for
+        # binary y, mirroring the resident reduction)
+        task_cls = np.array([1], np.int32) if self.binary_ else \
+            np.arange(k, dtype=np.int32)
+        counts = np.bincount(y_enc, minlength=k)
+        n = dataset.n_rows
+        degenerate = (counts == 0) | (counts == n)
+        live = np.asarray(
+            [c for c in task_cls if not degenerate[c]], np.int32
+        )
+        estimators = [None] * len(task_cls)
+        if live.size:
+            hyper = {
+                name: np.full(
+                    live.size, float(hyper_float(getattr(est, name))),
+                    np.float32,
+                )
+                for name in est_cls._hyper_names
+            }
+            if est_cls._stream_fit_kind == "gram" and "alpha" not in hyper:
+                hyper["alpha"] = np.full(
+                    live.size, float(hyper_float(est.alpha)), np.float32
+                )
+            task_args = {"hyper": hyper, "cls": live}
+
+            def derive(block, task):
+                yb = (block["y"] == task["cls"]).astype(jnp.int32)
+                return block["X"], yb, block["sw"], task["hyper"]
+
+            params = stream_fit_tasks(
+                backend, est_cls, meta, static, dataset,
+                {"y": y_enc, "sw": sw_arr}, task_args, derive=derive,
+                key_extra=("ovr",),
+            )
+            _warn_nonfinite_lanes(
+                params,
+                lambda i: f"class {classes[live[i]]!r}",
+                "one-vs-rest",
+            )
+            for pos, cls_idx in enumerate(live):
+                sl = {
+                    key: np.asarray(v)[pos] for key, v in params.items()
+                }
+                col = int(np.where(task_cls == cls_idx)[0][0])
+                estimators[col] = _make_fitted_binary(est, sl, meta)
+        for col, cls_idx in enumerate(task_cls):
+            if not degenerate[cls_idx]:
+                continue
+            warnings.warn(
+                f"Label {self._col_label(col)} is present in "
+                f"{'all' if counts[cls_idx] == n else 'no'} training "
+                "examples."
+            )
+            cp = _ConstantPredictor()
+            cp.y_ = np.array([1 if counts[cls_idx] == n else 0])
+            estimators[col] = cp
+        self.estimators_ = estimators
         self.estimator = clone(self.estimator)
         strip_runtime(self)
         return self
@@ -768,6 +912,15 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
 
     def fit(self, X, y, **fit_params):
         check_estimator_backend(self, self.verbose)
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            raise NotImplementedError(
+                "DistOneVsOneClassifier does not stream ChunkedDataset "
+                "input yet (pair-masked fits are planned on the same "
+                "task axis as the streamed OvR); use "
+                "DistOneVsRestClassifier or resident X"
+            )
         backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
         y = np.asarray(y)
         self.classes_ = np.unique(y)

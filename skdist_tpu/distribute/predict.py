@@ -216,12 +216,18 @@ def batch_predict(model, X, method="predict", backend=None,
     repeated per call.
     """
     backend = resolve_backend(backend)
+    if _plan is None:
+        _plan = device_predict_plan(model, method)
+
+    from ..data import is_chunked
+
+    if is_chunked(X):
+        return _batch_predict_chunked(model, X, method, backend, _plan)
+
     fn = getattr(model, method)
     n = X.shape[0] if hasattr(X, "shape") else len(X)
     if batch_size is None:
-        batch_size = max(1, min(n, 1 << 18))
-    if _plan is None:
-        _plan = device_predict_plan(model, method)
+        batch_size = _default_batch_size(n, backend, _plan)
 
     if _is_sparse_2d(X):
         device_out = _try_device_predict_sparse(
@@ -261,6 +267,150 @@ def batch_predict(model, X, method="predict", backend=None,
     ]
     outs = backend.run_tasks(lambda c: np.asarray(fn(c)), chunks)
     return np.concatenate(outs, axis=0)
+
+
+#: historical staging ceiling — now only the UPPER clamp of the
+#: HBM-derived default block size (and the CPU fallback, where the
+#: device reports no memory stats)
+_MAX_DEFAULT_BATCH = 1 << 18
+
+
+def _default_batch_size(n, backend, plan):
+    """Default rows per predict block: derived from the backend's free
+    device memory (``hbm_round_cap`` billed per ROW — argument + output
+    bytes), clamped at the historical ``1 << 18`` ceiling, so
+    wide-feature dense blocks can no longer overshoot HBM just because
+    the old fixed constant assumed narrow rows. CPU backends (no
+    memory stats) keep the historical ceiling."""
+    cap = None
+    if plan is not None:
+        bytes_per_row = 4 * (int(plan.n_features) + int(plan.out_width))
+        cap = backend.hbm_round_cap(bytes_per_row)
+    size = _MAX_DEFAULT_BATCH if cap is None else min(
+        _MAX_DEFAULT_BATCH, int(cap)
+    )
+    return max(1, min(n, size))
+
+
+def _batch_predict_chunked(model, dataset, method, backend, plan):
+    """Stream a ChunkedDataset through the model's block-inference
+    program: blocks are read + device-placed one ahead of the dispatch
+    (``BlockFeeder``), every dispatch is the SAME compiled executable a
+    resident block of this shape runs (``DevicePredictPlan`` →
+    ``BatchedPlan``), and only the per-block OUTPUTS accumulate on host
+    — a 100M-row predict holds ~two blocks of X resident, never the
+    matrix. Output is byte-identical to the blocked resident path: same
+    kernels, same block shapes, same padding rule.
+
+    Host (non-JAX) models fall back to a serial block loop through
+    their own ``predict`` — still bounded memory, no device programs.
+    """
+    import jax
+
+    from ..parallel import faults
+    from ..parallel.backend import BlockFeeder, _RetryState, _RoundFault
+
+    n = dataset.n_rows
+    if plan is None:
+        # host model: block loop through the model's own method —
+        # bounded host memory is the contract, speed is not
+        from ..data import packed_block_dense
+
+        fn = getattr(model, method)
+        outs = []
+        for i in range(dataset.n_blocks):
+            b = dataset.read_block(i, pad=False)
+            Xb = b.X
+            if hasattr(Xb, "idx"):  # PackedX → scipy for host models
+                from scipy import sparse as sp
+
+                Xb = sp.csr_matrix(packed_block_dense(Xb, b.n_real))
+            outs.append(np.asarray(fn(Xb)))
+        return np.concatenate(outs, axis=0)
+
+    bplan = backend.prepare_batched(
+        plan.block_kernel(), {"params": plan.params},
+        cache_key=plan.cache_key(),
+    )
+    stats = backend.last_round_stats = {
+        "mode": "streamed_predict", "rounds": 0, "retries": 0,
+    }
+    sync = bool(getattr(backend, "sync_rounds", False))
+
+    # blocks ride the TASK axis in groups of the mesh's task slots (a
+    # LocalBackend group is one block — the resident-parity shape); the
+    # tail group pads by repeating its last block, outputs sliced off
+    slots = max(1, int(bplan.n_task_slots))
+    n_blocks = dataset.n_blocks
+    groups = [
+        list(range(s, min(s + slots, n_blocks)))
+        for s in range(0, n_blocks, slots)
+    ]
+
+    def read(gi):
+        idxs = groups[gi]
+        trees = [dataset.read_block(i, pad=True).X for i in idxs]
+        while len(trees) < slots:
+            trees.append(trees[-1])
+        return {"X": jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees
+        )}
+
+    feeder = BlockFeeder(read, len(groups), bplan.put,
+                         sync=sync, stats=stats)
+    retry = _RetryState()
+    outs = {}
+    pending = []  # [(group_idx, dev_out)]
+
+    def drain_one():
+        gi, dev_out = pending[0]
+        out = np.asarray(bplan.gather(dev_out)["out"])  # may raise
+        pending.pop(0)
+        for j, bi in enumerate(groups[gi]):
+            start, stop = dataset.block_range(bi)
+            outs[bi] = out[j][: stop - start]
+
+    def salvage(exc, gi):
+        """Classify a dispatch- or gather-time fault and rewind the
+        feeder to the earliest group whose output has not landed —
+        the reader re-opens at exactly that offset."""
+        kind = faults.classify(exc)
+        if not faults.is_retryable(kind):
+            raise exc
+        retry.admit(_RoundFault([], 0, exc, kind), gi)
+        stats["retries"] = retry.total
+        resume = pending[0][0] if pending else gi
+        pending.clear()
+        feeder.seek(resume)
+
+    injector = faults.active_injector()
+    try:
+        while len(outs) < n_blocks:
+            item = feeder.next()
+            if item is not None:
+                gi, dev = item
+                try:
+                    if injector is not None:
+                        injector.round_dispatched()
+                    dev_out = bplan.run_async_placed(dev)
+                except Exception as exc:
+                    salvage(exc, gi)
+                    continue
+                pending.append((gi, dev_out))
+                stats["rounds"] += 1
+                if len(pending) < 2:
+                    continue  # keep one round in flight (depth 2)
+            elif not pending:
+                break  # exhausted with nothing in flight
+            gi = pending[0][0]
+            try:
+                drain_one()
+            except Exception as exc:  # async fault at the gather:
+                salvage(exc, gi)     # seek re-feeds the lost groups
+    finally:
+        feeder.close()
+    out = np.concatenate([outs[i] for i in range(n_blocks)], axis=0)
+    return plan.postprocess(out)
 
 
 def _is_sparse_2d(X):

@@ -391,6 +391,73 @@ def _resolve_device_scoring(estimator, scoring):
     return specs
 
 
+def _resolve_stream_scoring(estimator, scoring, y=None):
+    """Map ``scoring`` to streamed scorer specs ``[(out_name, metric)]``
+    or raise — the streamed search has no host fallback, so an
+    unsupported metric must say so instead of silently degrading."""
+    from ..metrics import STREAM_SCORERS
+
+    if scoring is None:
+        names = [("score", default_device_scorer(estimator))]
+    elif isinstance(scoring, str):
+        names = [("score", scoring)]
+    elif isinstance(scoring, (list, tuple, set)):
+        names = [(s, s) for s in scoring]
+    else:
+        raise ValueError(
+            "streamed search scoring must be None, a metric name, or a "
+            "list of metric names (callable scorers need resident "
+            f"predictions); got {scoring!r}"
+        )
+    classes = np.unique(y) if y is not None else None
+    for _out, metric in names:
+        if metric not in STREAM_SCORERS:
+            raise ValueError(
+                f"scoring={metric!r} has no streamed (decomposable) "
+                "kernel; streamed search supports "
+                f"{sorted(STREAM_SCORERS)}"
+            )
+        if metric in BINARY_ONLY_SCORERS and not \
+                device_scorer_compatible(metric, classes):
+            raise ValueError(
+                f"scoring={metric!r} is binary-only with positive "
+                "class 1; this label set needs a resident fit"
+            )
+    return names
+
+
+def _partition_fold_ids(splits, n):
+    """Collapse CV splits into one ``(n,)`` fold-id vector — the O(n)
+    representation the streamed CV path slices per block. Requires the
+    splits to PARTITION the rows with complementary train sets
+    (KFold/StratifiedKFold-style); overlapping or subsampling splitters
+    would need per-split masks, which is exactly the O(n_splits · n)
+    host state streaming exists to avoid."""
+    fold_id = np.full(n, -1, dtype=np.int32)
+    for s, (train, test) in enumerate(splits):
+        test = np.asarray(test)
+        if (fold_id[test] != -1).any():
+            raise ValueError(
+                "streamed search needs partition-style CV (each row in "
+                "exactly one test fold, train = complement), e.g. "
+                "KFold/StratifiedKFold; this splitter assigns rows to "
+                "multiple test folds"
+            )
+        fold_id[test] = s
+        if len(train) + len(test) != n:
+            raise ValueError(
+                "streamed search needs partition-style CV with "
+                "train = complement of test (KFold/StratifiedKFold); "
+                f"split {s} covers {len(train) + len(test)} of {n} rows"
+            )
+    if (fold_id == -1).any():
+        raise ValueError(
+            "streamed search needs partition-style CV: "
+            f"{int((fold_id == -1).sum())} rows appear in no test fold"
+        )
+    return fold_id
+
+
 #: sample-axis layout of the CV shared dict (consumed by
 #: parallel.row_sharded_specs on 2D meshes)
 _CV_SAMPLE_AXES = {
@@ -634,8 +701,15 @@ class DistBaseSearchCV(BaseEstimator):
         process kill resumes past its finished tasks."""
         from sklearn.model_selection import check_cv
 
+        from ..data import is_chunked
+
         check_error_score(self.error_score)
         check_adaptive(self.adaptive)
+        if is_chunked(X) and y is None:
+            # out-of-core input: the dataset carries its own labels
+            # (O(n) host bytes — bounded by design); splitters, class
+            # discovery, and scoring below all read this host vector
+            y = X.load_y()
         # per-fit adaptive bookkeeping (consumed below, deleted before
         # the artifact is finalized)
         self._adaptive_engaged_ = False
@@ -653,7 +727,14 @@ class DistBaseSearchCV(BaseEstimator):
                 f"Fitting {n_splits} folds for each of {n_candidates} "
                 f"candidates, totalling {n_candidates * n_splits} fits"
             )
-        splits = list(cv.split(X, y, groups))
+        # splitters index rows, not features: chunked X is presented to
+        # them as an (n, 0) stand-in (0 bytes) — fold membership is a
+        # function of n/y/groups alone for every sklearn splitter
+        split_X = (
+            np.empty((len(X), 0), dtype=np.float32) if is_chunked(X)
+            else X
+        )
+        splits = list(cv.split(split_X, y, groups))
 
         scorers, multimetric = check_multimetric_scoring(estimator, self.scoring)
         self.multimetric_ = multimetric
@@ -661,6 +742,13 @@ class DistBaseSearchCV(BaseEstimator):
 
         ckpt_dir = faults.resolve_checkpoint_dir(checkpoint_dir)
         checkpoint = None
+        if ckpt_dir is not None and is_chunked(X):
+            warnings.warn(
+                "durable search checkpoints are not yet supported for "
+                "ChunkedDataset input (the grid signature would need a "
+                "streaming data digest); running without checkpointing"
+            )
+            ckpt_dir = None
         if ckpt_dir is not None:
             checkpoint = faults.SearchCheckpoint(
                 ckpt_dir,
@@ -748,6 +836,16 @@ class DistBaseSearchCV(BaseEstimator):
         score dicts in task order (candidate-major, split fastest).
         With a ``checkpoint``, journaled tasks are restored instead of
         re-fit and fresh completions are journaled as they land."""
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            # out-of-core input has exactly one execution path: the
+            # streamed device drivers. Anything unsupported raises with
+            # a remedy — there is no host fallback that could hold X.
+            return self._run_streamed_search(
+                backend, estimator, X, y, candidate_params, splits,
+                fit_params,
+            )
         n_splits = len(splits)
         batched = None
         # the batched device path handles the one array-valued fit
@@ -1208,6 +1306,143 @@ class DistBaseSearchCV(BaseEstimator):
         _quarantine_nonfinite(
             out, self.error_score, exempt=set(self._rung_killed_gids_)
         )
+        return out
+
+    def _run_streamed_search(self, backend, estimator, dataset, y,
+                             candidate_params, splits, fit_params):
+        """The out-of-core CV search: (candidate × fold) tasks fit
+        through the family's streamed driver (``models/streaming``) —
+        fold selection is an O(n) fold-id vector sliced per block and
+        composed into the fit weights on device — then one streamed
+        scoring pass accumulates each task's decomposable metric
+        statistics. Everything X-sized stays on disk; per-task results
+        feed the ordinary ``_format_results`` schema."""
+        import jax.numpy as jnp
+
+        from ..models.linear import _freeze, hyper_float
+        from ..models.streaming import stream_fit_tasks, stream_scores
+
+        if self.preds:
+            raise ValueError(
+                "preds=True needs resident out-of-fold predictions; "
+                "not supported with ChunkedDataset input"
+            )
+        est_cls = type(estimator)
+        if getattr(est_cls, "_stream_fit_kind", None) is None:
+            raise ValueError(
+                f"{est_cls.__name__} has no streamed fit driver; "
+                "ChunkedDataset search supports the linear families "
+                "(LogisticRegression, LinearSVC, SGDClassifier, the "
+                "Ridge family). Materialise the dataset for other "
+                "estimators."
+            )
+        if getattr(estimator, "engine", None) == "host":
+            raise ValueError(
+                "engine='host' cannot fit a ChunkedDataset (the f64 "
+                "host engine needs X resident); use engine='auto'/'xla'"
+            )
+        scorer_specs = _resolve_stream_scoring(estimator, self.scoring, y)
+        n = dataset.n_rows
+        n_splits = len(splits)
+        sw_param, sw_ok = full_length_sample_weight(fit_params, n)
+        extra = [k for k in fit_params if k != "sample_weight"]
+        if not sw_ok or extra:
+            raise ValueError(
+                "streamed search supports only a full-length "
+                f"sample_weight fit param; got {sorted(fit_params)}"
+            )
+        sw = sw_param if sw_param is not None else dataset.load_sw()
+        fold_id = _partition_fold_ids(splits, n)
+        buckets = _candidate_buckets(estimator, candidate_params)
+        if buckets is None:
+            raise ValueError(
+                "streamed search candidates may only vary the "
+                "estimator's batchable hypers "
+                f"({getattr(est_cls, '_hyper_names', ())}) and declared "
+                f"statics ({getattr(est_cls, '_static_names', ())})"
+            )
+        out = [None] * (len(candidate_params) * n_splits)
+        hyper_names = list(getattr(est_cls, "_hyper_names", ()))
+        if est_cls._stream_fit_kind == "gram" and "alpha" not in hyper_names:
+            hyper_names.append("alpha")  # LinearRegression's fixed 0.0
+
+        def derive(block, task):
+            # fold masking by weights, the batched path's idiom: user
+            # sample_weight weights the FIT; scoring uses raw masks
+            fit_w = block["sw"] * (
+                block["fold"] != task["split"]
+            ).astype(jnp.float32)
+            return block["X"], block["y"], fit_w, task["hyper"]
+
+        # scoring weights are raw fold masks (sklearn scorers called
+        # without sample_weight). Tail-padding rows carry fold id -1:
+        # that never EQUALS a split id (test mask safe by construction)
+        # but it does DIFFER from every split id, so the train mask
+        # must exclude it explicitly — a padded zero row would
+        # otherwise score as a correct class-0 hit
+        weight_fns = {
+            "test": lambda block, task: (
+                block["fold"] == task["split"]
+            ).astype(jnp.float32),
+        }
+        if self.return_train_score:
+            weight_fns["train"] = lambda block, task: (
+                (block["fold"] != task["split"]) & (block["fold"] >= 0)
+            ).astype(jnp.float32)
+
+        for static_overrides, cand_indices in buckets.values():
+            bucket_est = clone(estimator)
+            if static_overrides:
+                bucket_est.set_params(**static_overrides)
+            y_enc, sw_arr, meta = bucket_est._prep_stream_fit(
+                dataset, y, sw
+            )
+            static_cfg = bucket_est._static_config(meta)
+            static = _freeze(static_cfg)
+            task_hyper = {name: [] for name in hyper_names}
+            split_ids, gids = [], []
+            for cand_idx in cand_indices:
+                cand = candidate_params[cand_idx]
+                for s in range(n_splits):
+                    for name in hyper_names:
+                        task_hyper[name].append(float(hyper_float(
+                            cand.get(name, getattr(bucket_est, name))
+                        )))
+                    split_ids.append(s)
+                    gids.append(cand_idx * n_splits + s)
+            task_args = {
+                "hyper": {
+                    k: np.asarray(v, dtype=np.float32)
+                    for k, v in task_hyper.items()
+                },
+                "split": np.asarray(split_ids, dtype=np.int32),
+            }
+            row_arrays = {"y": y_enc, "sw": sw_arr, "fold": fold_id}
+            t0 = time.perf_counter()
+            # key_extra distinguishes this fold-masked derive from the
+            # plain single-fit derive in the structural compile keys —
+            # same family/static/meta, different program
+            params = stream_fit_tasks(
+                backend, est_cls, meta, static, dataset, row_arrays,
+                task_args, derive=derive, key_extra=("cv",),
+            )
+            fit_wall = time.perf_counter() - t0
+            stats = backend.last_round_stats
+            t0 = time.perf_counter()
+            scores = stream_scores(
+                backend, est_cls, meta, static, dataset, row_arrays,
+                task_args, params, scorer_specs, weight_fns,
+                stats=stats, key_extra=("cv",),
+            )
+            score_wall = time.perf_counter() - t0
+            per_fit = fit_wall / max(len(gids), 1)
+            per_score = score_wall / max(len(gids), 1)
+            for t, gid in enumerate(gids):
+                row = {k: float(v[t]) for k, v in scores.items()}
+                row["fit_time"] = per_fit
+                row["score_time"] = per_score
+                out[gid] = row
+        _quarantine_nonfinite(out, self.error_score, context="streamed")
         return out
 
     @staticmethod

@@ -200,6 +200,148 @@ DEVICE_SCORERS = {
 BINARY_ONLY_SCORERS = {"f1", "roc_auc"}
 
 
+# ---------------------------------------------------------------------------
+# streamed (decomposable) scorer kernels
+# ---------------------------------------------------------------------------
+# The out-of-core scoring pass (models/streaming.stream_scores) cannot
+# hold all predictions at once: each metric instead accumulates
+# per-block SUFFICIENT STATISTICS on device (a dict of weighted sums /
+# a confusion matrix, summed across blocks) and a host ``combine``
+# finishes. Every statistic is exactly additive over row blocks, so the
+# streamed score differs from the resident kernel only by f32 summation
+# order. roc_auc has no bounded sufficient statistic (it needs the full
+# score ranking) and is deliberately absent.
+
+def _acc_stats(y, out, w, meta):
+    correct = (_pred_idx(out) == y).astype(jnp.float32)
+    return {"num": _wsum(correct, w), "den": jnp.sum(w)}
+
+
+def _ratio_combine(parts, meta):
+    return float(parts["num"]) / max(float(parts["den"]), 1e-12)
+
+
+def _confusion_stats(y, out, w, meta):
+    return {"C": _confusion(y, out, w, meta["n_classes"])}
+
+
+def _np_prf(C):
+    tp = np.diag(C)
+    support = C.sum(axis=1)
+    pred_tot = C.sum(axis=0)
+    precision = tp / np.maximum(pred_tot, 1e-12)
+    recall = tp / np.maximum(support, 1e-12)
+    f1 = 2 * precision * recall / np.maximum(precision + recall, 1e-12)
+    return precision, recall, f1, support
+
+
+def _combine_f1(average):
+    def combine(parts, meta):
+        C = np.asarray(parts["C"], dtype=np.float64)
+        precision, recall, f1, support = _np_prf(C)
+        if average == "micro":
+            return float(np.sum(np.diag(C)) / max(np.sum(C), 1e-12))
+        if average == "macro":
+            present = (support > 0) | (C.sum(axis=0) > 0)
+            return float(
+                np.sum(np.where(present, f1, 0.0))
+                / max(np.sum(present.astype(np.float64)), 1e-12)
+            )
+        if average == "binary":
+            return float(f1[meta["n_classes"] - 1])
+        return float(
+            np.sum(f1 * support) / max(np.sum(support), 1e-12)
+        )
+
+    return combine
+
+
+def _combine_precision_weighted(parts, meta):
+    C = np.asarray(parts["C"], dtype=np.float64)
+    precision, _r, _f, support = _np_prf(C)
+    return float(np.sum(precision * support) / max(np.sum(support), 1e-12))
+
+
+def _combine_recall_weighted(parts, meta):
+    C = np.asarray(parts["C"], dtype=np.float64)
+    _p, recall, _f, support = _np_prf(C)
+    return float(np.sum(recall * support) / max(np.sum(support), 1e-12))
+
+
+def _combine_balanced_accuracy(parts, meta):
+    C = np.asarray(parts["C"], dtype=np.float64)
+    _p, recall, _f, support = _np_prf(C)
+    present = support > 0
+    return float(
+        np.sum(np.where(present, recall, 0.0))
+        / max(np.sum(present.astype(np.float64)), 1e-12)
+    )
+
+
+def _nll_stats(y, proba, w, meta):
+    p = jnp.clip(proba, 1e-15, 1.0 - 1e-15)
+    ll = jnp.sum(jax.nn.one_hot(y, meta["n_classes"]) * jnp.log(p), axis=1)
+    return {"num": _wsum(ll, w), "den": jnp.sum(w)}
+
+
+def _sq_err_stats(y, pred, w, meta):
+    return {"num": _wsum((y - pred) ** 2, w), "den": jnp.sum(w)}
+
+
+def _abs_err_stats(y, pred, w, meta):
+    return {"num": _wsum(jnp.abs(y - pred), w), "den": jnp.sum(w)}
+
+
+def _neg_ratio_combine(parts, meta):
+    return -_ratio_combine(parts, meta)
+
+
+def _neg_root_ratio_combine(parts, meta):
+    return -float(np.sqrt(_ratio_combine(parts, meta)))
+
+
+def _r2_stats(y, pred, w, meta):
+    return {
+        "sw": jnp.sum(w),
+        "swy": _wsum(y, w),
+        "swy2": _wsum(y * y, w),
+        "sres": _wsum((y - pred) ** 2, w),
+    }
+
+
+def _r2_combine(parts, meta):
+    sw = max(float(parts["sw"]), 1e-12)
+    ybar = float(parts["swy"]) / sw
+    ss_tot = float(parts["swy2"]) - sw * ybar * ybar
+    return 1.0 - float(parts["sres"]) / max(ss_tot, 1e-12)
+
+
+#: name → (block-stats kernel, host combine, required output kind) —
+#: the streamed counterpart of DEVICE_SCORERS (same names, same
+#: greater-is-better convention)
+STREAM_SCORERS = {
+    "accuracy": (_acc_stats, _ratio_combine, "decision"),
+    "f1": (_confusion_stats, _combine_f1("binary"), "decision"),
+    "f1_macro": (_confusion_stats, _combine_f1("macro"), "decision"),
+    "f1_micro": (_confusion_stats, _combine_f1("micro"), "decision"),
+    "f1_weighted": (_confusion_stats, _combine_f1("weighted"), "decision"),
+    "precision_weighted": (
+        _confusion_stats, _combine_precision_weighted, "decision"),
+    "recall_weighted": (
+        _confusion_stats, _combine_recall_weighted, "decision"),
+    "balanced_accuracy": (
+        _confusion_stats, _combine_balanced_accuracy, "decision"),
+    "neg_log_loss": (_nll_stats, _ratio_combine, "proba"),
+    "r2": (_r2_stats, _r2_combine, "predict"),
+    "neg_mean_squared_error": (
+        _sq_err_stats, _neg_ratio_combine, "predict"),
+    "neg_root_mean_squared_error": (
+        _sq_err_stats, _neg_root_ratio_combine, "predict"),
+    "neg_mean_absolute_error": (
+        _abs_err_stats, _neg_ratio_combine, "predict"),
+}
+
+
 def device_scorer_supported(name):
     return name in DEVICE_SCORERS
 

@@ -276,6 +276,17 @@ def _annotate_x_meta(meta, X):
     return meta
 
 
+def _annotate_stream_meta(meta, dataset):
+    """The ChunkedDataset analogue of :func:`_annotate_x_meta`: a
+    packed dataset's blocks run the packed kernels, and the
+    representation participates in the structural compile keys exactly
+    as on the resident path."""
+    if getattr(dataset, "x_format", "dense") == "packed":
+        meta["x_format"] = "packed"
+        meta["x_matvec"] = resolve_matvec_mode()
+    return meta
+
+
 def _linear_op(X, fit_intercept, meta, matmul_dtype=None):
     """The one construction point of the fit problems' matvec
     interface (``sparse.LinearOperator``): dense X reproduces the
@@ -338,8 +349,28 @@ class _LinearModelBase(BaseEstimator):
     #: flag always receive dense input from :func:`prepare_fit_X`
     _supports_packed_X = True
 
+    #: streamed-fit family kind consumed by ``models/streaming.py``:
+    #: "lbfgs" (block-accumulated value/grad), "sgd" (block-stream
+    #: epochs), "gram" (block-accumulated normal equations); None =
+    #: family has no out-of-core fit
+    _stream_fit_kind = None
+
     # ---- host-facing API -------------------------------------------------
-    def fit(self, X, y, sample_weight=None):
+    def fit(self, X, y=None, sample_weight=None):
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            # out-of-core path: blocks stream through the backend's
+            # double-buffered pipeline; labels/weights ride the dataset
+            # (or come explicitly) as O(n) host vectors
+            from .streaming import stream_fit_estimator
+
+            return stream_fit_estimator(self, X, y, sample_weight)
+        if y is None:
+            raise TypeError(
+                f"{type(self).__name__}.fit requires y (only a "
+                "ChunkedDataset carries its own labels)"
+            )
         # packed input has no host (f64 BLAS) form: under engine='auto'
         # the packed XLA path IS the sparse engine on every platform —
         # densifying a packable hashed-text input to reach scipy would
@@ -424,6 +455,15 @@ class _LinearModelBase(BaseEstimator):
 
     def decision_function(self, X):
         self._check_fitted()
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            raise TypeError(
+                "decision_function does not take a ChunkedDataset; use "
+                "skdist_tpu.distribute.batch_predict(model, dataset) "
+                "(or predict/predict_proba, which route there) to "
+                "stream inference block by block"
+            )
         # sparse predict input stays packed when packing wins — the
         # decision kernels are representation-polymorphic (matvec_any)
         X = prepare_fit_X(X, type(self))
@@ -497,6 +537,34 @@ def _split_Wb(W, d, fit_intercept, n_out):
 
 
 class _LinearClassifierBase(_LinearModelBase, ClassifierMixin):
+    def _prep_stream_fit(self, dataset, y, sample_weight=None):
+        """Streamed-fit prep: global label encoding + meta from O(n)
+        host vectors and the dataset's shape — no X materialisation.
+        Returns ``(y_idx (n,), sw (n,), meta)``; the streaming driver
+        slices both per block."""
+        if y is None:
+            raise ValueError(
+                f"{type(self).__name__} needs labels: the ChunkedDataset "
+                "carries none and no y was passed"
+            )
+        y_idx, classes = encode_labels(y)
+        sw = prepare_sample_weight(sample_weight, dataset.n_rows)
+        if getattr(self, "class_weight", None) == "balanced":
+            raise ValueError(
+                "class_weight='balanced' needs a global pass over the "
+                "masked weights and is not supported on the streamed "
+                "fit path yet; pass an explicit class_weight dict"
+            )
+        meta = _annotate_stream_meta({
+            "n_features": dataset.n_features,
+            "classes": classes,
+            "n_classes": len(classes),
+            "cw_arr": class_weight_vector(
+                getattr(self, "class_weight", None), classes
+            ),
+        }, dataset)
+        return y_idx, sw, meta
+
     def _prep_fit_data(self, X, y, sample_weight=None):
         y_idx, classes = encode_labels(y)
         sw = prepare_sample_weight(sample_weight, X.shape[0])
@@ -514,6 +582,12 @@ class _LinearClassifierBase(_LinearModelBase, ClassifierMixin):
         return data, meta
 
     def predict(self, X):
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            from ..distribute.predict import batch_predict
+
+            return batch_predict(self, X, method="predict")
         scores = self.decision_function(X)
         if scores.ndim == 1:
             idx = (scores > 0).astype(np.int64)
@@ -537,6 +611,19 @@ class _LbfgsFitMixin:
 
     #: batched-path marker consulted by the scheduler gates
     _supports_sliced_fit = True
+
+    #: out-of-core fit form: block-accumulated value/grad through the
+    #: streamed L-BFGS driver (models/streaming.py)
+    _stream_fit_kind = "lbfgs"
+
+    @classmethod
+    def _flat_w_width(cls, meta, static):
+        """Flat weight-vector width of this family's solve — what the
+        streamed driver allocates per task without tracing a kernel."""
+        st = dict(static)
+        p = meta["n_features"] + (1 if st["fit_intercept"] else 0)
+        k = meta.get("n_classes", 2)
+        return p if k <= 2 else p * k
 
     @classmethod
     def _batched_task_cost(cls, hyper):
@@ -747,7 +834,7 @@ class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
         unpenalized = penalty in (None, "none")
         bf16 = md == "bfloat16"
 
-        def problem(X, y_idx, sw, hyper):
+        def problem(X, y_idx, sw, hyper, parts=False):
             C = hyper["C"]
             # one matvec interface over dense AND packed-CSR X: the
             # operator reproduces the historical dense expressions
@@ -762,41 +849,65 @@ class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
             d = meta["n_features"]
             matvec = op.matvec
+            # the data term and regulariser are separable closures: the
+            # resident loss composes them in the historical expression
+            # order (numerics pinned), and the STREAMED fit evaluates
+            # data_loss per block (the term is row-additive) plus
+            # reg_loss once — `parts=True` is that second consumer
             if binary:
                 ypm = (y_idx == (k - 1)).astype(op.dtype)  # {0,1}
 
-                def loss(w):
+                def data_loss(w):
                     z = matvec(w)
-                    ce = jnp.sum(sw * (jax.nn.softplus(z) - ypm * z))
+                    return jnp.sum(sw * (jax.nn.softplus(z) - ypm * z))
+
+                def reg_loss(w):
                     if unpenalized:  # penalty=None: sklearn's C=inf
+                        return jnp.float32(0.0)
+                    return 0.5 / C * jnp.dot(w[:d], w[:d])
+
+                def loss(w):
+                    ce = data_loss(w)
+                    if unpenalized:
                         return ce
-                    reg = 0.5 / C * jnp.dot(w[:d], w[:d])
-                    return ce + reg
+                    return ce + reg_loss(w)
 
                 w0 = jnp.zeros(p, op.dtype)
 
                 def unpack(w, n_iter):
                     return {"W": w, "n_iter": n_iter}
 
+                if parts:
+                    return loss, w0, unpack, data_loss, reg_loss
                 return loss, w0, unpack
 
             onehot = jax.nn.one_hot(y_idx, k, dtype=op.dtype)
 
-            def loss(wflat):
+            def data_loss(wflat):
                 W = wflat.reshape(p, k)
                 logits = matvec(W)
                 lse = jax.nn.logsumexp(logits, axis=1)
-                ce = jnp.sum(sw * (lse - jnp.sum(onehot * logits, axis=1)))
+                return jnp.sum(sw * (lse - jnp.sum(onehot * logits, axis=1)))
+
+            def reg_loss(wflat):
                 if unpenalized:  # penalty=None: sklearn's C=inf
+                    return jnp.float32(0.0)
+                W = wflat.reshape(p, k)
+                return 0.5 / C * jnp.sum(W[:d] * W[:d])
+
+            def loss(wflat):
+                ce = data_loss(wflat)
+                if unpenalized:
                     return ce
-                reg = 0.5 / C * jnp.sum(W[:d] * W[:d])
-                return ce + reg
+                return ce + reg_loss(wflat)
 
             w0 = jnp.zeros(p * k, op.dtype)
 
             def unpack(w, n_iter):
                 return {"W": w.reshape(p, k), "n_iter": n_iter}
 
+            if parts:
+                return loss, w0, unpack, data_loss, reg_loss
             return loss, w0, unpack
 
         return problem
@@ -836,6 +947,12 @@ class LogisticRegression(_LbfgsFitMixin, _LinearClassifierBase):
 
     def predict_proba(self, X):
         self._check_fitted()
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            from ..distribute.predict import batch_predict
+
+            return batch_predict(self, X, method="predict_proba")
         X = prepare_fit_X(X, type(self))
         static = _freeze(self._static_config(self._meta))
         kernel = get_kernel(type(self), "proba", self._meta, static)
@@ -933,40 +1050,58 @@ class LinearSVC(_LbfgsFitMixin, _LinearClassifierBase):
             # not silently fit squared hinge (ADVICE r05 #3)
             raise ValueError("LinearSVC supports loss='squared_hinge'")
 
-        def problem(X, y_idx, sw, hyper):
+        def problem(X, y_idx, sw, hyper, parts=False):
             C = hyper["C"]
             # dense or packed-CSR X behind one matvec interface (see
-            # LogisticRegression._build_fit_problem)
+            # LogisticRegression._build_fit_problem); data/reg split as
+            # there — the squared-hinge sum is row-additive (streamed
+            # per block), the ridge term is evaluated once
             op = _linear_op(X, fit_intercept, meta)
             p = op.p
             sw = _apply_class_weight(sw, y_idx, k, class_weight, cw_arr)
             if binary:
                 ypm = jnp.where(y_idx == (k - 1), 1.0, -1.0).astype(op.dtype)
 
-                def loss(w):
+                def data_loss(w):
                     margin = jnp.maximum(0.0, 1.0 - ypm * op.matvec(w))
-                    return 0.5 * jnp.dot(w[:d], w[:d]) + C * jnp.sum(sw * margin**2)
+                    return C * jnp.sum(sw * margin**2)
+
+                def reg_loss(w):
+                    return 0.5 * jnp.dot(w[:d], w[:d])
+
+                def loss(w):
+                    return reg_loss(w) + data_loss(w)
 
                 w0 = jnp.zeros(p, op.dtype)
 
                 def unpack(w, n_iter):
                     return {"W": w, "n_iter": n_iter}
 
+                if parts:
+                    return loss, w0, unpack, data_loss, reg_loss
                 return loss, w0, unpack
 
             Ypm = jnp.where(jax.nn.one_hot(y_idx, k) > 0, 1.0, -1.0).astype(op.dtype)
 
-            def loss(wflat):
+            def data_loss(wflat):
                 W = wflat.reshape(p, k)
                 margins = jnp.maximum(0.0, 1.0 - Ypm * op.matvec(W))
-                hinge = jnp.sum(sw[:, None] * margins**2)
-                return 0.5 * jnp.sum(W[:d] * W[:d]) + C * hinge
+                return C * jnp.sum(sw[:, None] * margins**2)
+
+            def reg_loss(wflat):
+                W = wflat.reshape(p, k)
+                return 0.5 * jnp.sum(W[:d] * W[:d])
+
+            def loss(wflat):
+                return reg_loss(wflat) + data_loss(wflat)
 
             w0 = jnp.zeros(p * k, op.dtype)
 
             def unpack(w, n_iter):
                 return {"W": w.reshape(p, k), "n_iter": n_iter}
 
+            if parts:
+                return loss, w0, unpack, data_loss, reg_loss
             return loss, w0, unpack
 
         return problem
@@ -1012,13 +1147,13 @@ class SGDClassifier(_LinearClassifierBase):
     _static_names = (
         "max_iter", "fit_intercept", "class_weight", "loss", "penalty",
         "learning_rate", "batch_size", "random_state",
-        "n_iter_no_change",
+        "n_iter_no_change", "shuffle",
     )
 
     def __init__(self, loss="hinge", penalty="l2", alpha=1e-4, l1_ratio=0.15,
                  max_iter=20, tol=1e-3, fit_intercept=True, eta0=0.01,
                  learning_rate="optimal", class_weight=None, random_state=0,
-                 batch_size=64, n_iter_no_change=5):
+                 batch_size=64, n_iter_no_change=5, shuffle=True):
         self.loss = loss
         self.penalty = penalty
         self.alpha = alpha
@@ -1032,8 +1167,22 @@ class SGDClassifier(_LinearClassifierBase):
         self.random_state = random_state
         self.batch_size = batch_size
         self.n_iter_no_change = n_iter_no_change
+        # sklearn's SGD exposes shuffle too; shuffle=False is also what
+        # makes a block-streamed fit bitwise-comparable to the resident
+        # scan (consecutive batches don't cross row blocks)
+        self.shuffle = shuffle
 
     _supports_sliced_fit = True
+
+    #: out-of-core fit form: epochs as block streams (models/streaming)
+    _stream_fit_kind = "sgd"
+
+    @classmethod
+    def _flat_w_width(cls, meta, static):
+        st = dict(static)
+        p = meta["n_features"] + (1 if st["fit_intercept"] else 0)
+        k = meta.get("n_classes", 2)
+        return p if k <= 2 else p * k
 
     @classmethod
     def _batched_task_cost(cls, hyper):
@@ -1225,11 +1374,14 @@ class SGDClassifier(_LinearClassifierBase):
         max_iter, batch_size = st["max_iter"], st["batch_size"]
         n_iter_no_change = int(st["n_iter_no_change"])
 
+        shuffle = bool(st.get("shuffle", True))
+
         def kernel(X, y_idx, sw, hyper, aux=None):
             pb = problem(X, y_idx, sw, hyper)
             W, n_epochs = sgd_minimize(
                 pb["grad_fn"], pb["W0"], pb["n"], pb["key"], max_iter,
-                batch_size, pb["lr_fn"], loss_fn=pb["loss_fn"],
+                batch_size, pb["lr_fn"], shuffle=shuffle,
+                loss_fn=pb["loss_fn"],
                 tol=hyper["tol"], n_iter_no_change=n_iter_no_change,
                 post_step=pb["post_step"], post_state=pb["post_state"],
             )
@@ -1249,10 +1401,13 @@ class SGDClassifier(_LinearClassifierBase):
         n_iter_no_change = int(st["n_iter_no_change"])
         n_slice = int(n_slice)
 
+        shuffle = bool(st.get("shuffle", True))
+
         def resume(pb, carry, hyper):
             return sgd_resume(
                 pb["grad_fn"], carry, n_slice, pb["n"], pb["key"],
-                max_iter, batch_size, pb["lr_fn"], loss_fn=pb["loss_fn"],
+                max_iter, batch_size, pb["lr_fn"], shuffle=shuffle,
+                loss_fn=pb["loss_fn"],
                 tol=hyper["tol"], n_iter_no_change=n_iter_no_change,
                 post_step=pb["post_step"],
             )
@@ -1289,6 +1444,12 @@ class SGDClassifier(_LinearClassifierBase):
                 "predict_proba is only available with loss='log_loss'"
             )
         self._check_fitted()
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            from ..distribute.predict import batch_predict
+
+            return batch_predict(self, X, method="predict_proba")
         X = prepare_fit_X(X, type(self))
         static = _freeze(self._static_config(self._meta))
         kernel = get_kernel(type(self), "proba", self._meta, static)
@@ -1324,9 +1485,26 @@ class Ridge(_LinearModelBase, RegressorMixin, _RidgeKernelMixin):
     _hyper_names = ("alpha",)
     _static_names = ("fit_intercept",)
 
+    #: out-of-core fit form: block-accumulated normal equations — the
+    #: gram/rhs sums stream, one solve finishes (models/streaming.py)
+    _stream_fit_kind = "gram"
+
     def __init__(self, alpha=1.0, fit_intercept=True):
         self.alpha = alpha
         self.fit_intercept = fit_intercept
+
+    def _prep_stream_fit(self, dataset, y, sample_weight=None):
+        if y is None:
+            raise ValueError(
+                f"{type(self).__name__} needs targets: the "
+                "ChunkedDataset carries none and no y was passed"
+            )
+        y = np.asarray(y, dtype=np.float32)
+        sw = prepare_sample_weight(sample_weight, dataset.n_rows)
+        meta = _annotate_stream_meta(
+            {"n_features": dataset.n_features, "y_ndim": y.ndim}, dataset
+        )
+        return y, sw, meta
 
     def _prep_fit_data(self, X, y, sample_weight=None):
         y = np.asarray(y, dtype=np.float32)
@@ -1369,6 +1547,12 @@ class Ridge(_LinearModelBase, RegressorMixin, _RidgeKernelMixin):
         return decision
 
     def predict(self, X):
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            from ..distribute.predict import batch_predict
+
+            return batch_predict(self, X, method="predict")
         return self.decision_function(X)
 
     def _sklearn_2d_coef(self):
@@ -1385,7 +1569,7 @@ class LinearRegression(Ridge):
         self.fit_intercept = fit_intercept
         self.alpha = 0.0
 
-    def fit(self, X, y, sample_weight=None):
+    def fit(self, X, y=None, sample_weight=None):
         self.alpha = 0.0
         return super().fit(X, y, sample_weight)
 
@@ -1406,6 +1590,8 @@ class RidgeClassifier(_LinearClassifierBase, _RidgeKernelMixin):
 
     _hyper_names = ("alpha",)
     _static_names = ("fit_intercept", "class_weight")
+
+    _stream_fit_kind = "gram"
 
     def __init__(self, alpha=1.0, fit_intercept=True, class_weight=None):
         self.alpha = alpha

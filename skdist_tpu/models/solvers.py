@@ -235,6 +235,31 @@ SGD_CARRY_KEYS = ("w", "pstate", "step", "best", "bad", "n_done", "it",
                   "done")
 
 
+def sgd_batch_scan(grad_fn, learning_rate_fn, post_step, loss_fn, track,
+                   carry4, batches):
+    """Advance the ``(w, pstate, step, acc)`` quadruple over a fixed
+    stack of sample-index ``batches`` — the inner mini-batch loop,
+    extracted so the resident epoch body (:func:`_sgd_epoch_body`) and
+    the streamed block kernels (``models/streaming.py``; an epoch there
+    is a SEQUENCE of these scans, one per row block) apply the exact
+    same traced update — identical op sequence, so a block-streamed
+    epoch that visits the same rows in the same order is bitwise
+    identical to the resident scan."""
+
+    def one(carry, idx):
+        w, pstate, step, acc = carry
+        g = grad_fn(w, idx)
+        lr = learning_rate_fn(step)
+        w_new = w - lr * g
+        if post_step is not None:
+            w_new, pstate = post_step(w_new, pstate, lr)
+        if track:
+            acc = acc + loss_fn(w_new, idx)
+        return (w_new, pstate, step + 1, acc), None
+
+    return lax.scan(one, carry4, batches)[0]
+
+
 def _sgd_epoch_body(grad_fn, keys, n_samples, max_epochs, batch_size,
                     learning_rate_fn, shuffle, loss_fn, tol,
                     n_iter_no_change, post_step):
@@ -258,19 +283,9 @@ def _sgd_epoch_body(grad_fn, keys, n_samples, max_epochs, batch_size,
             perm = jnp.arange(padded) % n_samples
         batches = perm.reshape(n_batches, batch_size)
 
-        def one(carry, idx):
-            w, pstate, step, acc = carry
-            g = grad_fn(w, idx)
-            lr = learning_rate_fn(step)
-            w_new = w - lr * g
-            if post_step is not None:
-                w_new, pstate = post_step(w_new, pstate, lr)
-            if track:
-                acc = acc + loss_fn(w_new, idx)
-            return (w_new, pstate, step + 1, acc), None
-
-        (w_new, pstate_new, step_new, acc), _ = lax.scan(
-            one, (w, pstate, step, jnp.float32(0.0)), batches
+        w_new, pstate_new, step_new, acc = sgd_batch_scan(
+            grad_fn, learning_rate_fn, post_step, loss_fn, track,
+            (w, pstate, step, jnp.float32(0.0)), batches,
         )
         # frozen lanes keep everything: early-stopped lanes, and every
         # lane of an epoch index past max_epochs (a slice tail that

@@ -1,0 +1,1031 @@
+"""
+Streamed (out-of-core) fit drivers: the solver carry forms rewired to
+consume a :class:`~skdist_tpu.data.ChunkedDataset` block by block
+through the backend's double-buffered host→device pipeline
+(``parallel.backend.BlockFeeder``).
+
+Three family forms, selected by the estimator's ``_stream_fit_kind``:
+
+- **"lbfgs"** (LogisticRegression, LinearSVC): the objective's data
+  term is row-additive, so one evaluation of ``(f, g)`` at the current
+  iterate is a streamed reduction — each block contributes
+  ``value_and_grad`` of its block-local data loss (through the same
+  ``LinearOperator`` matvec interface as the resident problem, dense or
+  packed-CSR; on a mesh with a 'data' axis the block row-shards and
+  GSPMD psums the partials), the regulariser is evaluated once, and the
+  L-BFGS state machine (two-loop recursion, Armijo backtracking —
+  mirroring ``models/solvers._lbfgs_body`` lane for lane) runs
+  host-side over the task batch. Each line-search probe is a value-only
+  streamed pass. Block accumulation reorders f32 sums, so results agree
+  with the resident solve to tolerance, not bitwise.
+- **"sgd"** (SGDClassifier): epochs become block streams. An epoch
+  visits blocks in order; within a block, mini-batches advance the
+  ``(w, pstate, step, acc)`` carry through the SAME traced update as
+  the resident scan (``solvers.sgd_batch_scan``), with the global epoch
+  clock keying block-local shuffles. With ``shuffle=False`` and batch
+  boundaries aligned to block boundaries, the visit order equals the
+  resident scan's and the streamed fit is BITWISE identical to it.
+  Early stopping applies sklearn's no-improvement rule at epoch
+  boundaries exactly as the resident epoch body does.
+- **"gram"** (Ridge family): the normal equations accumulate — each
+  block contributes its ``(XᵀSX, XᵀST)`` partials, one small solve
+  finishes per task.
+
+Every driver dispatches per-task batches (the CV search's candidate ×
+fold axis, OvR's class axis) through one vmapped program whose task
+axis shards over the backend mesh; fault handling is block-granular —
+a transient fault re-dispatches the failed block with the reader
+RE-OPENED at that offset (``BlockFeeder.seek``), a preemption restarts
+the current pass after re-placing device state.
+"""
+
+import math
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel import faults
+from ..parallel.backend import BlockFeeder, _RetryState, _RoundFault
+
+__all__ = [
+    "stream_fit_estimator",
+    "stream_fit_tasks",
+    "stream_scores",
+    "lbfgs_stream",
+]
+
+_EPS = np.float32(1e-12)
+
+
+# ---------------------------------------------------------------------------
+# block plumbing
+# ---------------------------------------------------------------------------
+
+def _pad_rows_for(name):
+    """Pad value for a per-row array appended to a padded block: all
+    streamed row arrays pad with values that cannot influence a fit —
+    weights pad 0 (excluded from every contraction), fold ids pad -1
+    (never a real split id), labels pad 0 (a valid class index whose
+    row has zero weight)."""
+    return -1 if name == "fold" else 0
+
+
+def _make_block_read(dataset, row_arrays, pad=True):
+    """``read(i) -> host block tree`` composing the dataset's X block
+    with driver-owned per-row vectors (encoded labels, weights, fold
+    ids) sliced to the block's global row range."""
+
+    def read(i):
+        b = dataset.read_block(i, pad=pad)
+        tree = {"X": b.X}
+        s, e = b.start, b.stop
+        rows = dataset.block_rows if pad else b.n_real
+        pad_n = rows - b.n_real
+        for name, arr in row_arrays.items():
+            sl = np.asarray(arr[s:e])
+            if pad_n:
+                sl = np.concatenate([
+                    sl,
+                    np.full((pad_n,) + sl.shape[1:],
+                            _pad_rows_for(name), sl.dtype),
+                ])
+            tree[name] = sl
+        return tree
+
+    return read
+
+
+def _example_block(dataset, row_arrays, extra_scalars=()):
+    """Zero-filled block tree with the runtime block's exact structure
+    and shapes — what mesh backends with a 'data' axis need to resolve
+    per-leaf block shardings without reading data."""
+    from ..sparse import PackedX
+
+    r = dataset.block_rows
+    if dataset.x_format == "packed":
+        X = PackedX(
+            np.zeros((r, dataset.packed_m), np.int32),
+            np.zeros((r, dataset.packed_m), np.float32),
+            dataset.n_features,
+        )
+    else:
+        X = np.zeros((r, dataset.n_features), np.float32)
+    tree = {"X": X}
+    for name, arr in row_arrays.items():
+        arr = np.asarray(arr)
+        tree[name] = np.zeros((r,) + arr.shape[1:], arr.dtype)
+    for name in extra_scalars:
+        tree[name] = np.int32(0)
+    return tree
+
+
+def _stream_stats(backend, sync):
+    stats = backend.last_round_stats = {
+        "mode": "streamed",
+        "stream_mode": "serial" if sync else "pipelined",
+        "retries": 0,
+        "dispatch_s": 0.0,
+    }
+    return stats
+
+
+def _resolve_sync(backend, sync):
+    return bool(getattr(backend, "sync_rounds", False)) if sync is None \
+        else bool(sync)
+
+
+class _BlockRetry:
+    """Block-granular fault policy shared by every streamed pass: a
+    retryable fault at block ``i`` seeks the feeder back to ``i`` (the
+    reader re-opens at exactly that offset) and re-dispatches; budget
+    accounting matches the round loop's per-round contract (the counter
+    resets on progress). A PREEMPTED fault calls ``restart`` (the
+    driver re-places device state and rewinds its accumulators) and
+    seeks to the pass start."""
+
+    def __init__(self, stats):
+        self.retry = _RetryState()
+        self.stats = stats
+
+    def handle(self, exc, feeder, i, restart=None):
+        kind = faults.classify(exc)
+        if not faults.is_retryable(kind):
+            raise exc
+        self.retry.admit(_RoundFault([], 0, exc, kind), i)
+        self.stats["retries"] = self.retry.total
+        if kind == faults.PREEMPTED and restart is not None:
+            restart()
+            feeder.seek(0)
+            return 0
+        feeder.seek(i)
+        return i
+
+
+def _dispatch_seam():
+    """The fault-injection seam: a planned transient/preempt/fatal
+    fires here, where a real device dispatch would fail."""
+    inj = faults.active_injector()
+    if inj is not None:
+        inj.round_dispatched()
+
+
+def _n_tasks(task_args):
+    return len(np.asarray(next(iter(task_args["hyper"].values()))))
+
+
+def _slot_pad_tree(tree, T, slots):
+    """Pad every task-axis leaf to a slot multiple by repeating the
+    last lane — mesh task sharding needs a divisible axis (the
+    streamed analogue of the round loop's tail padding); padded lanes
+    compute duplicate work and their outputs are sliced off."""
+    Tp = -(-T // max(1, int(slots))) * max(1, int(slots))
+    if Tp == T:
+        return tree, T
+    pad = Tp - T
+    return jax.tree_util.tree_map(
+        lambda a: np.concatenate(
+            [np.asarray(a), np.repeat(np.asarray(a)[-1:], pad, axis=0)]
+        ),
+        tree,
+    ), Tp
+
+
+# ---------------------------------------------------------------------------
+# streamed reductions (the L-BFGS / gram data passes)
+# ---------------------------------------------------------------------------
+
+def _streamed_sum(plan, read, n_blocks, tc, stats, sync, restart=None):
+    """Sum ``plan.fn(block, tc)`` over all blocks (device-resident
+    accumulator; one D2H at the end). ``tc`` may be a zero-arg callable
+    re-evaluated per dispatch (so a preemption ``restart`` can swap in
+    freshly-placed task trees). The reduction is block-order
+    deterministic: serial and pipelined feeds produce bitwise-identical
+    sums.
+
+    Fault handling is two-tier, mirroring where XLA surfaces errors:
+    dispatch-time faults retry at BLOCK granularity (the feeder
+    re-opens the reader at the failed offset), while faults that only
+    surface at the blocking gather (asynchronous dispatch poisons the
+    whole accumulator chain) retry the PASS — same retry budget."""
+    tc_fn = tc if callable(tc) else (lambda: tc)
+    pass_guard = _BlockRetry(stats)
+    while True:
+        acc = None
+        feeder = BlockFeeder(read, n_blocks, plan.put_block,
+                             sync=sync, stats=stats)
+        guard = _BlockRetry(stats)
+        try:
+            while True:
+                item = feeder.next()
+                if item is None:
+                    break
+                i, dev = item
+                t0 = time.perf_counter()
+                try:
+                    _dispatch_seam()
+                    out = plan.fn(dev, tc_fn())
+                except Exception as exc:
+                    preempted = faults.classify(exc) == faults.PREEMPTED
+                    guard.handle(exc, feeder, i, restart=restart)
+                    if preempted and restart is not None:
+                        acc = None  # device accumulator presumed lost
+                    continue
+                acc = out if acc is None else jax.tree_util.tree_map(
+                    jnp.add, acc, out
+                )
+                stats["dispatch_s"] += time.perf_counter() - t0
+        finally:
+            feeder.close()
+        try:
+            return jax.device_get(acc)
+        except Exception as exc:
+            # an async fault re-surfacing at the gather: the failed
+            # block is unknowable, so the whole pass re-runs
+            kind = faults.classify(exc)
+            if not faults.is_retryable(kind):
+                raise
+            pass_guard.retry.admit(_RoundFault([], 0, exc, kind), 0)
+            stats["retries"] = pass_guard.retry.total
+            if kind == faults.PREEMPTED and restart is not None:
+                restart()
+
+
+# ---------------------------------------------------------------------------
+# host-side batched L-BFGS (mirrors models/solvers._lbfgs_body)
+# ---------------------------------------------------------------------------
+
+def _two_loop_batch(g, S, Y, rho, k):
+    T, m, P = S.shape
+    rT = np.arange(T)
+    n_corr = np.minimum(k, m)
+    q = g.astype(np.float32).copy()
+    alphas = np.zeros((T, m), np.float32)
+    for i in range(m):
+        idx = (k - 1 - i) % m
+        valid = i < n_corr
+        alpha = rho[rT, idx] * np.einsum("tp,tp->t", S[rT, idx], q)
+        alpha = np.where(valid, alpha, np.float32(0.0)).astype(np.float32)
+        q = q - alpha[:, None] * Y[rT, idx]
+        alphas[rT, idx] = alpha
+    last = (k - 1) % m
+    sy = np.einsum("tp,tp->t", S[rT, last], Y[rT, last])
+    yy = np.einsum("tp,tp->t", Y[rT, last], Y[rT, last])
+    gamma = np.where(k > 0, sy / (yy + _EPS), np.float32(1.0))
+    r = gamma.astype(np.float32)[:, None] * q
+    for i in range(m):
+        idx = (k - n_corr + i) % m
+        valid = i < n_corr
+        beta = rho[rT, idx] * np.einsum("tp,tp->t", Y[rT, idx], r)
+        upd = S[rT, idx] * (alphas[rT, idx] - beta.astype(np.float32))[:, None]
+        r = r + np.where(valid[:, None], upd, np.float32(0.0))
+    return -r
+
+
+def lbfgs_stream(eval_fg, eval_f, w0, tol, max_iter, history=10,
+                 max_ls=20):
+    """Batched L-BFGS whose objective evaluations are STREAMED passes.
+
+    ``eval_fg(W (T,P) f32) -> (f (T,), g (T,P))`` and ``eval_f`` are
+    full-objective evaluations (block-accumulated data term + the
+    regulariser); the state machine here mirrors
+    ``models/solvers._lbfgs_body`` lane for lane — same Armijo
+    constants, direction-normalisation rule, curvature filter, and
+    ``done`` semantics (converged at ``tol`` | line-search stall |
+    iteration cap) — in host numpy f32 over the task batch, with frozen
+    lanes masked out of every update. Returns ``(W, n_iter, done)``.
+    """
+    w = np.ascontiguousarray(w0, dtype=np.float32)
+    T, P = w.shape
+    m = int(history)
+    tol = np.asarray(tol, dtype=np.float32).reshape(T)
+    f, g = eval_fg(w)
+    f = np.asarray(f, np.float32).reshape(T)
+    g = np.asarray(g, np.float32).reshape(T, P)
+    S = np.zeros((T, m, P), np.float32)
+    Y = np.zeros((T, m, P), np.float32)
+    rho = np.zeros((T, m), np.float32)
+    k = np.zeros(T, np.int64)
+    it = np.zeros(T, np.int64)
+    done = (np.max(np.abs(g), axis=1) <= tol) | (max_iter <= 0)
+    rT = np.arange(T)
+    while not done.all():
+        d = _two_loop_batch(g, S, Y, rho, k)
+        gd0 = np.einsum("tp,tp->t", g, d)
+        descent = gd0 < 0
+        d = np.where(descent[:, None], d, -g)
+        raw_scale = (~descent) | (k == 0)
+        norm = np.linalg.norm(d, axis=1).astype(np.float32) + _EPS
+        d = np.where(raw_scale[:, None], d / norm[:, None], d)
+        gd = np.einsum("tp,tp->t", g, d).astype(np.float32)
+        # Armijo backtracking, lockstep over lanes (each full-objective
+        # probe is one streamed pass over every block)
+        t_step = np.ones(T, np.float32)
+        f_new = np.asarray(
+            eval_f((w + t_step[:, None] * d).astype(np.float32)),
+            np.float32,
+        ).reshape(T)
+        ls_it = np.zeros(T, np.int64)
+        armijo = f_new <= f + np.float32(1e-4) * t_step * gd
+        active = (~armijo) & (ls_it < max_ls) & (~done)
+        while active.any():
+            t_step = np.where(active, t_step * np.float32(0.5), t_step)
+            f_try = np.asarray(
+                eval_f((w + t_step[:, None] * d).astype(np.float32)),
+                np.float32,
+            ).reshape(T)
+            f_new = np.where(active, f_try, f_new)
+            ls_it = ls_it + active
+            armijo = f_new <= f + np.float32(1e-4) * t_step * gd
+            active = (~armijo) & (ls_it < max_ls) & (~done)
+        ok = f_new <= f + np.float32(1e-4) * t_step * gd
+        w_new = (w + t_step[:, None] * d).astype(np.float32)
+        f2, g_new = eval_fg(w_new)
+        f2 = np.asarray(f2, np.float32).reshape(T)
+        g_new = np.asarray(g_new, np.float32).reshape(T, P)
+        s = w_new - w
+        yv = g_new - g
+        sy = np.einsum("tp,tp->t", s, yv)
+        store = (sy > 1e-10) & (~done)
+        idx = k % m
+        S[rT[store], idx[store]] = s[store]
+        Y[rT[store], idx[store]] = yv[store]
+        rho[rT[store], idx[store]] = (
+            np.float32(1.0) / (sy[store].astype(np.float32) + _EPS)
+        )
+        live = ~done
+        converged = np.max(np.abs(g_new), axis=1) <= tol
+        stalled = ~ok
+        w = np.where(live[:, None], w_new, w)
+        f = np.where(live, f2, f)
+        g = np.where(live[:, None], g_new, g)
+        k = k + (store & live)
+        it = it + live
+        done = np.where(
+            live, converged | stalled | (it >= max_iter), done
+        )
+    return w, it, done
+
+
+# ---------------------------------------------------------------------------
+# family kernel builders
+# ---------------------------------------------------------------------------
+
+def _stream_key(est_cls, static, meta, part, extra=()):
+    from .linear import _meta_signature
+    from ..parallel import structural_key
+
+    return structural_key(
+        "stream", est_cls, part, static, _meta_signature(meta), *extra
+    )
+
+
+def _default_derive(block, task):
+    """Single-fit / no-fold derive: labels and weights ride the block;
+    fold-masked variants are composed by the CV/OvR call sites."""
+    return block["X"], block["y"], block["sw"], task["hyper"]
+
+
+def _lbfgs_stream_kernels(est_cls, meta, static, derive):
+    """The three jit programs of one streamed L-BFGS family config:
+    per-block data (f, g), per-block data f (line-search probes), and
+    the one-shot regulariser (f, g) evaluated on a zero block."""
+    from .linear import maybe_exact_matmuls
+
+    problem = est_cls._build_fit_problem(meta, static)
+
+    def fg_kernel(block, tc):
+        Xb, yb, swb, hyper = derive(block, tc["task"])
+        parts = problem(Xb, yb, swb, hyper, parts=True)
+        f, g = jax.value_and_grad(parts[3])(tc["W"])
+        return {"f": f, "g": g}
+
+    def f_kernel(block, tc):
+        Xb, yb, swb, hyper = derive(block, tc["task"])
+        parts = problem(Xb, yb, swb, hyper, parts=True)
+        return {"f": parts[3](tc["W"])}
+
+    def reg_kernel(block, tc):
+        Xb, yb, swb, hyper = derive(block, tc["task"])
+        parts = problem(Xb, yb, swb, hyper, parts=True)
+        f, g = jax.value_and_grad(parts[4])(tc["W"])
+        return {"f": f, "g": g}
+
+    wrap = lambda fn: maybe_exact_matmuls(est_cls, fn)
+    return wrap(fg_kernel), wrap(f_kernel), wrap(reg_kernel)
+
+
+def _host_unpack(est_cls, meta, static, dataset):
+    """The family's ``unpack`` closure, recovered host-side from a
+    one-row zero problem (unpack only reshapes; it never touches X)."""
+    from ..sparse import PackedX
+
+    problem = est_cls._build_fit_problem(meta, static)
+    if dataset.x_format == "packed":
+        Xz = PackedX(np.zeros((1, 1), np.int32), np.zeros((1, 1), np.float32),
+                     meta["n_features"])
+    else:
+        Xz = np.zeros((1, meta["n_features"]), np.float32)
+    hyper = {
+        name: np.float32(1.0)
+        for name in getattr(est_cls, "_hyper_names", ())
+    }
+    out = problem(Xz, np.zeros(1, np.int32), np.zeros(1, np.float32), hyper)
+    return out[2]
+
+
+# ---------------------------------------------------------------------------
+# the drivers
+# ---------------------------------------------------------------------------
+
+def _zero_block_dev(plan, dataset, row_arrays, extra_scalars=()):
+    """A one-row zero block, placed once — the regulariser kernels'
+    dummy shared tree."""
+    from ..sparse import PackedX
+
+    if dataset.x_format == "packed":
+        X = PackedX(np.zeros((1, dataset.packed_m), np.int32),
+                    np.zeros((1, dataset.packed_m), np.float32),
+                    dataset.n_features)
+    else:
+        X = np.zeros((1, dataset.n_features), np.float32)
+    tree = {"X": X}
+    for name, arr in row_arrays.items():
+        arr = np.asarray(arr)
+        tree[name] = np.full(
+            (1,) + arr.shape[1:], _pad_rows_for(name), arr.dtype
+        )
+    for name in extra_scalars:
+        tree[name] = np.int32(0)
+    return plan.put_block(tree)
+
+
+def _fit_lbfgs_stream(backend, est_cls, meta, static, dataset, row_arrays,
+                      task_args, derive, stats, sync, key_extra=()):
+    st = dict(static)
+    max_iter, history = int(st["max_iter"]), int(st["history"])
+    width = est_cls._flat_w_width(meta, static)
+    T = _n_tasks(task_args)
+    fg_kernel, f_kernel, reg_kernel = _lbfgs_stream_kernels(
+        est_cls, meta, static, derive
+    )
+    example = _example_block(dataset, row_arrays)
+    plan_fg = backend.prepare_streamed(
+        fg_kernel, example,
+        cache_key=_stream_key(est_cls, static, meta, "lbfgs_fg", key_extra),
+    )
+    plan_f = backend.prepare_streamed(
+        f_kernel, example,
+        cache_key=_stream_key(est_cls, static, meta, "lbfgs_f", key_extra),
+    )
+    plan_reg = backend.prepare_streamed(
+        reg_kernel, example,
+        cache_key=_stream_key(est_cls, static, meta, "lbfgs_reg", key_extra),
+    )
+    # mesh task sharding needs a slot-divisible task axis; padded
+    # lanes duplicate the last task and are sliced off below
+    task_args, Tp = _slot_pad_tree(task_args, T, plan_fg.n_task_slots)
+    read = _make_block_read(dataset, row_arrays, pad=True)
+    n_blocks = dataset.n_blocks
+
+    state = {"tasks": plan_fg.put_task(task_args)}
+    zero_dev = {"b": _zero_block_dev(plan_reg, dataset, row_arrays)}
+
+    def restart():
+        # preemption: device state presumed lost — re-place the task
+        # tree and the regulariser's zero block
+        state["tasks"] = plan_fg.put_task(task_args)
+        zero_dev["b"] = _zero_block_dev(plan_reg, dataset, row_arrays)
+        faults.record("shared_replacements")
+
+    def eval_fg(W):
+        W = np.ascontiguousarray(W, np.float32)
+        tc = lambda: {"task": state["tasks"], "W": plan_fg.put_task(W)}
+        acc = _streamed_sum(plan_fg, read, n_blocks, tc, stats, sync,
+                            restart=restart)
+        reg = jax.device_get(plan_reg.fn(zero_dev["b"], tc()))
+        return (np.asarray(acc["f"]) + np.asarray(reg["f"]),
+                np.asarray(acc["g"]) + np.asarray(reg["g"]))
+
+    def eval_f(W):
+        W = np.ascontiguousarray(W, np.float32)
+        tc = lambda: {"task": state["tasks"], "W": plan_f.put_task(W)}
+        acc = _streamed_sum(plan_f, read, n_blocks, tc, stats, sync,
+                            restart=restart)
+        reg = jax.device_get(plan_reg.fn(zero_dev["b"], tc()))
+        return np.asarray(acc["f"]) + np.asarray(reg["f"])
+
+    w0 = np.zeros((Tp, width), np.float32)
+    tol = np.asarray(task_args["hyper"]["tol"], np.float32)
+    W, n_iter, _done = lbfgs_stream(
+        eval_fg, eval_f, w0, tol, max_iter, history=history,
+        max_ls=20,
+    )
+    unpack = _host_unpack(est_cls, meta, static, dataset)
+    params = [unpack(W[t], int(n_iter[t])) for t in range(T)]
+    return _stack_params(params)
+
+
+def _fit_gram_stream(backend, est_cls, meta, static, dataset, row_arrays,
+                     task_args, derive, stats, sync, key_extra=()):
+    """Block-accumulated normal equations for the ridge family: stream
+    ``(XᵀSX, XᵀST)`` partials, finish with one solve per task."""
+    from .linear import (
+        _apply_class_weight, _linear_op, maybe_exact_matmuls,
+    )
+
+    st = dict(static)
+    fit_intercept = st["fit_intercept"]
+    d = meta["n_features"]
+    k = meta.get("n_classes")
+    class_weight = st.get("class_weight")
+    cw_arr = meta.get("cw_arr")
+
+    def gram_kernel(block, tc):
+        Xb, yb, swb, hyper = derive(block, tc["task"])
+        op = _linear_op(Xb, fit_intercept, meta)
+        if k is not None:
+            swb = _apply_class_weight(swb, yb, k, class_weight, cw_arr)
+            if k <= 2:
+                T_t = jnp.where(yb == (k - 1), 1.0, -1.0).astype(
+                    op.dtype)[:, None]
+            else:
+                T_t = jnp.where(
+                    jax.nn.one_hot(yb, k) > 0, 1.0, -1.0
+                ).astype(op.dtype)
+        else:
+            T_t = yb.astype(jnp.float32).reshape(yb.shape[0], -1)
+        G, b = op.weighted_gram_rhs(swb, T_t)
+        return {"G": G, "b": b}
+
+    def finish_kernel(_z, tc):
+        G, b = tc["G"], tc["b"]
+        alpha = tc["task"]["hyper"].get("alpha", jnp.float32(0.0))
+        p = G.shape[0]
+        reg = jnp.concatenate([jnp.full((d,), alpha), jnp.zeros(p - d)])
+        G = G + jnp.diag(reg)
+        G = G + 1e-8 * jnp.eye(p, dtype=G.dtype)
+        return {"W": jax.scipy.linalg.solve(G, b, assume_a="pos")}
+
+    gram_kernel = maybe_exact_matmuls(est_cls, gram_kernel)
+    finish_kernel = maybe_exact_matmuls(est_cls, finish_kernel)
+    example = _example_block(dataset, row_arrays)
+    plan = backend.prepare_streamed(
+        gram_kernel, example,
+        cache_key=_stream_key(est_cls, static, meta, "gram", key_extra),
+    )
+    plan_fin = backend.prepare_streamed(
+        finish_kernel, None,
+        cache_key=_stream_key(est_cls, static, meta, "gram_fin", key_extra),
+    )
+    T = _n_tasks(task_args)
+    task_args, _Tp = _slot_pad_tree(task_args, T, plan.n_task_slots)
+    read = _make_block_read(dataset, row_arrays, pad=True)
+    state = {"tasks": plan.put_task(task_args)}
+
+    def restart():
+        state["tasks"] = plan.put_task(task_args)
+        faults.record("shared_replacements")
+
+    acc = _streamed_sum(
+        plan, read, dataset.n_blocks,
+        lambda: {"task": state["tasks"]}, stats, sync, restart=restart,
+    )
+    fin = jax.device_get(plan_fin.fn(
+        plan_fin.put_block({"z": np.zeros(1, np.float32)}),
+        {
+            "task": plan_fin.put_task(task_args),
+            "G": jnp.asarray(acc["G"]),
+            "b": jnp.asarray(acc["b"]),
+        },
+    ))
+    W = np.asarray(fin["W"])  # (T, p, k_out)
+    out = []
+    for t in range(T):
+        Wt = W[t]
+        if k is not None and k <= 2:
+            Wt = Wt[:, 0]
+        elif k is None and meta.get("y_ndim", 1) == 1:
+            Wt = Wt[:, 0]
+        out.append({"W": Wt})
+    return _stack_params(out)
+
+
+def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
+                    task_args, derive, stats, sync, key_extra=()):
+    """Epochs as block streams: visit blocks in order, advance the
+    mini-batch carry through the resident scan's exact update
+    (``solvers.sgd_batch_scan``), apply the epoch-end early-stopping
+    bookkeeping host-side in f32 — mirroring ``solvers._sgd_epoch_body``
+    value for value, so an aligned, unshuffled streamed fit is bitwise
+    identical to the resident kernel."""
+    from .linear import maybe_exact_matmuls
+    from .solvers import sgd_batch_scan
+
+    st = dict(static)
+    max_iter = int(st["max_iter"])
+    batch_size = int(st["batch_size"])
+    n_iter_no_change = int(st["n_iter_no_change"])
+    shuffle = bool(st.get("shuffle", True))
+    penalty = st["penalty"]
+    width = est_cls._flat_w_width(meta, static)
+    problem = est_cls._build_fit_problem(meta, static)
+    R = dataset.block_rows
+    n = dataset.n_rows
+    if R % batch_size and dataset.n_blocks > 1:
+        raise ValueError(
+            f"streamed SGD needs block_rows ({R}) divisible by "
+            f"batch_size ({batch_size}) so mini-batches never straddle "
+            "blocks; rebuild the ChunkedDataset with an aligned "
+            "block_rows"
+        )
+
+    def block_kernel(block, tc):
+        Xb, yb, swb, hyper = derive(block, tc["task"])
+        pb = problem(Xb, yb, swb, hyper)
+        rows = yb.shape[0]
+        n_b = rows // batch_size
+        if shuffle:
+            bkey = jax.random.fold_in(
+                jax.random.fold_in(pb["key"], block["epoch"]),
+                block["bid"],
+            )
+            perm = jax.random.permutation(bkey, rows)
+        else:
+            perm = jnp.arange(rows)
+        batches = perm.reshape(n_b, batch_size)
+        carry = tc["carry"]
+        w, pstate, step, acc = sgd_batch_scan(
+            pb["grad_fn"], pb["lr_fn"], pb["post_step"], pb["loss_fn"],
+            True,
+            (carry["w"], carry["pstate"], carry["step"], carry["acc"]),
+            batches,
+        )
+        return {"w": w, "pstate": pstate, "step": step, "acc": acc}
+
+    block_kernel = maybe_exact_matmuls(est_cls, block_kernel)
+    example = _example_block(dataset, row_arrays, ("epoch", "bid"))
+    plan = backend.prepare_streamed(
+        block_kernel, example,
+        cache_key=_stream_key(est_cls, static, meta, "sgd", key_extra),
+    )
+
+    # ---- epoch plan: full blocks + a virtual tail whose trailing
+    # batch wraps to the dataset head (the streamed rendition of the
+    # resident scan's arange(padded) % n wrap) -----------------------
+    base_read = _make_block_read(dataset, row_arrays, pad=False)
+    full_blocks = n // R
+    rem = n - full_blocks * R
+    if rem == 0 and n % batch_size:
+        # every block is full but the epoch still needs a wrap batch
+        # (possible only for a single-block dataset — aligned
+        # block_rows is enforced above for more): demote the last full
+        # block to the virtual tail so the wrap rows get appended
+        full_blocks -= 1
+        rem = R
+    tail_rows = 0
+    wrap_tree = None
+    if rem:
+        tail_rows = int(math.ceil(rem / batch_size) * batch_size)
+        wrap = tail_rows - rem
+        if wrap:
+            # wrap rows are the resident scan's arange(padded) % n
+            # tail: global rows (n + j) % n = j % n for j < wrap. When
+            # wrap <= n they are simply the dataset head; a dataset
+            # SMALLER than one batch cycles (possible only when the
+            # whole dataset is the tail block, so block 0 holds every
+            # row the cycle can touch)
+            head = base_read(0)
+            avail = rem if full_blocks == 0 else R
+            idx = np.arange(wrap) % min(avail, n)
+            wrap_tree = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[idx], head
+            )
+
+    def read_epoch_block(e):
+        def read(i):
+            if rem and i == full_blocks:
+                tree = base_read(full_blocks)
+                if wrap_tree is not None:
+                    tree = jax.tree_util.tree_map(
+                        lambda a, w_: np.concatenate(
+                            [np.asarray(a), w_]
+                        ),
+                        tree, wrap_tree,
+                    )
+            else:
+                tree = base_read(i)
+            tree["epoch"] = np.int32(e)
+            tree["bid"] = np.int32(i)
+            return tree
+
+        return read
+
+    n_stream_blocks = full_blocks + (1 if rem else 0)
+    n_batches_total = np.float32(-(-n // batch_size))
+
+    T = _n_tasks(task_args)
+    task_args, Tp = _slot_pad_tree(task_args, T, plan.n_task_slots)
+    tol = np.asarray(task_args["hyper"]["tol"], np.float32)
+    tasks_dev = plan.put_task(task_args)
+    if penalty in ("l1", "elasticnet"):
+        pstate0 = (np.zeros(Tp, np.float32),
+                   np.zeros((Tp, width), np.float32))
+    else:
+        pstate0 = ()
+    carry = plan.put_task({
+        "w": np.zeros((Tp, width), np.float32),
+        "pstate": pstate0,
+        "step": np.zeros(Tp, np.int32),
+        "acc": np.zeros(Tp, np.float32),
+    })
+    # host-side early-stopping state (mirrors _sgd_epoch_body's tail)
+    best = np.full(Tp, np.inf, np.float32)
+    bad = np.zeros(Tp, np.int64)
+    n_done = np.zeros(Tp, np.int64)
+    done = np.zeros(Tp, bool)
+
+    guard = _BlockRetry(stats)
+    epoch_guard = _BlockRetry(stats)
+    e = 0
+    while e < max_iter:
+        carry_start = carry
+        # host snapshot of the epoch-start carry: the preemption
+        # restart below (and the epoch-retry path) re-place from it
+        # (device buffers are presumed lost with the worker)
+        host_start = jax.device_get(carry_start)
+        carry = _reset_acc(carry)
+        read = read_epoch_block(e)
+        feeder = BlockFeeder(read, n_stream_blocks, plan.put_block,
+                             sync=sync, stats=stats)
+        try:
+            while True:
+                item = feeder.next()
+                if item is None:
+                    break
+                i, dev = item
+                t0 = time.perf_counter()
+                try:
+                    _dispatch_seam()
+                    carry = plan.fn(dev, {"task": tasks_dev,
+                                          "carry": carry})
+                except Exception as exc:
+                    def restart():
+                        # preemption loses device state: re-place the
+                        # tasks and rewind to the epoch-start carry
+                        nonlocal tasks_dev, carry
+                        tasks_dev = plan.put_task(task_args)
+                        carry = _reset_acc(plan.put_task(host_start))
+                        faults.record("shared_replacements")
+
+                    # a TRANSIENT fault at block i leaves the input
+                    # carry (the post-(i-1) state) valid: the feeder
+                    # re-opens the reader at block i and the identical
+                    # dispatch re-runs bitwise
+                    guard.handle(exc, feeder, i, restart=restart)
+                    continue
+                stats["dispatch_s"] += time.perf_counter() - t0
+        finally:
+            feeder.close()
+        try:
+            acc = np.asarray(jax.device_get(carry["acc"]), np.float32)
+        except Exception as exc:
+            # async fault surfacing only at the blocking gather: the
+            # whole epoch's carry chain is suspect — re-run the epoch
+            # from its start snapshot (deterministic, so bitwise)
+            kind = faults.classify(exc)
+            if not faults.is_retryable(kind):
+                raise
+            epoch_guard.retry.admit(_RoundFault([], 0, exc, kind), e)
+            stats["retries"] = epoch_guard.retry.total
+            if kind == faults.PREEMPTED:
+                tasks_dev = plan.put_task(task_args)
+                faults.record("shared_replacements")
+            carry = plan.put_task(host_start)
+            continue
+        # ---- epoch-end bookkeeping: the resident epoch body's tail,
+        # value for value, in host f32 (same IEEE ops => bitwise) -----
+        keep = done.copy()
+        loss = (acc / n_batches_total).astype(np.float32)
+        improved = loss < (best - tol).astype(np.float32)
+        bad_new = np.where(improved, 0, bad + 1)
+        newly_stopped = bad_new >= n_iter_no_change
+        best_new = np.minimum(best, loss).astype(np.float32)
+        if keep.any():
+            # frozen lanes keep their epoch-start carry, exactly like
+            # the resident scan's pick()
+            kmask = plan.put_task(keep)
+            carry = _pick_carry(kmask, carry_start, carry)
+        best = np.where(keep, best, best_new)
+        bad = np.where(keep, bad, bad_new)
+        n_done = np.where(keep, n_done, n_done + 1)
+        done = keep | newly_stopped | ((e + 1) >= max_iter)
+        if done.all():
+            break
+        e += 1
+
+    w_host = np.asarray(jax.device_get(carry["w"]), np.float32)
+    # unpack per task (host reshape, identical to the family unpack)
+    unpack = _sgd_host_unpack(est_cls, meta, static)
+    params = [unpack(w_host[t], int(n_done[t])) for t in range(T)]
+    return _stack_params(params)
+
+
+def _sgd_host_unpack(est_cls, meta, static):
+    st = dict(static)
+    p = meta["n_features"] + (1 if st["fit_intercept"] else 0)
+    k = meta.get("n_classes", 2)
+    n_out = 1 if k <= 2 else k
+
+    def unpack(Wf, n_epochs):
+        W = np.asarray(Wf).reshape(p, n_out)
+        if n_out == 1:
+            W = W[:, 0]
+        return {"W": W, "n_iter": n_epochs}
+
+    return unpack
+
+
+def _reset_acc(carry):
+    return {**carry, "acc": jnp.zeros_like(carry["acc"])}
+
+
+def _pick_carry(keep_dev, old, new):
+    """``where(keep, old, new)`` leaf-wise with the (T,) mask broadcast
+    to each leaf's rank — the device rendition of the resident epoch
+    body's freeze pick."""
+
+    def pick(a, b):
+        m = jnp.reshape(keep_dev, keep_dev.shape + (1,) * (a.ndim - 1))
+        return jnp.where(m, a, b)
+
+    return jax.tree_util.tree_map(pick, old, new)
+
+
+def _stack_params(params_list):
+    """List of per-task param dicts -> dict of stacked (T, ...) arrays
+    (n_iter-style scalars stack to (T,))."""
+    out = {}
+    for key in params_list[0]:
+        out[key] = np.stack([
+            np.asarray(p[key]) for p in params_list
+        ])
+    return out
+
+
+def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
+                     task_args, derive=None, sync=None, stats=None,
+                     key_extra=()):
+    """Fit a batch of tasks over a ChunkedDataset with the family's
+    streamed driver. ``row_arrays`` maps per-row vector names (``y``
+    encoded labels, ``sw`` weights, ``fold`` CV fold ids, ...) to
+    ``(n_rows,)`` host arrays sliced per block; ``derive(block, task)
+    -> (Xb, yb, swb, hyper)`` adapts a placed block + one task lane to
+    the family's fit problem (fold masking, OvR binarisation).
+    Returns a dict of stacked ``(T, ...)`` fitted params."""
+    kind = getattr(est_cls, "_stream_fit_kind", None)
+    if kind is None:
+        raise TypeError(
+            f"{est_cls.__name__} has no out-of-core fit path "
+            "(_stream_fit_kind is unset); materialise the dataset or "
+            "use a linear family"
+        )
+    sync = _resolve_sync(backend, sync)
+    if stats is None:
+        stats = _stream_stats(backend, sync)
+    derive = derive or _default_derive
+    driver = {
+        "lbfgs": _fit_lbfgs_stream,
+        "sgd": _fit_sgd_stream,
+        "gram": _fit_gram_stream,
+    }[kind]
+    return driver(backend, est_cls, meta, static, dataset, row_arrays,
+                  task_args, derive, stats, sync, key_extra=key_extra)
+
+
+# ---------------------------------------------------------------------------
+# streamed scoring
+# ---------------------------------------------------------------------------
+
+def stream_scores(backend, est_cls, meta, static, dataset, row_arrays,
+                  task_args, params, scorer_specs, weight_fns,
+                  sync=None, stats=None, key_extra=()):
+    """Evaluate fitted per-task params over the dataset with
+    decomposable device scorers (``metrics.STREAM_SCORERS``): one
+    streamed pass accumulates each metric's sufficient statistics per
+    task, host ``combine`` finishes. ``weight_fns`` maps an output
+    prefix ('test', 'train') to ``fn(block, task) -> (rows,) weights``.
+    Returns ``{f"{prefix}_{name}": (T,) float64}``."""
+    from .linear import maybe_exact_matmuls
+    from ..metrics import STREAM_SCORERS
+
+    sync = _resolve_sync(backend, sync)
+    if stats is None:
+        stats = backend.last_round_stats or {}
+    decision_kernel = maybe_exact_matmuls(
+        est_cls, est_cls._build_decision_kernel(meta, static)
+    )
+    needs_proba = any(
+        STREAM_SCORERS[m][2] == "proba" for _n, m in scorer_specs
+    )
+    proba_kernel = (
+        maybe_exact_matmuls(est_cls, est_cls._build_proba_kernel(meta, static))
+        if needs_proba else None
+    )
+
+    def score_kernel(block, tc):
+        Xb = block["X"]
+        yb = block["y"]
+        dec = decision_kernel(tc["params"], Xb)
+        outputs = {"decision": dec, "predict": dec}
+        if proba_kernel is not None:
+            outputs["proba"] = proba_kernel(tc["params"], Xb)
+        out = {}
+        for prefix, wfn in weight_fns.items():
+            wv = wfn(block, tc["task"])
+            for name, metric in scorer_specs:
+                kernel, _combine, kind = STREAM_SCORERS[metric]
+                out[f"{prefix}_{name}"] = kernel(
+                    yb, outputs[kind], wv, meta
+                )
+        return out
+
+    score_kernel = maybe_exact_matmuls(est_cls, score_kernel)
+    example = _example_block(dataset, row_arrays)
+    plan = backend.prepare_streamed(
+        score_kernel, example,
+        cache_key=_stream_key(est_cls, static, meta, "score",
+                              tuple(sorted(
+                                  (p, n, m) for p in weight_fns
+                                  for n, m in scorer_specs
+                              )) + tuple(key_extra)),
+    )
+    T = _n_tasks(task_args)
+    task_args, _Tp = _slot_pad_tree(task_args, T, plan.n_task_slots)
+    params, _Tp = _slot_pad_tree(params, T, plan.n_task_slots)
+    read = _make_block_read(dataset, row_arrays, pad=True)
+    tc = {"task": plan.put_task(task_args),
+          "params": plan.put_task(params)}
+    acc = _streamed_sum(plan, read, dataset.n_blocks, tc, stats, sync)
+    out = {}
+    for key, parts in acc.items():
+        prefix, name = key.split("_", 1)
+        metric = dict(scorer_specs)[name]
+        _kernel, combine, _kind = STREAM_SCORERS[metric]
+        out[key] = np.asarray([
+            combine(jax.tree_util.tree_map(
+                lambda a, t=t: np.asarray(a)[t], parts
+            ), meta)
+            for t in range(T)
+        ], dtype=np.float64)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# single-estimator entry point
+# ---------------------------------------------------------------------------
+
+def stream_fit_estimator(est, dataset, y=None, sample_weight=None,
+                         backend=None):
+    """``estimator.fit(ChunkedDataset)``: the out-of-core fit of one
+    estimator — labels/weights from the dataset (or passed explicitly),
+    blocks streamed through the double-buffered pipeline, fitted state
+    set exactly like a resident fit."""
+    from ..parallel import resolve_backend
+    from .linear import _freeze, hyper_float
+
+    if getattr(est, "engine", None) == "host":
+        raise ValueError(
+            "engine='host' cannot fit a ChunkedDataset: the f64 BLAS "
+            "host engine needs X resident. Use engine='auto'/'xla' for "
+            "the streamed XLA path."
+        )
+    backend = resolve_backend(backend)
+    if y is None:
+        y = dataset.load_y()
+    if sample_weight is None:
+        sample_weight = dataset.load_sw()
+    y_enc, sw, meta = est._prep_stream_fit(dataset, y, sample_weight)
+    static_cfg = est._static_config(meta)
+    static = _freeze(static_cfg)
+    est_cls = type(est)
+    task_args = {"hyper": {
+        name: np.asarray([hyper_float(getattr(est, name))], np.float32)
+        for name in est_cls._hyper_names
+    }}
+    if "alpha" not in task_args["hyper"] and \
+            getattr(est, "alpha", None) is not None and \
+            est._stream_fit_kind == "gram":
+        task_args["hyper"]["alpha"] = np.asarray(
+            [hyper_float(est.alpha)], np.float32
+        )
+    row_arrays = {"y": y_enc, "sw": sw}
+    params = stream_fit_tasks(
+        backend, est_cls, meta, static, dataset, row_arrays, task_args,
+    )
+    est._set_fitted(
+        {k: np.asarray(v)[0] for k, v in params.items()}, meta
+    )
+    return est

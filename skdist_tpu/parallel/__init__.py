@@ -8,10 +8,12 @@ every reference estimator is built on (reference ``search.py:411-437``,
 from . import compile_cache, faults
 from .backend import (
     BatchedPlan,
+    BlockFeeder,
     IterativeKernelSpec,
     IterativePlan,
     LocalBackend,
     RungController,
+    StreamPlan,
     TPUBackend,
     TaskBackend,
     compaction_enabled,
@@ -32,6 +34,8 @@ __all__ = [
     "LocalBackend",
     "TPUBackend",
     "BatchedPlan",
+    "BlockFeeder",
+    "StreamPlan",
     "IterativeKernelSpec",
     "IterativePlan",
     "RungController",

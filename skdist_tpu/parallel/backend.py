@@ -565,6 +565,20 @@ class LocalBackend(TaskBackend):
 
     supports_iterative = True
 
+    def prepare_streamed(self, kernel, block_example=None,
+                         static_args=None, cache_key=None):
+        """Jit entry + placement fns for a block-streamed dispatch
+        (``kernel(block, task)``; tasks vmapped on the leading axis):
+        the task tree is placed once by the caller, the shared tree —
+        one data block — per block by a :class:`BlockFeeder`."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = _jit_vmapped(kernel, static_args, None, None, cache_key,
+                          False)
+        put = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+        return StreamPlan(fn, put, put, n_task_slots=1)
+
     def prepare_batched_iterative(self, spec, shared_args=(),
                                   static_args=None, shared_specs=None,
                                   cache_key=None):
@@ -835,6 +849,43 @@ class TPUBackend(TaskBackend):
                            n_task_slots=self.n_devices)
 
     supports_iterative = True
+
+    def prepare_streamed(self, kernel, block_example=None,
+                         static_args=None, cache_key=None):
+        """Mesh variant of the streamed plan: the task axis shards over
+        the task mesh axis exactly like :meth:`prepare_batched`'s, and
+        the per-block shared tree row-shards onto the mesh 'data' axis
+        when one exists (:func:`_block_shardings`) — streamed blocks
+        land on the same axis the resident row-sharded path uses, so
+        GSPMD inserts the identical psum of gram/gradient partials."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        task_sharding = NamedSharding(self.mesh, P(self.axis_name))
+        block_shardings = _block_shardings(self, block_example)
+        fn = _jit_vmapped(
+            kernel, static_args, task_sharding, block_shardings,
+            cache_key, False,
+        )
+
+        def put_task(t):
+            return jax.tree_util.tree_map(
+                lambda a: _put_mesh_scoped(a, task_sharding), t
+            )
+
+        if isinstance(block_shardings, NamedSharding):
+            def put_block(t):
+                return jax.tree_util.tree_map(
+                    lambda a: _put_mesh_scoped(a, block_shardings), t
+                )
+        else:
+            def put_block(t):
+                return jax.tree_util.tree_map(
+                    _put_mesh_scoped, t, block_shardings
+                )
+
+        return StreamPlan(fn, put_task, put_block,
+                          n_task_slots=self.n_devices)
 
     def prepare_batched_iterative(self, spec, shared_args=(),
                                   static_args=None, shared_specs=None,
@@ -1165,7 +1216,13 @@ class BatchedPlan:
         behind the compute (the same overlap trick as the pipelined
         round loop). Pair with :meth:`gather`; callers overlapping
         launches must bound their in-flight depth themselves."""
-        sl = self.put(task_args)
+        return self.run_async_placed(self.put(task_args))
+
+    def run_async_placed(self, sl):
+        """:meth:`run_async` for a task slice ALREADY device-placed —
+        the streamed-predict path places blocks on a prefetch worker
+        (``BlockFeeder``) and dispatches them here, so the H2D leg
+        rides the feed thread instead of the dispatch clock."""
         comp = compile_cache.aot_executable(
             self.fn, self.shared, sl, _leading_dim(sl),
             shared_sig=self._shared_sig,
@@ -1187,6 +1244,193 @@ class BatchedPlan:
             self.fn, self.shared, task_like, n_chunk=n_chunk,
             shared_sig=self._shared_sig,
         )
+
+
+class StreamPlan:
+    """A pre-resolved block-streamed dispatch: the jit entry of a
+    ``kernel(block, task)`` program whose TASK tree is long-lived
+    (placed once, task-axis sharded) while its SHARED tree — one data
+    block — is re-placed per block by the feeder
+    (:class:`BlockFeeder`). The transpose of :class:`BatchedPlan`:
+    there the shared data is resident and tasks stream; here the tasks
+    are resident and the data streams. Built by
+    :meth:`TaskBackend.prepare_streamed`; driven by the streamed fit/
+    predict drivers (``models/streaming.py``)."""
+
+    __slots__ = ("fn", "put_task", "put_block", "n_task_slots")
+
+    def __init__(self, fn, put_task, put_block, n_task_slots=1):
+        self.fn = fn
+        self.put_task = put_task
+        self.put_block = put_block
+        self.n_task_slots = n_task_slots
+
+
+def _block_shardings(backend, block_example):
+    """Per-leaf shardings of a streamed block on a mesh backend: row
+    leaves (leading axis == the block's row count) ride the mesh 'data'
+    axis when one exists — the streamed analogue of
+    ``row_sharded_specs`` (GSPMD then psums the solver contractions
+    over the data axis exactly as in the resident row-sharded path) —
+    and everything else (per-block scalars like the SGD epoch clock)
+    replicates. On 1D meshes everything replicates."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(backend.mesh, P())
+    if getattr(backend, "data_axis_size", 1) <= 1:
+        return rep
+    row = NamedSharding(backend.mesh, P("data"))
+    leaves = jax.tree_util.tree_leaves(block_example)
+    n_rows = max(
+        (l.shape[0] for l in leaves if getattr(l, "ndim", 0) >= 1),
+        default=0,
+    )
+
+    def pick(leaf):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_rows:
+            return row
+        return rep
+
+    return jax.tree_util.tree_map(pick, block_example)
+
+
+class BlockFeeder:
+    """The double-buffered host→device leg of the streaming data plane.
+
+    Reads blocks (``read(i) -> host tree``) and places them on device
+    (``place``) on a background worker, ONE block ahead of the
+    consumer, so block ``k+1``'s disk read + H2D transfer hides behind
+    block ``k``'s compute — the same depth-2 overlap discipline as the
+    pipelined round loop (``_run_in_rounds``), applied to the data axis
+    instead of the task axis. ``sync=True`` is the serial-feed debug
+    mode (``sync_rounds``' analogue): read + place happen inline in
+    :meth:`next`, so the consumer pays the full feed cost on its own
+    clock — the baseline the streaming smoke measures overlap against.
+    Consumed blocks are dropped as soon as the next is handed out, so
+    at most ``depth`` blocks are host+device resident at once.
+
+    :meth:`seek` repositions the cursor — the round-retry contract: a
+    transient fault at block ``i`` seeks back to ``i`` and the reader
+    is RE-OPENED at exactly that offset (a fresh read; nothing stale
+    survives the fault).
+
+    ``stats`` (a dict, typically the backend's ``last_round_stats``)
+    accumulates the streamed byte accounting: ``streamed_bytes`` (total
+    H2D-fed bytes), ``peak_block_bytes`` (largest single resident
+    block), ``blocks_fed``, ``feed_wait_s`` (consumer time blocked on
+    the feed — the UNHIDDEN remainder under overlap), ``read_place_s``
+    (worker time reading + placing), and ``stream_mode``.
+    """
+
+    def __init__(self, read, n_blocks, place, depth=2, sync=False,
+                 stats=None):
+        self.read = read
+        self.n_blocks = int(n_blocks)
+        self.place = place
+        self.depth = max(2, int(depth))
+        self.sync = bool(sync)
+        self.stats = stats if stats is not None else {}
+        for key, v0 in (
+            ("streamed_bytes", 0), ("peak_block_bytes", 0),
+            ("blocks_fed", 0), ("feed_wait_s", 0.0),
+            ("read_place_s", 0.0),
+        ):
+            self.stats.setdefault(key, v0)
+        self.stats["stream_mode"] = "serial" if self.sync else "pipelined"
+        self._cursor = 0
+        self._pending = []  # [(idx, Future)]
+        self._pool = None
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="skdist-blockfeed"
+            )
+        return self._pool
+
+    def _produce(self, i):
+        t0 = time.perf_counter()
+        host = self.read(i)
+        dev = self.place(host)
+        nbytes = tree_nbytes(host)
+        return dev, nbytes, time.perf_counter() - t0
+
+    def _account(self, nbytes, dt):
+        self.stats["streamed_bytes"] += int(nbytes)
+        self.stats["peak_block_bytes"] = max(
+            self.stats["peak_block_bytes"], int(nbytes)
+        )
+        self.stats["blocks_fed"] += 1
+        self.stats["read_place_s"] += dt
+
+    def seek(self, i):
+        """Reposition the cursor to block ``i``; in-flight prefetches
+        are discarded (their results never reach the consumer), so the
+        next :meth:`next` re-reads from ``i`` — the fault-retry
+        offset contract."""
+        for _idx, fut in self._pending:
+            try:
+                fut.cancel() or fut.exception()
+            except Exception:  # a failed prefetch is WHY we seek
+                pass
+        self._pending = []
+        self._cursor = int(i)
+
+    def next(self):
+        """``(block_index, device_tree)`` for the next block, or None
+        past the end. Prefetches the following block before returning,
+        so the consumer's compute and the feed overlap."""
+        if self.sync:
+            if self._cursor >= self.n_blocks:
+                return None
+            i = self._cursor
+            t0 = time.perf_counter()
+            dev, nbytes, dt = self._produce(i)
+            self.stats["feed_wait_s"] += time.perf_counter() - t0
+            self._account(nbytes, dt)
+            self._cursor = i + 1
+            return i, dev
+        pool = self._ensure_pool()
+        while (len(self._pending) < self.depth - 1
+               and self._cursor + len(self._pending) < self.n_blocks):
+            j = self._cursor + len(self._pending)
+            self._pending.append((j, pool.submit(self._produce, j)))
+        if not self._pending:
+            return None
+        i, fut = self._pending.pop(0)
+        t0 = time.perf_counter()
+        dev, nbytes, dt = fut.result()  # a read/place error raises HERE
+        self.stats["feed_wait_s"] += time.perf_counter() - t0
+        self._account(nbytes, dt)
+        self._cursor = i + 1
+        # top the prefetch window back up before handing the block out
+        if (self._cursor + len(self._pending) < self.n_blocks
+                and len(self._pending) < self.depth - 1):
+            j = self._cursor + len(self._pending)
+            self._pending.append((j, pool.submit(self._produce, j)))
+        return i, dev
+
+    def __iter__(self):
+        while True:
+            item = self.next()
+            if item is None:
+                return
+            yield item
+
+    def close(self):
+        self.seek(self.n_blocks)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 # Device-broadcast reuse cache (opt-in via TPUBackend(reuse_broadcast=

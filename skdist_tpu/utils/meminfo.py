@@ -22,6 +22,40 @@ def available_host_bytes():
         return None
 
 
+def _proc_status_kb(field):
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith(field + ":"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
+def rss_bytes():
+    """Current resident set size of this process, or None off-Linux —
+    the streaming smoke's bounded-host-memory probe."""
+    return _proc_status_kb("VmRSS")
+
+
+def peak_rss_bytes():
+    """Lifetime peak resident set size (VmHWM, falling back to
+    ``ru_maxrss`` where the kernel omits it), or None where neither
+    exists. Monotone: the streaming smoke asserts on the DELTA across
+    the out-of-core fit, not the absolute value (the interpreter + jax
+    runtime own the baseline)."""
+    v = _proc_status_kb("VmHWM")
+    if v is not None:
+        return v
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except (ImportError, ValueError, OSError):
+        return None
+
+
 def densify_budget_bytes():
     """(budget, source_description) for a full densified allocation.
 
