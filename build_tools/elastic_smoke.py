@@ -1,0 +1,308 @@
+"""Elastic-execution smoke: the preemption/self-healing PR's
+acceptance gate, standalone on the 8-virtual-device CPU mesh.
+
+Two scenarios, one per plane:
+
+- **elastic fit**: a grid search with durable checkpointing on an
+  elastic mesh is preempted at round PREEMPT_ROUND — a SPECIFIC
+  participant (half the devices) dies via ``FaultInjector.on_host`` —
+  and capacity returns one round later. The search must COMPLETE with
+  cv_results_ parity 0.0 (bitwise) vs the un-preempted run, shrink the
+  mesh exactly once, re-grow at a round boundary exactly once, salvage
+  (not re-run) >= RESUME_FRAC of its tasks — the same contiguous
+  prefix the checkpoint journal holds, asserted against the journal's
+  row count — and finish back on the full mesh with every task
+  journaled. ``SKDIST_COMPACTION=0`` pins the classic round loop so
+  rounds (and therefore the salvaged prefix) are the unit of loss, the
+  same geometry a real per-round journal protects.
+
+- **replica fleet**: a 3-replica ``ReplicaSet`` under sustained
+  threaded load has replica 1 killed ABRUPTLY (queued futures fail, as
+  a process death would) at request KILL_AT via
+  ``FaultInjector.kill_replica``. The fleet must serve EVERY request
+  (0 failures — failover absorbs the death), drain+respawn the dead
+  replica under its own traffic, route real work to the respawned
+  replica, keep ``compiles_after_warmup`` at 0 on every replica (the
+  respawn re-registers through the warm structural/AOT caches — the
+  PR-1 artifact tier cross-process), and keep fleet p99 bounded.
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/elastic_smoke.py [--resume-frac 0.5]
+        [--p99-ms 5000]
+"""
+
+import json
+import os
+import sys
+import threading
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+# pin the classic round loop: round-granular salvage is the contract
+# under test (the compacted path retries preemption by full re-run)
+os.environ["SKDIST_COMPACTION"] = "0"
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+#: dispatch ordinal the targeted participant dies at; with N_ROUNDS
+#: rounds this leaves PREEMPT_ROUND/N_ROUNDS of the tasks salvaged
+PREEMPT_ROUND = 2
+N_ROUNDS = 4
+#: router request ordinal replica 1 dies at (mid-load)
+KILL_AT = 60
+FLEET_THREADS = 6
+REQS_PER_THREAD = 40
+
+
+def _data():
+    import numpy as np
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=360, n_features=12, n_informative=8, random_state=7,
+    )
+    return X.astype(np.float32), y
+
+
+def _search(backend):
+    import numpy as np
+
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+
+    return DistGridSearchCV(
+        LogisticRegression(max_iter=40, engine="xla"),
+        {"C": list(np.logspace(-2, 2, 8))}, cv=4,
+        partitions=N_ROUNDS, backend=backend,
+    )
+
+
+def _score_cols(cv_results):
+    import numpy as np
+
+    return {
+        k: np.asarray(v) for k, v in cv_results.items()
+        if "test_score" in k and not k.startswith("rank")
+    }
+
+
+def _max_diff(a, b):
+    import numpy as np
+
+    return max(
+        float(np.abs(np.asarray(a[k], float)
+                     - np.asarray(b[k], float)).max())
+        for k in a
+    )
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: elastic fit (shrink -> salvage/resume -> regrow, parity 0)
+# ---------------------------------------------------------------------------
+
+def scenario_elastic_fit(failures, resume_frac):
+    import tempfile
+
+    import jax
+
+    from skdist_tpu.parallel import TPUBackend, faults
+    from skdist_tpu.testing.faultinject import FaultInjector
+
+    X, y = _data()
+    gs0 = _search(TPUBackend())
+    gs0.fit(X, y)  # un-preempted reference (also the compile warmup)
+    base = _score_cols(gs0.cv_results_)
+    n_tasks = len(gs0.cv_results_["mean_test_score"]) * gs0.n_splits_
+
+    full = len(jax.devices())
+    ckpt = tempfile.mkdtemp(prefix="skdist-elastic-smoke-")
+    faults.reset_stats()
+    backend = TPUBackend(elastic={"group_size": full // 2})
+    gs1 = _search(backend)
+    inj = FaultInjector().on_host(1, at_round=PREEMPT_ROUND,
+                                  restore_after=1)
+    with inj, warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gs1.fit(X, y, checkpoint_dir=ckpt)
+    stats = faults.snapshot()
+    diff = _max_diff(base, _score_cols(gs1.cv_results_))
+
+    journals = [f for f in os.listdir(ckpt) if f.endswith(".jsonl")]
+    journaled = 0
+    if len(journals) == 1:
+        with open(os.path.join(ckpt, journals[0])) as fh:
+            journaled = len([ln for ln in fh if ln.strip()])
+    else:
+        failures.append(f"elastic fit: {len(journals)} journals, want 1")
+
+    fired = [k for _o, k in inj.fired]
+    if "preempt" not in fired or "lost:1" not in fired:
+        failures.append(f"elastic fit: injection never fired ({fired})")
+    if diff != 0.0:
+        failures.append(
+            f"elastic fit: cv_results_ parity {diff} != 0.0 vs the "
+            "un-preempted run"
+        )
+    if stats["elastic_shrinks"] != 1:
+        failures.append(
+            f"elastic fit: {stats['elastic_shrinks']} shrinks, want 1"
+        )
+    if stats["elastic_regrows"] != 1:
+        failures.append(
+            f"elastic fit: {stats['elastic_regrows']} regrows, want 1 "
+            "(capacity returned but the mesh never re-grew)"
+        )
+    salvaged = stats["elastic_tasks_salvaged"]
+    if salvaged < resume_frac * n_tasks:
+        failures.append(
+            f"elastic fit: salvaged {salvaged}/{n_tasks} tasks "
+            f"(< {resume_frac:.0%}) across the preemption"
+        )
+    if journaled != n_tasks:
+        failures.append(
+            f"elastic fit: journal holds {journaled}/{n_tasks} tasks"
+        )
+    if len(backend.devices) != full:
+        failures.append(
+            f"elastic fit: finished on {len(backend.devices)}/{full} "
+            "devices (never re-grew to the full mesh)"
+        )
+    import shutil
+
+    shutil.rmtree(ckpt, ignore_errors=True)
+    return {
+        "cv_max_diff": diff, "n_tasks": n_tasks,
+        "tasks_salvaged": salvaged, "journaled": journaled,
+        "shrinks": stats["elastic_shrinks"],
+        "regrows": stats["elastic_regrows"],
+        "final_devices": len(backend.devices),
+    }
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: replica fleet (kill 1-of-3 under load, self-heal, 0 fail)
+# ---------------------------------------------------------------------------
+
+def scenario_replica_fleet(failures, p99_budget_ms):
+    import numpy as np
+
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.parallel import TPUBackend, faults
+    from skdist_tpu.serve import ReplicaSet
+    from skdist_tpu.testing.faultinject import FaultInjector
+
+    X, y = _data()
+    model = LogisticRegression(max_iter=30, engine="xla").fit(X, y)
+    faults.reset_stats()
+    errors = []
+    ok = [0]
+    lock = threading.Lock()
+    with ReplicaSet(n_replicas=3, backend=TPUBackend(),
+                    max_batch_rows=64, max_delay_ms=1.0) as rs:
+        rs.rollout("clf", model, methods=("predict",))
+
+        def worker(tid):
+            rng = np.random.RandomState(tid)
+            for _ in range(REQS_PER_THREAD):
+                x = rng.normal(size=(3, X.shape[1])).astype(np.float32)
+                try:
+                    out = rs.predict(x, model="clf", timeout_s=30.0)
+                    assert out.shape[0] == 3
+                    with lock:
+                        ok[0] += 1
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(exc))
+
+        inj = FaultInjector().kill_replica(1, at_request=KILL_AT)
+        with inj:
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(FLEET_THREADS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        snap = faults.snapshot()
+        st = rs.stats()
+
+    total = FLEET_THREADS * REQS_PER_THREAD
+    if (KILL_AT, "kill_replica:1") not in inj.fired:
+        failures.append("replica fleet: the kill never fired")
+    if errors or ok[0] != total:
+        failures.append(
+            f"replica fleet: {len(errors)} failed requests of {total} "
+            f"(first: {errors[:1]})"
+        )
+    if snap["replica_respawns"] < 1:
+        failures.append("replica fleet: the dead replica never respawned")
+    rep1 = st["replicas"][1]
+    if not (rep1["alive"] and rep1["generation"] >= 1):
+        failures.append(
+            f"replica fleet: replica 1 alive={rep1['alive']} "
+            f"generation={rep1['generation']} after the kill"
+        )
+    respawn_served = rep1["engine"]["completed"] if rep1["engine"] else 0
+    if respawn_served <= 0:
+        failures.append(
+            "replica fleet: the respawned replica served nothing"
+        )
+    compiles = [r["engine"]["compiles_after_warmup"]
+                for r in st["replicas"] if r["engine"]]
+    if any(c != 0 for c in compiles):
+        failures.append(
+            f"replica fleet: post-warmup compiles {compiles} != 0 "
+            "(the respawn must reuse the AOT artifacts)"
+        )
+    p99 = max((r["engine"]["p99_ms"] or 0.0)
+              for r in st["replicas"] if r["engine"])
+    if p99 > p99_budget_ms:
+        failures.append(
+            f"replica fleet: p99 {p99:.1f} ms > {p99_budget_ms} ms"
+        )
+    return {
+        "requests": total, "failed": len(errors),
+        "failovers": snap["replica_failovers"],
+        "respawns": snap["replica_respawns"],
+        "respawned_replica_served": respawn_served,
+        "post_warmup_compiles": compiles, "p99_ms": p99,
+    }
+
+
+def main(resume_frac, p99_budget_ms):
+    failures = []
+    report = {
+        "elastic_fit": scenario_elastic_fit(failures, resume_frac),
+        "replica_fleet": scenario_replica_fleet(failures, p99_budget_ms),
+    }
+    print(json.dumps(report, indent=1))
+    if failures:
+        raise SystemExit("FAIL: " + "; ".join(failures))
+    ef, rf = report["elastic_fit"], report["replica_fleet"]
+    print(
+        "PASS: preempted search parity 0.0 with "
+        f"{ef['tasks_salvaged']}/{ef['n_tasks']} tasks salvaged, "
+        f"{ef['shrinks']} shrink / {ef['regrows']} regrow, finished on "
+        f"{ef['final_devices']} devices; fleet served "
+        f"{rf['requests']}/{rf['requests']} with a replica killed "
+        f"mid-load ({rf['respawns']} respawn, "
+        f"{rf['respawned_replica_served']} requests on the respawned "
+        f"replica, 0 compiles, p99 {rf['p99_ms']:.1f} ms)"
+    )
+
+
+if __name__ == "__main__":
+    frac = 0.5
+    p99 = 5000.0
+    if "--resume-frac" in sys.argv:
+        frac = float(sys.argv[sys.argv.index("--resume-frac") + 1])
+    if "--p99-ms" in sys.argv:
+        p99 = float(sys.argv[sys.argv.index("--p99-ms") + 1])
+    main(frac, p99)

@@ -49,6 +49,17 @@
 #                             reused, <=1e-5 vs uninterrupted); lane
 #                             guard adds <=2% warm wall and 0 compiles
 #                             (fault-tolerance PR).
+#   elastic_smoke.py        — elastic execution: a specific mesh
+#                             participant preempted at round 2 of a
+#                             checkpointed search -> mesh shrinks once,
+#                             >=50% of tasks salvaged (journal-backed),
+#                             re-grows at a round boundary, cv_results_
+#                             parity 0.0 vs un-preempted; 1-of-3
+#                             serving replicas killed under threaded
+#                             load -> 0 failed requests, dead replica
+#                             drained+respawned warm (0 compiles),
+#                             respawned replica serves, p99 bounded
+#                             (elastic mesh + replica fleet PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
@@ -58,3 +69,4 @@ python build_tools/sparse_fit_smoke.py
 python build_tools/asha_smoke.py
 python build_tools/fault_smoke.py
 python build_tools/streaming_smoke.py
+python build_tools/elastic_smoke.py

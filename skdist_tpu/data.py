@@ -186,6 +186,52 @@ class ChunkedDataset:
             f"blocks, ~{self.block_nbytes >> 20} MiB/block)"
         )
 
+    def content_digest(self):
+        """Stable content identity of the dataset WITHOUT materialising
+        it: the structural meta (rows, width, block geometry, format —
+        everything ``chunked_meta.json`` records) plus head- and
+        tail-block samples through the same bounded-slab recipe the
+        resident grid signature uses (``faults._digest_update_array``).
+        This is what lets ``DistGridSearchCV.fit(dataset,
+        checkpoint_dir=...)`` key a durable journal on out-of-core
+        input: a regenerated / truncated / re-packed dataset changes
+        the digest (meta or one of the sampled blocks moves) and gets a
+        fresh journal, while re-opening the same on-disk dataset after
+        a kill resumes into the old one. Reads two blocks; cached per
+        instance (the readers are immutable by the dataset contract —
+        mutating source arrays after building a dataset is the same
+        user error as mutating a broadcast host array)."""
+        if getattr(self, "_content_digest", None) is not None:
+            return self._content_digest
+        import hashlib
+
+        from .parallel.faults import _digest_update_array
+        from .sparse import PackedX
+
+        h = hashlib.blake2b(digest_size=16)
+        h.update(repr((
+            "chunked", self.n_rows, self.n_features, self.block_rows,
+            self.x_format, self.packed_m, self.n_blocks, self.has_y,
+            self.has_sw,
+        )).encode())
+        for i in sorted({0, self.n_blocks - 1}):
+            b = self.read_block(i, pad=False)
+            if isinstance(b.X, PackedX):
+                _digest_update_array(h, np.asarray(b.X.idx))
+                _digest_update_array(h, np.asarray(b.X.val))
+            else:
+                _digest_update_array(h, np.asarray(b.X))
+            # embedded labels/weights participate too: the streamed
+            # search reads them from the dataset AFTER the signature is
+            # computed, so a regenerated dataset with the same X but
+            # different embedded sw/y must not resume the old journal
+            if b.y is not None:
+                _digest_update_array(h, np.asarray(b.y))
+            if self.has_sw:
+                _digest_update_array(h, np.asarray(b.sw))
+        self._content_digest = h.hexdigest()
+        return self._content_digest
+
     # ------------------------------------------------------------------
     # block access
     # ------------------------------------------------------------------

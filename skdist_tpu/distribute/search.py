@@ -742,14 +742,11 @@ class DistBaseSearchCV(BaseEstimator):
 
         ckpt_dir = faults.resolve_checkpoint_dir(checkpoint_dir)
         checkpoint = None
-        if ckpt_dir is not None and is_chunked(X):
-            warnings.warn(
-                "durable search checkpoints are not yet supported for "
-                "ChunkedDataset input (the grid signature would need a "
-                "streaming data digest); running without checkpointing"
-            )
-            ckpt_dir = None
         if ckpt_dir is not None:
+            # ChunkedDataset input journals too: faults.data_digest
+            # routes to the dataset's content_digest() (meta +
+            # head/tail block samples), so the structural signature is
+            # as stable across a kill+resume as the resident one
             checkpoint = faults.SearchCheckpoint(
                 ckpt_dir,
                 _checkpoint_signature(
@@ -844,7 +841,7 @@ class DistBaseSearchCV(BaseEstimator):
             # a remedy — there is no host fallback that could hold X.
             return self._run_streamed_search(
                 backend, estimator, X, y, candidate_params, splits,
-                fit_params,
+                fit_params, checkpoint=checkpoint,
             )
         n_splits = len(splits)
         batched = None
@@ -1309,14 +1306,21 @@ class DistBaseSearchCV(BaseEstimator):
         return out
 
     def _run_streamed_search(self, backend, estimator, dataset, y,
-                             candidate_params, splits, fit_params):
+                             candidate_params, splits, fit_params,
+                             checkpoint=None):
         """The out-of-core CV search: (candidate × fold) tasks fit
         through the family's streamed driver (``models/streaming``) —
         fold selection is an O(n) fold-id vector sliced per block and
         composed into the fit weights on device — then one streamed
         scoring pass accumulates each task's decomposable metric
         statistics. Everything X-sized stays on disk; per-task results
-        feed the ordinary ``_format_results`` schema."""
+        feed the ordinary ``_format_results`` schema.
+
+        With a ``checkpoint`` (grid signature keyed on the dataset's
+        ``content_digest``), journaled tasks restore instead of
+        re-fitting — whole (candidate, fold) lanes drop out of the
+        streamed task batch — and fresh completions journal as each
+        bucket's scoring pass lands."""
         import jax.numpy as jnp
 
         from ..models.linear import _freeze, hyper_float
@@ -1362,6 +1366,17 @@ class DistBaseSearchCV(BaseEstimator):
                 f"statics ({getattr(est_cls, '_static_names', ())})"
             )
         out = [None] * (len(candidate_params) * n_splits)
+        restored = set()
+        if checkpoint is not None and checkpoint.completed:
+            for gid, row in checkpoint.completed.items():
+                if 0 <= gid < len(out):
+                    row = dict(row)
+                    # tolerate rows journaled by an adaptive resident
+                    # run of the same signature shape (tag stripped for
+                    # aggregate_score_dicts' uniform keys)
+                    row.pop("rung_killed", None)
+                    out[gid] = row
+                    restored.add(gid)
         hyper_names = list(getattr(est_cls, "_hyper_names", ()))
         if est_cls._stream_fit_kind == "gram" and "alpha" not in hyper_names:
             hyper_names.append("alpha")  # LinearRegression's fixed 0.0
@@ -1394,22 +1409,30 @@ class DistBaseSearchCV(BaseEstimator):
             bucket_est = clone(estimator)
             if static_overrides:
                 bucket_est.set_params(**static_overrides)
-            y_enc, sw_arr, meta = bucket_est._prep_stream_fit(
-                dataset, y, sw
-            )
-            static_cfg = bucket_est._static_config(meta)
-            static = _freeze(static_cfg)
             task_hyper = {name: [] for name in hyper_names}
             split_ids, gids = [], []
             for cand_idx in cand_indices:
                 cand = candidate_params[cand_idx]
                 for s in range(n_splits):
+                    gid = cand_idx * n_splits + s
+                    if gid in restored:
+                        # journaled by a killed run of the same
+                        # signature: the whole lane drops out of the
+                        # streamed fit/score batch
+                        continue
                     for name in hyper_names:
                         task_hyper[name].append(float(hyper_float(
                             cand.get(name, getattr(bucket_est, name))
                         )))
                     split_ids.append(s)
-                    gids.append(cand_idx * n_splits + s)
+                    gids.append(gid)
+            if not gids:
+                continue
+            y_enc, sw_arr, meta = bucket_est._prep_stream_fit(
+                dataset, y, sw
+            )
+            static_cfg = bucket_est._static_config(meta)
+            static = _freeze(static_cfg)
             task_args = {
                 "hyper": {
                     k: np.asarray(v, dtype=np.float32)
@@ -1442,6 +1465,8 @@ class DistBaseSearchCV(BaseEstimator):
                 row["fit_time"] = per_fit
                 row["score_time"] = per_score
                 out[gid] = row
+                if checkpoint is not None:
+                    checkpoint.record(gid, row)
         _quarantine_nonfinite(out, self.error_score, context="streamed")
         return out
 

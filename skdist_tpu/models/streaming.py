@@ -172,6 +172,24 @@ def _dispatch_seam():
         inj.round_dispatched()
 
 
+def _elastic_replans(backend, plans):
+    """The elastic half of a streamed PREEMPTED restart: let an
+    elastic backend shrink its mesh to the surviving devices, then
+    re-resolve every driver plan in place against the new mesh
+    (:meth:`StreamPlan.rebuild`) BEFORE the caller re-places its task
+    trees. The divisor rule of the mesh manager keeps the shrunken
+    task extent dividing the full one, so task axes already padded to
+    full-mesh slots re-place on the shrunken mesh unchanged — which is
+    why a resumed streamed fit stays bitwise identical: the same
+    lanes, the same block order, the same arithmetic, just fewer
+    devices under them. No-op (False) on non-elastic backends."""
+    if backend.elastic_preempted():
+        for p in plans:
+            p.rebuild()
+        return True
+    return False
+
+
 def _n_tasks(task_args):
     return len(np.asarray(next(iter(task_args["hyper"].values()))))
 
@@ -214,7 +232,10 @@ def _streamed_sum(plan, read, n_blocks, tc, stats, sync, restart=None):
     pass_guard = _BlockRetry(stats)
     while True:
         acc = None
-        feeder = BlockFeeder(read, n_blocks, plan.put_block,
+        # late-bind placement through the plan object: an elastic
+        # restart rebuilds the plan in place mid-pass, and the feeder
+        # must place subsequent blocks on the NEW mesh
+        feeder = BlockFeeder(read, n_blocks, lambda t: plan.put_block(t),
                              sync=sync, stats=stats)
         guard = _BlockRetry(stats)
         try:
@@ -494,8 +515,10 @@ def _fit_lbfgs_stream(backend, est_cls, meta, static, dataset, row_arrays,
     zero_dev = {"b": _zero_block_dev(plan_reg, dataset, row_arrays)}
 
     def restart():
-        # preemption: device state presumed lost — re-place the task
-        # tree and the regulariser's zero block
+        # preemption: device state presumed lost — shrink an elastic
+        # mesh to the survivors (rebuilding the three plans), then
+        # re-place the task tree and the regulariser's zero block
+        _elastic_replans(backend, (plan_fg, plan_f, plan_reg))
         state["tasks"] = plan_fg.put_task(task_args)
         zero_dev["b"] = _zero_block_dev(plan_reg, dataset, row_arrays)
         faults.record("shared_replacements")
@@ -586,6 +609,7 @@ def _fit_gram_stream(backend, est_cls, meta, static, dataset, row_arrays,
     state = {"tasks": plan.put_task(task_args)}
 
     def restart():
+        _elastic_replans(backend, (plan, plan_fin))
         state["tasks"] = plan.put_task(task_args)
         faults.record("shared_replacements")
 
@@ -758,7 +782,10 @@ def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
         host_start = jax.device_get(carry_start)
         carry = _reset_acc(carry)
         read = read_epoch_block(e)
-        feeder = BlockFeeder(read, n_stream_blocks, plan.put_block,
+        # late-bound placement: an elastic restart rebuilds `plan` in
+        # place mid-epoch and later blocks must land on the new mesh
+        feeder = BlockFeeder(read, n_stream_blocks,
+                             lambda t: plan.put_block(t),
                              sync=sync, stats=stats)
         try:
             while True:
@@ -773,9 +800,11 @@ def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
                                           "carry": carry})
                 except Exception as exc:
                     def restart():
-                        # preemption loses device state: re-place the
+                        # preemption loses device state: shrink an
+                        # elastic mesh to the survivors, re-place the
                         # tasks and rewind to the epoch-start carry
                         nonlocal tasks_dev, carry
+                        _elastic_replans(backend, (plan,))
                         tasks_dev = plan.put_task(task_args)
                         carry = _reset_acc(plan.put_task(host_start))
                         faults.record("shared_replacements")
@@ -801,6 +830,7 @@ def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
             epoch_guard.retry.admit(_RoundFault([], 0, exc, kind), e)
             stats["retries"] = epoch_guard.retry.total
             if kind == faults.PREEMPTED:
+                _elastic_replans(backend, (plan,))
                 tasks_dev = plan.put_task(task_args)
                 faults.record("shared_replacements")
             carry = plan.put_task(host_start)
@@ -966,9 +996,20 @@ def stream_scores(backend, est_cls, meta, static, dataset, row_arrays,
     task_args, _Tp = _slot_pad_tree(task_args, T, plan.n_task_slots)
     params, _Tp = _slot_pad_tree(params, T, plan.n_task_slots)
     read = _make_block_read(dataset, row_arrays, pad=True)
-    tc = {"task": plan.put_task(task_args),
-          "params": plan.put_task(params)}
-    acc = _streamed_sum(plan, read, dataset.n_blocks, tc, stats, sync)
+    state = {"tc": {"task": plan.put_task(task_args),
+                    "params": plan.put_task(params)}}
+
+    def restart():
+        # preemption mid-scoring: same contract as the fit passes —
+        # elastic shrink + re-place the task/param trees
+        _elastic_replans(backend, (plan,))
+        state["tc"] = {"task": plan.put_task(task_args),
+                       "params": plan.put_task(params)}
+        faults.record("shared_replacements")
+
+    acc = _streamed_sum(plan, read, dataset.n_blocks,
+                        lambda: state["tc"], stats, sync,
+                        restart=restart)
     out = {}
     for key, parts in acc.items():
         prefix, name = key.split("_", 1)

@@ -28,11 +28,13 @@ from .backend import (
     tree_nbytes,
 )
 from .compile_cache import enable_disk_cache, structural_key
+from .mesh import ElasticMeshManager
 
 __all__ = [
     "TaskBackend",
     "LocalBackend",
     "TPUBackend",
+    "ElasticMeshManager",
     "BatchedPlan",
     "BlockFeeder",
     "StreamPlan",

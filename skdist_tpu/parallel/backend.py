@@ -181,6 +181,21 @@ class TaskBackend:
     #: backends); BatchedPlan callers shape their task axis to this
     n_task_slots = 1
 
+    #: the elastic-mesh manager (``TPUBackend(elastic=...)``); None on
+    #: backends without preemptible capacity
+    elastic = None
+
+    def elastic_preempted(self):
+        """PREEMPTED seen by a caller-owned dispatch loop: hook for
+        elastic backends to shrink their mesh. Base backends have no
+        mesh to shrink — False means "nothing changed, just
+        re-place"."""
+        return False
+
+    def elastic_regrow_check(self):
+        """Round-boundary regrow probe; False on non-elastic backends."""
+        return False
+
     def _free_device_bytes(self):
         """Free memory on the execution device, or None where the
         backend reports no stats (host/CPU backends)."""
@@ -697,7 +712,7 @@ class TPUBackend(TaskBackend):
     def __init__(self, devices=None, axis_name="tasks", round_size=None,
                  n_jobs=None, data_axis_size=1, mesh=None,
                  reuse_broadcast=False, compile_cache_dir=None,
-                 sync_rounds=None, donate_tasks=True):
+                 sync_rounds=None, donate_tasks=True, elastic=None):
         """``data_axis_size`` > 1 builds a 2D ('tasks', 'data') mesh:
         that many devices cooperate on each task with row-sharded shared
         data (GSPMD inserts the psum of gram/gradient partials over
@@ -727,6 +742,16 @@ class TPUBackend(TaskBackend):
         donation of per-round task-axis input buffers (donation
         reclaims one round's task-argument HBM for outputs/temps and is
         safe because every round places a fresh slice).
+
+        ``elastic`` opts this backend into elastic execution under
+        preemption: ``True`` (or a kwargs dict for
+        :class:`~skdist_tpu.parallel.mesh.ElasticMeshManager`, or a
+        pre-built manager) makes a PREEMPTED round shrink the mesh to
+        the surviving devices, resume from the first unfinished task
+        (re-placing shared args through the ordinary placement path),
+        and re-grow to the full mesh at the next round boundary once
+        capacity returns. Off by default — the non-elastic preemption
+        contract (re-place on the SAME mesh) is unchanged.
         """
         import jax
         from jax.sharding import Mesh
@@ -751,6 +776,7 @@ class TPUBackend(TaskBackend):
             self.data_axis_size = dict(
                 zip(mesh.axis_names, mesh.devices.shape)
             ).get("data", 1)
+            self.elastic = self._make_elastic(elastic)
             return
         if devices is None:
             devices = jax.devices()
@@ -768,6 +794,66 @@ class TPUBackend(TaskBackend):
             self.mesh = task_data_mesh(self.devices, data_axis_size)
         else:
             self.mesh = Mesh(np.array(self.devices), (axis_name,))
+        self.elastic = self._make_elastic(elastic)
+
+    def _make_elastic(self, spec):
+        """Normalise the ``elastic=`` knob: None/False → off; True or
+        a kwargs dict → a manager over THIS backend's roster; a
+        pre-built :class:`ElasticMeshManager` is adopted as-is."""
+        if not spec:
+            return None
+        from .mesh import ElasticMeshManager
+
+        if isinstance(spec, ElasticMeshManager):
+            return spec
+        if len(self.mesh.axis_names) > 2:
+            raise ValueError(
+                "elastic execution supports the standard 1D (tasks,) "
+                "and 2D (tasks, data) meshes; got axes "
+                f"{self.mesh.axis_names}"
+            )
+        kwargs = dict(spec) if isinstance(spec, dict) else {}
+        return ElasticMeshManager(
+            devices=self.devices, axis_name=self.axis_name,
+            data_axis_size=self.data_axis_size, **kwargs,
+        )
+
+    def _adopt_mesh(self, mesh):
+        """Swap in a (shrunken or regrown) elastic mesh: the device
+        roster and every placement decision from here on bind to it;
+        compiled programs for the new sharding build lazily through
+        the ordinary structural-cache path."""
+        self.mesh = mesh
+        self.devices = list(mesh.devices.flat)
+
+    def elastic_preempted(self):
+        """A round classified PREEMPTED: drop cached broadcasts
+        (device state is presumed lost) and, when elastic, shrink the
+        mesh to the surviving devices. Returns True when the mesh
+        CHANGED — callers owning their own dispatch plans (streamed
+        drivers) rebuild them; ``batched_map`` re-prepares its plan
+        unconditionally, as the non-elastic contract already did."""
+        _BCAST_CACHE.clear()
+        if self.elastic is None:
+            return False
+        mesh = self.elastic.on_preempted()
+        if mesh is None:
+            return False
+        self._adopt_mesh(mesh)
+        return True
+
+    def elastic_regrow_check(self):
+        """Round-boundary half of the elastic contract: while
+        degraded, probe for returned capacity and re-grow. Returns
+        True when the mesh changed (callers re-place/re-prepare)."""
+        if self.elastic is None:
+            return False
+        mesh = self.elastic.maybe_regrow()
+        if mesh is None:
+            return False
+        _BCAST_CACHE.clear()
+        self._adopt_mesh(mesh)
+        return True
 
     @property
     def n_devices(self):
@@ -857,35 +943,47 @@ class TPUBackend(TaskBackend):
         the per-block shared tree row-shards onto the mesh 'data' axis
         when one exists (:func:`_block_shardings`) — streamed blocks
         land on the same axis the resident row-sharded path uses, so
-        GSPMD inserts the identical psum of gram/gradient partials."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        GSPMD inserts the identical psum of gram/gradient partials.
 
-        task_sharding = NamedSharding(self.mesh, P(self.axis_name))
-        block_shardings = _block_shardings(self, block_example)
-        fn = _jit_vmapped(
-            kernel, static_args, task_sharding, block_shardings,
-            cache_key, False,
-        )
+        The returned plan carries a ``rebuild`` hook re-resolving it
+        against the backend's CURRENT mesh — the elastic-restart seam
+        for the streamed drivers."""
+        self.elastic_regrow_check()
 
-        def put_task(t):
-            return jax.tree_util.tree_map(
-                lambda a: _put_mesh_scoped(a, task_sharding), t
+        def resolve(plan):
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            task_sharding = NamedSharding(self.mesh, P(self.axis_name))
+            block_shardings = _block_shardings(self, block_example)
+            plan.fn = _jit_vmapped(
+                kernel, static_args, task_sharding, block_shardings,
+                cache_key, False,
             )
 
-        if isinstance(block_shardings, NamedSharding):
-            def put_block(t):
+            def put_task(t):
                 return jax.tree_util.tree_map(
-                    lambda a: _put_mesh_scoped(a, block_shardings), t
-                )
-        else:
-            def put_block(t):
-                return jax.tree_util.tree_map(
-                    _put_mesh_scoped, t, block_shardings
+                    lambda a: _put_mesh_scoped(a, task_sharding), t
                 )
 
-        return StreamPlan(fn, put_task, put_block,
-                          n_task_slots=self.n_devices)
+            if isinstance(block_shardings, NamedSharding):
+                def put_block(t):
+                    return jax.tree_util.tree_map(
+                        lambda a: _put_mesh_scoped(a, block_shardings), t
+                    )
+            else:
+                def put_block(t):
+                    return jax.tree_util.tree_map(
+                        _put_mesh_scoped, t, block_shardings
+                    )
+
+            plan.put_task = put_task
+            plan.put_block = put_block
+            plan.n_task_slots = self.n_devices
+
+        plan = StreamPlan(None, None, None, rebuild=resolve)
+        resolve(plan)
+        return plan
 
     def prepare_batched_iterative(self, spec, shared_args=(),
                                   static_args=None, shared_specs=None,
@@ -916,6 +1014,7 @@ class TPUBackend(TaskBackend):
         the per-slice host compaction decisions would otherwise need
         cross-process agreement at every slice (and the fallback is
         exhaustive: the rung is reset, never applied)."""
+        self.elastic_regrow_check()
         n_tasks = _leading_dim(task_args)
         d = self.n_devices
         if self._spans_processes():
@@ -1048,6 +1147,9 @@ class TPUBackend(TaskBackend):
         """
         import jax
 
+        # a degraded elastic backend re-grows at dispatch entry too —
+        # a fresh fit should start on whatever capacity exists NOW
+        self.elastic_regrow_check()
         n_tasks = _leading_dim(task_args)
         d = self.n_devices
         round_size = round_size or self.round_size or n_tasks
@@ -1096,7 +1198,32 @@ class TPUBackend(TaskBackend):
         retry = _RetryState()
         rounds_out = []
         offset = 0
+        salvage_mark = 0  # tasks already credited to elastic salvage
         while offset < n_tasks:
+            degraded = self.elastic is not None and self.elastic.degraded
+            if degraded and self.elastic_regrow_check():
+                # capacity returned at a round boundary: re-grow —
+                # re-place the shared args on the full mesh and realign
+                # the round size to the new device count (compiled
+                # programs for the new sharding build lazily)
+                d = self.n_devices
+                chunk = int(math.ceil(chunk / d) * d)
+                plan = self.prepare_batched(
+                    kernel, shared_args, static_args, shared_specs,
+                    cache_key,
+                )
+                fn, shared_placed, put = plan.fn, plan.shared, plan.put
+                exec_fn, chunk = _aot_exec_fn(
+                    fn, shared_placed, task_args, chunk, d, None
+                )
+                degraded = self.elastic.degraded
+            # while degraded, dispatch ONE round per call so every
+            # round boundary returns here for the regrow probe — the
+            # "re-grow at the next round boundary" half of the elastic
+            # contract. Cross-round pipelining is suspended while
+            # degraded; it resumes with the full mesh.
+            span = min(chunk, n_tasks - offset) if degraded \
+                else n_tasks - offset
             sub = (
                 jax.tree_util.tree_map(lambda a: a[offset:], task_args)
                 if offset else task_args
@@ -1108,12 +1235,13 @@ class TPUBackend(TaskBackend):
             )
             try:
                 rounds_out.extend(_run_in_rounds(
-                    exec_fn, sub, shared_placed, n_tasks - offset, chunk,
+                    exec_fn, sub, shared_placed, span, chunk,
                     put=put, timings=timings, concat=False,
                     pipeline=not self.sync_rounds, stats=stats,
                     on_round=cb,
                 ))
-                break
+                offset += span
+                continue
             except _RoundsExhausted as oom:
                 if multiprocess:
                     # The reactive resume is driven by a LOCALLY caught
@@ -1158,11 +1286,24 @@ class TPUBackend(TaskBackend):
                 retry.admit(rf, offset)  # raises rf.cause when spent
                 if rf.kind == faults.PREEMPTED:
                     # device state is presumed lost with the preempted
-                    # worker: drop cached broadcasts and re-place the
-                    # shared args through a fresh placement pass (the
-                    # jit entry and its AOT executables are host-side
-                    # memos and survive)
-                    _BCAST_CACHE.clear()
+                    # worker: drop cached broadcasts, let an elastic
+                    # mesh shrink to the surviving devices, and
+                    # re-place the shared args through a fresh
+                    # placement pass (the jit entries are host-side
+                    # memos and survive; a changed mesh compiles its
+                    # own executables lazily). The gathered prefix —
+                    # `offset` tasks, the same prefix the checkpoint
+                    # journal holds — is NOT re-run: the resume
+                    # re-dispatches from the first unfinished task.
+                    if self.elastic_preempted():
+                        d = self.n_devices
+                        chunk = int(math.ceil(chunk / d) * d)
+                        # credit only the prefix not already counted by
+                        # an earlier shrink in this call — the tasks
+                        # the shrunken mesh does NOT re-run
+                        faults.record("elastic_tasks_salvaged",
+                                      offset - salvage_mark)
+                        salvage_mark = offset
                     plan = self.prepare_batched(
                         kernel, shared_args, static_args, shared_specs,
                         cache_key,
@@ -1255,15 +1396,32 @@ class StreamPlan:
     there the shared data is resident and tasks stream; here the tasks
     are resident and the data streams. Built by
     :meth:`TaskBackend.prepare_streamed`; driven by the streamed fit/
-    predict drivers (``models/streaming.py``)."""
+    predict drivers (``models/streaming.py``).
 
-    __slots__ = ("fn", "put_task", "put_block", "n_task_slots")
+    The plan is MUTABLE-in-place on elastic backends: after a
+    preemption shrinks (or a boundary regrows) the mesh,
+    :meth:`rebuild` re-resolves ``fn``/``put_task``/``put_block``
+    against the backend's current mesh without changing the plan's
+    identity — drivers and feeders that late-bind through the plan
+    object (``plan.fn(...)``, ``lambda t: plan.put_block(t)``) pick up
+    the new mesh on their next dispatch."""
 
-    def __init__(self, fn, put_task, put_block, n_task_slots=1):
+    __slots__ = ("fn", "put_task", "put_block", "n_task_slots",
+                 "_rebuild")
+
+    def __init__(self, fn, put_task, put_block, n_task_slots=1,
+                 rebuild=None):
         self.fn = fn
         self.put_task = put_task
         self.put_block = put_block
         self.n_task_slots = n_task_slots
+        self._rebuild = rebuild
+
+    def rebuild(self):
+        """Re-resolve this plan against the backend's CURRENT mesh
+        (elastic shrink/regrow); a no-op on backends without one."""
+        if self._rebuild is not None:
+            self._rebuild(self)
 
 
 def _block_shardings(backend, block_example):
@@ -1943,8 +2101,12 @@ def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
                         # state (placed shared args, cached broadcasts)
                         # is presumed lost with the preempted worker —
                         # retrying against the old plan's buffers would
-                        # burn the whole budget on dead state
-                        _BCAST_CACHE.clear()
+                        # burn the whole budget on dead state. An
+                        # elastic backend additionally shrinks its mesh
+                        # to the survivors here (the divisor rule keeps
+                        # `chunk` slot-aligned on the shrunken mesh, so
+                        # the compacted rounds re-run unchanged).
+                        backend.elastic_preempted()
                         plan = backend.prepare_batched_iterative(
                             spec, shared_args, static_args,
                             shared_specs, cache_key,

@@ -156,32 +156,60 @@ class RetryPolicy:
     meshes — a locally caught exception cannot be re-synchronised with
     peers already inside the next collective.
 
-    Deliberately jitter-free: one process re-dispatching onto its own
-    mesh has no thundering-herd peer, and determinism keeps the
-    fault-injection matrix bitwise-checkable.
+    Jitter is OPT-IN (``jitter_ms`` / ``SKDIST_RETRY_JITTER_MS``,
+    default 0): one process re-dispatching onto its own mesh has no
+    thundering-herd peer, and the jitter-free default keeps the
+    fault-injection matrix bitwise-checkable. A FLEET of replicas or
+    hosts retrying against one shared resource (coordinator, storage,
+    the recovering device pool itself) is exactly where synchronized
+    retry storms come from — there, a uniform extra delay in
+    ``[0, jitter_ms)`` per attempt decorrelates the herd. The jitter
+    rides ON TOP of :meth:`delay_s` (which stays deterministic — it is
+    what tests and log lines reason about); only the actual sleep
+    moves.
     """
 
-    __slots__ = ("max_retries", "backoff_ms", "max_backoff_ms", "_sleep")
+    __slots__ = ("max_retries", "backoff_ms", "max_backoff_ms", "_sleep",
+                 "jitter_ms", "_rng")
 
     def __init__(self, max_retries=None, backoff_ms=None,
-                 max_backoff_ms=5000.0, sleep=time.sleep):
+                 max_backoff_ms=5000.0, sleep=time.sleep,
+                 jitter_ms=None, rng=None):
         if max_retries is None:
             max_retries = _env_int("SKDIST_ROUND_RETRIES", 2)
         if backoff_ms is None:
             backoff_ms = _env_float("SKDIST_RETRY_BACKOFF_MS", 50.0)
+        if jitter_ms is None:
+            jitter_ms = _env_float("SKDIST_RETRY_JITTER_MS", 0.0)
         self.max_retries = max(0, int(max_retries))
         self.backoff_ms = max(0.0, float(backoff_ms))
         self.max_backoff_ms = float(max_backoff_ms)
+        self.jitter_ms = max(0.0, float(jitter_ms))
         self._sleep = sleep
+        self._rng = rng  # lazily a random.Random; injectable for tests
 
     def delay_s(self, attempt):
-        """Backoff before consecutive attempt ``attempt`` (1-based)."""
+        """Deterministic backoff before consecutive attempt ``attempt``
+        (1-based) — excludes jitter by design (class docstring)."""
         ms = min(self.backoff_ms * (2.0 ** (attempt - 1)),
                  self.max_backoff_ms)
         return ms / 1e3
 
+    def jitter_s(self):
+        """One draw of the opt-in decorrelation delay: uniform in
+        ``[0, jitter_ms)`` seconds; exactly 0.0 when jitter is off (the
+        default — no RNG is even constructed, so injection runs stay
+        bitwise-checkable)."""
+        if self.jitter_ms <= 0.0:
+            return 0.0
+        if self._rng is None:
+            import random
+
+            self._rng = random.Random()
+        return self._rng.uniform(0.0, self.jitter_ms) / 1e3
+
     def backoff(self, attempt):
-        d = self.delay_s(attempt)
+        d = self.delay_s(attempt) + self.jitter_s()
         if d > 0:
             self._sleep(d)
         return d
@@ -223,6 +251,12 @@ _STATS = {
     "suppressed": 0,           # exceptions logged instead of swallowed
     "checkpoint_hits": 0,      # tasks skipped because a journal had them
     "watchdog_trips": 0,       # dispatches past their watchdog budget
+    "elastic_shrinks": 0,      # mesh rebuilt over survivors (preemption)
+    "elastic_regrows": 0,      # mesh re-grown after capacity returned
+    "elastic_tasks_salvaged": 0,  # tasks NOT re-run across an elastic
+                                  # shrink (journaled/gathered prefix)
+    "replica_failovers": 0,    # requests re-routed off a sick replica
+    "replica_respawns": 0,     # serving replicas drained + respawned
 }
 
 
@@ -414,10 +448,16 @@ def _digest_update_array(h, arr):
 
 
 def data_digest(X):
-    """Stable digest of a training array (dense, pandas, or scipy
-    sparse) for the grid signature."""
+    """Stable digest of a training input (dense, pandas, scipy sparse,
+    or any object exposing ``content_digest()`` — e.g. a
+    ``ChunkedDataset``, whose digest covers its meta + head/tail block
+    samples without materialising the out-of-core matrix) for the grid
+    signature."""
     import hashlib
 
+    digest = getattr(X, "content_digest", None)
+    if callable(digest):
+        return str(digest())
     h = hashlib.blake2b(digest_size=16)
     if hasattr(X, "values") and not isinstance(X, np.ndarray):
         X = X.values

@@ -16,13 +16,21 @@ SparkContext. Here the cluster is a ``jax.sharding.Mesh``:
   ``create_hybrid_device_mesh`` produces.
 """
 
+import logging
+import time
+
 import numpy as np
+
+from . import faults
 
 __all__ = [
     "initialize_cluster",
     "task_data_mesh",
     "multihost_task_mesh",
+    "ElasticMeshManager",
 ]
+
+logger = logging.getLogger("skdist_tpu.mesh")
 
 
 def initialize_cluster(coordinator_address=None, num_processes=None,
@@ -122,3 +130,197 @@ def multihost_task_mesh(data_axis_size=None):
     devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
     arr = np.array(devices).reshape(-1, data_axis_size)
     return Mesh(arr, ("tasks", "data"))
+
+
+# ---------------------------------------------------------------------------
+# elastic meshes (preemptible capacity)
+# ---------------------------------------------------------------------------
+
+class ElasticMeshManager:
+    """Shrink / resume / re-grow policy for a mesh on preemptible
+    capacity — the analogue of Spark dynamic allocation plus executor
+    loss handling (the driver kept scheduling on the executors that
+    remained, and took preempted ones back when the cluster returned
+    them).
+
+    The manager owns the FULL device roster and a partition of it into
+    *participants* — the units that are preempted and restored together
+    (a host's local devices on multi-process meshes; individual
+    devices, or ``group_size`` blocks, on a single-controller mesh).
+    Three calls drive the state machine, all invoked by the elastic
+    backend (``TPUBackend(elastic=...)``), never by user code:
+
+    - :meth:`on_preempted` — a round classified PREEMPTED: probe which
+      participants are lost and rebuild the mesh over the survivors.
+      Returns the new (shrunken) mesh, or None when the probe says the
+      current mesh already matches (the caller still re-places device
+      state either way — preemption presumes it lost).
+    - :meth:`maybe_regrow` — called at round boundaries while degraded:
+      when the probe reports capacity back, rebuild the larger (up to
+      full) mesh. Returns the new mesh or None.
+    - :attr:`degraded` — whether the current mesh is smaller than full.
+
+    **Shrink geometry.** The shrunken task extent is the largest
+    divisor of the FULL task extent that the survivors can still
+    populate (times the unchanged 'data' axis). The divisor rule is
+    what keeps every task axis laid out for the full mesh — padded
+    carries, slot-aligned chunks, streamed task trees — placeable on
+    the shrunken mesh without re-padding: anything divisible by the
+    full extent is divisible by each of its divisors.
+
+    **Probing.** ``probe`` is the seam to real preemption signals
+    (plant notifications, heartbeat loss, device health): a callable
+    returning the set of currently-LOST participant ids. The default
+    consults the installed fault injector's ``lost_participants()``
+    (deterministic tests/smokes) and reports nothing lost otherwise —
+    on real clusters the PREEMPTED classification itself is the loss
+    signal and the operator wires a probe.
+
+    **Multi-host.** ``cluster`` (a dict of ``initialize_cluster``
+    kwargs) is the re-init seam for meshes spanning processes: when
+    capacity returns, :meth:`rebuild_cluster` tears down and re-joins
+    the jax.distributed cluster before the mesh is rebuilt. Today's
+    in-process elastic path covers single-controller meshes (a
+    shrunken local device set); the multi-process round loop stays
+    fail-loud (its collectives cannot be re-synchronised mid-dispatch)
+    and resumes through durable checkpoints on restart.
+    """
+
+    def __init__(self, devices=None, axis_name="tasks", data_axis_size=1,
+                 group_size=None, probe=None, cluster=None):
+        import jax
+
+        if devices is None:
+            devices = jax.devices()
+        self.full_devices = list(devices)
+        self.axis_name = axis_name
+        self.data_axis_size = max(1, int(data_axis_size))
+        if len(self.full_devices) % self.data_axis_size:
+            raise ValueError(
+                f"data_axis_size={self.data_axis_size} must divide the "
+                f"device count {len(self.full_devices)}"
+            )
+        self.full_extent = len(self.full_devices) // self.data_axis_size
+        self._probe = probe
+        self.cluster = dict(cluster) if cluster else None
+        # participant partition: by process on multi-process rosters,
+        # else group_size blocks (default 1 device = 1 participant)
+        n_proc = len({d.process_index for d in self.full_devices})
+        if group_size is None and n_proc > 1:
+            self._pid_of = {
+                id(d): d.process_index for d in self.full_devices
+            }
+        else:
+            gs = max(1, int(group_size or 1))
+            self._pid_of = {
+                id(d): i // gs for i, d in enumerate(self.full_devices)
+            }
+        self.participant_ids = sorted(set(self._pid_of.values()))
+        self.current_extent = self.full_extent
+        #: shrink/regrow log: dicts with kind, lost, extents, wall time
+        self.events = []
+
+    # ------------------------------------------------------------------
+    @property
+    def degraded(self):
+        return self.current_extent < self.full_extent
+
+    def _probe_lost(self):
+        """Currently-lost participant ids (a frozenset)."""
+        if self._probe is not None:
+            return frozenset(self._probe())
+        inj = faults.active_injector()
+        lost = getattr(inj, "lost_participants", None)
+        if callable(lost):
+            return frozenset(lost())
+        return frozenset()
+
+    def _survivors(self, lost):
+        return [d for d in self.full_devices
+                if self._pid_of[id(d)] not in lost]
+
+    def _fit_extent(self, n_survivors):
+        """Largest divisor of the full task extent the survivors can
+        populate (see class docstring), or 0 when even one task slot
+        cannot be formed."""
+        best = 0
+        for t in range(1, self.full_extent + 1):
+            if self.full_extent % t == 0 and \
+                    t * self.data_axis_size <= n_survivors:
+                best = t
+        return best
+
+    def _build(self, extent, survivors):
+        from jax.sharding import Mesh
+
+        picked = survivors[: extent * self.data_axis_size]
+        if self.data_axis_size > 1:
+            arr = np.array(picked).reshape(extent, self.data_axis_size)
+            return Mesh(arr, (self.axis_name, "data"))
+        return Mesh(np.array(picked), (self.axis_name,))
+
+    def _resize(self, kind, lost):
+        survivors = self._survivors(lost)
+        extent = self._fit_extent(len(survivors))
+        if extent == 0:
+            raise RuntimeError(
+                f"elastic mesh cannot shrink below one task slot: "
+                f"{len(survivors)} surviving device(s) for "
+                f"data_axis_size={self.data_axis_size} (lost "
+                f"participants: {sorted(lost)})"
+            )
+        if extent == self.current_extent:
+            return None
+        mesh = self._build(extent, survivors)
+        self.events.append({
+            "kind": kind, "lost": sorted(lost),
+            "from_extent": self.current_extent, "to_extent": extent,
+            "t": time.time(),
+        })
+        logger.warning(
+            "elastic mesh %s: task extent %d -> %d (lost participants: "
+            "%s)", kind, self.current_extent, extent, sorted(lost) or "none",
+        )
+        self.current_extent = extent
+        faults.record(
+            "elastic_shrinks" if kind == "shrink" else "elastic_regrows"
+        )
+        return mesh
+
+    # ------------------------------------------------------------------
+    def on_preempted(self):
+        """A PREEMPTED round: rebuild over the survivors. Returns the
+        shrunken mesh or None when the extent is unchanged (the caller
+        re-places shared state either way)."""
+        return self._resize("shrink", self._probe_lost())
+
+    def maybe_regrow(self):
+        """Round-boundary check while degraded: when the probe reports
+        capacity back, rebuild the larger mesh (re-joining the cluster
+        first where configured). Returns the new mesh or None."""
+        if not self.degraded:
+            return None
+        lost = self._probe_lost()
+        survivors = self._survivors(lost)
+        if self._fit_extent(len(survivors)) <= self.current_extent:
+            return None
+        if self.cluster is not None:
+            self.rebuild_cluster()
+        return self._resize("regrow", lost)
+
+    def rebuild_cluster(self):
+        """Re-join the jax.distributed cluster (the multi-host 'regrow'
+        leg: restored hosts re-initialize into the global device set).
+        A no-op failure is logged, not fatal — the local device roster
+        still regrows."""
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception as exc:  # not initialised / already down
+            faults.log_suppressed("ElasticMeshManager.shutdown", exc,
+                                  level=logging.DEBUG)
+        try:
+            initialize_cluster(**self.cluster)
+        except Exception as exc:
+            faults.log_suppressed("ElasticMeshManager.reinit", exc)

@@ -26,6 +26,12 @@ over the same compiled block-inference programs the offline
   round estimate).
 - :class:`ServingStats` — rolling latency percentiles, queue depth,
   batch-fill ratio, bucket-hit histogram, compiles-after-warmup.
+- :class:`ReplicaSet` — the self-healing fleet: N engines behind
+  least-loaded health-driven routing with transparent failover,
+  drain+respawn of replicas whose breaker/watchdog trips, fleet-wide
+  prewarm-before-publish rollouts, and a shared on-disk AOT artifact
+  tier so a respawned (or fresh-process) replica serves its first
+  request with zero compiles.
 
 Quickstart::
 
@@ -50,10 +56,13 @@ from .batcher import (
 )
 from .engine import ServingEngine
 from .registry import ModelEntry, ModelRegistry
+from .replicaset import AllReplicasUnhealthy, ReplicaSet
 from .stats import ServingStats
 
 __all__ = [
     "ServingEngine",
+    "ReplicaSet",
+    "AllReplicasUnhealthy",
     "ModelRegistry",
     "ModelEntry",
     "MicroBatcher",

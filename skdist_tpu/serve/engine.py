@@ -267,6 +267,12 @@ class ServingEngine:
                               else round(self.watchdog_s * 1e3, 3))
         return out
 
+    @property
+    def closed(self):
+        """Whether admissions are stopped — the ReplicaSet router's
+        cheap liveness read."""
+        return self._closed
+
     def queue_depth(self):
         """Total queued requests across batchers — read from the
         per-batcher stats gauges (one lock, O(#gauges)), NOT by taking

@@ -22,6 +22,16 @@ fires at most ``times`` times, so the retried dispatch sails through.
 Everything is host-side and deterministic: no randomness, no clocks in
 the decision path, so an injected run's task outputs are bitwise
 reproducible.
+
+Two TARGETED scenarios ride the same ordinals for the elastic layer:
+:meth:`FaultInjector.on_host` preempts a SPECIFIC mesh participant
+(the raise at its ordinal plus a loss mark the
+``ElasticMeshManager``'s probe reads until capacity "returns" N
+dispatches later), and :meth:`FaultInjector.kill_replica` kills a
+SPECIFIC serving replica when the ``ReplicaSet`` router dispatches a
+chosen request ordinal — so "host 1 dies at round 2 and comes back
+2 rounds later" and "replica 1 dies at request 40 under load" are
+exact, replayable sentences rather than races.
 """
 
 import os
@@ -76,6 +86,14 @@ class FaultInjector:
         self._exact = {}    # ordinal -> [_Rule, ...]
         self._every = []    # (period, _Rule)
         self.fired = []
+        # elastic-mesh scenarios: ordinal -> [(participant,
+        # restore_after_rounds or None)] armed when that ordinal
+        # dispatches; participant -> restore_at ordinal (None = never)
+        self._loss_plan = {}
+        self._lost = {}
+        # replica scenarios: request ordinal -> [replica indices] the
+        # router must kill BEFORE dispatching that request
+        self._replica_kills = {}
 
     # ------------------------------------------------------------------
     # plan construction
@@ -100,6 +118,41 @@ class FaultInjector:
                             else int(start), rule))
         return self
 
+    def on_host(self, participant, at_round, restore_after=None,
+                times=1):
+        """Preempt a SPECIFIC mesh participant: at dispatch ordinal
+        ``at_round`` a preemption raises (exactly like ``at_round(...,
+        kind="preempt")``) AND participant ``participant`` is marked
+        LOST — :meth:`lost_participants` (the
+        ``ElasticMeshManager``'s default probe) reports it until
+        capacity "returns" after ``restore_after`` further dispatch
+        ordinals (None = never within this plan). This is what makes
+        "host 1 is preempted at round k and comes back m rounds later"
+        deterministically expressible — the round-ordinal preempt alone
+        could not say WHICH participant died, so an elastic mesh had
+        nothing concrete to shrink around."""
+        self.at_round(int(at_round), kind="preempt", times=times)
+        self._loss_plan.setdefault(int(at_round), []).append(
+            (int(participant),
+             None if restore_after is None else int(restore_after))
+        )
+        return self
+
+    def kill_replica(self, replica, at_request, times=1):
+        """Kill a SPECIFIC serving replica: when the ``ReplicaSet``
+        router dispatches its ``at_request``-th request (0-based, the
+        router's own deterministic ordinal), replica ``replica`` is
+        killed abruptly (``close(drain=False)`` — queued futures fail,
+        exactly like a process death) BEFORE the request routes. The
+        router consults :meth:`replica_kills_due` on every request;
+        ``times`` caps how many requests at that ordinal trigger it
+        (>1 only matters with retries consuming request ordinals)."""
+        del times  # one ordinal routes one request; kept for symmetry
+        self._replica_kills.setdefault(int(at_request), []).append(
+            int(replica)
+        )
+        return self
+
     # ------------------------------------------------------------------
     # runtime hooks (called by the round loop through the faults seam)
     # ------------------------------------------------------------------
@@ -121,6 +174,13 @@ class FaultInjector:
             for rule in todo:
                 rule.times -= 1
                 self.fired.append((ordinal, rule.kind))
+            for participant, restore_after in self._loss_plan.pop(
+                    ordinal, ()):
+                self._lost[participant] = (
+                    None if restore_after is None
+                    else self._count + restore_after
+                )
+                self.fired.append((ordinal, f"lost:{participant}"))
         for rule in todo:
             if rule.kind == "hang":
                 time.sleep(rule.sleep_s)
@@ -163,6 +223,34 @@ class FaultInjector:
             if "nan" not in fired_here:
                 return []
             return [r for r in self._rules_for(ordinal) if r.kind == "nan"]
+
+    # ------------------------------------------------------------------
+    # elastic-mesh / replica scenario hooks
+    # ------------------------------------------------------------------
+    def lost_participants(self):
+        """Currently-lost mesh participants (the ``ElasticMeshManager``
+        probe): a participant marked by :meth:`on_host` stays lost
+        until the dispatch count reaches its restore ordinal — rounds
+        are the clock, so "capacity returns after N more rounds" is
+        exact and replayable."""
+        with self._lock:
+            return {
+                p for p, restore_at in self._lost.items()
+                if restore_at is None or self._count < restore_at
+            }
+
+    def replica_kills_due(self, request_ordinal):
+        """Replica indices the router must kill before dispatching its
+        ``request_ordinal``-th request (consumed: each plan entry fires
+        once). Records ``(request_ordinal, "kill_replica:<i>")`` in
+        :attr:`fired`."""
+        with self._lock:
+            due = self._replica_kills.pop(int(request_ordinal), [])
+            for i in due:
+                self.fired.append(
+                    (int(request_ordinal), f"kill_replica:{i}")
+                )
+            return due
 
     # ------------------------------------------------------------------
     @property
