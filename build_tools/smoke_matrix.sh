@@ -49,6 +49,16 @@
 #                             reused, <=1e-5 vs uninterrupted); lane
 #                             guard adds <=2% warm wall and 0 compiles
 #                             (fault-tolerance PR).
+#   kernels_smoke.py        — on-chip kernel push: interpret-mode
+#                             Pallas packed-CSR kernel parity <= 1e-5
+#                             vs the XLA kernels (+ identical batched
+#                             CV scores through mode='pallas'),
+#                             chunked-gram parity, int8/bf16
+#                             registration parity inside the
+#                             documented bound with smaller staged
+#                             params, 0 post-warmup compiles across
+#                             all three serve_dtype variants
+#                             (Pallas kernels + quantized serving PR).
 #   elastic_smoke.py        — elastic execution: a specific mesh
 #                             participant preempted at round 2 of a
 #                             checkpointed search -> mesh shrinks once,
@@ -70,3 +80,4 @@ python build_tools/asha_smoke.py
 python build_tools/fault_smoke.py
 python build_tools/streaming_smoke.py
 python build_tools/elastic_smoke.py
+python build_tools/kernels_smoke.py

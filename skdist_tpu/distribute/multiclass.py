@@ -735,6 +735,9 @@ class DistOneVsRestClassifier(BaseEstimator, ClassifierMixin):
                     lambda *xs: np.concatenate(xs, axis=0), *parts
                 )
             )
+            from ..models.linear import annotate_round_kernel_mode
+
+            annotate_round_kernel_mode(backend, meta)
             _warn_nonfinite_lanes(
                 stacked,
                 lambda i: f"class {self._col_label(live[i])!r}",
@@ -1058,6 +1061,9 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
                 shared_specs=specs,
                 cache_key=kernel_key,
             )
+        from ..models.linear import annotate_round_kernel_mode
+
+        annotate_round_kernel_mode(backend, meta)
         _warn_nonfinite_lanes(
             stacked,
             lambda t: "pair (%r, %r)" % (

@@ -39,7 +39,7 @@ class DevicePredictPlan:
     """
 
     __slots__ = ("model", "method", "which", "kernel", "static",
-                 "meta_sig", "cls", "params")
+                 "meta_sig", "cls", "params", "serve_dtype")
 
     def block_kernel(self):
         """``(shared, task) -> {'out': scores}`` over a dense row block
@@ -56,7 +56,8 @@ class DevicePredictPlan:
         from ..parallel import structural_key
 
         return structural_key(
-            "predict", self.cls, self.which, self.static, self.meta_sig
+            "predict", self.cls, self.which, self.static, self.meta_sig,
+            self.serve_dtype,
         )
 
     def postprocess(self, out):
@@ -76,17 +77,28 @@ class DevicePredictPlan:
         return len(classes) if classes is not None else 1
 
 
-def device_predict_plan(model, method="predict"):
+def device_predict_plan(model, method="predict", serve_dtype="float32"):
     """Build the device block-kernel plan for a fitted JAX estimator,
     or None when the model exposes no device kernels (host models take
     thread-chunked fallbacks). Parameters are staged host-side ONCE
     here; backend placement (and the broadcast-reuse cache) happens at
-    dispatch."""
+    dispatch.
+
+    ``serve_dtype`` selects the stored-parameter precision tier
+    (``serve.quantize``): bf16/int8 plans stage the QUANTIZED tree —
+    that is what backend placement puts in HBM — and wrap the
+    decision/proba kernel with the in-program dequant, which XLA fuses
+    into the matmul's operand read (f32 accumulation throughout). The
+    tier is part of the structural cache key, so each dtype compiles
+    (and AOT-caches) its own program family and a prewarmed dtype
+    serves with zero steady-state compiles like any other entry.
+    """
     if not hasattr(model, "_params") or not hasattr(model, "_meta"):
         return None
     import jax
 
     from ..models.linear import _freeze, _meta_signature, get_kernel
+    from ..serve.quantize import dequantize_params, quantize_params
 
     which = "proba" if method == "predict_proba" else "decision"
     try:
@@ -98,11 +110,22 @@ def device_predict_plan(model, method="predict"):
     plan.model = model
     plan.method = method
     plan.which = which
-    plan.kernel = kernel
     plan.static = static
     plan.meta_sig = _meta_signature(model._meta)
     plan.cls = type(model)
-    plan.params = jax.tree_util.tree_map(np.asarray, model._params)
+    plan.serve_dtype = serve_dtype
+    params = jax.tree_util.tree_map(np.asarray, model._params)
+    if serve_dtype == "float32":
+        plan.kernel = kernel
+        plan.params = params
+    else:
+        plan.params = quantize_params(params, serve_dtype)
+
+        def quantized_kernel(qparams, X, _base=kernel,
+                             _dtype=serve_dtype):
+            return _base(dequantize_params(qparams, _dtype), X)
+
+        plan.kernel = quantized_kernel
     return plan
 
 

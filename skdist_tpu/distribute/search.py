@@ -1054,8 +1054,8 @@ class DistBaseSearchCV(BaseEstimator):
             return None
 
         from ..models.linear import (
-            _freeze, extract_aux, fit_would_pack, hyper_float,
-            prepare_fit_X,
+            _freeze, annotate_round_kernel_mode, extract_aux,
+            fit_would_pack, hyper_float, prepare_fit_X,
         )
         import jax.numpy as jnp
 
@@ -1264,6 +1264,7 @@ class DistBaseSearchCV(BaseEstimator):
                     return_timings=True, cache_key=kernel_key,
                     on_round=self._round_journal(checkpoint, disp_gids),
                 )
+            annotate_round_kernel_mode(backend, meta)
             # per-task fit_time = its round's measured wall / tasks in
             # that round (fit+score run fused in one kernel, so the
             # whole round wall is recorded as fit_time; score_time is

@@ -287,6 +287,25 @@ def _annotate_stream_meta(meta, dataset):
     return meta
 
 
+def kernel_mode_of(meta):
+    """The contraction variant a fit with this ``meta`` runs —
+    ``"dense"`` or ``"packed_<matvec mode>"``. The batched dispatch
+    sites stamp it into ``backend.last_round_stats["kernel_mode"]`` so
+    round observability (and the chip-leg bench captures) can attribute
+    walls to the kernel that actually ran."""
+    if meta.get("x_format") == "packed":
+        return "packed_" + meta.get("x_matvec", "gather")
+    return "dense"
+
+
+def annotate_round_kernel_mode(backend, meta):
+    """Stamp :func:`kernel_mode_of` onto the backend's most recent
+    round stats (no-op when the backend has none)."""
+    stats = getattr(backend, "last_round_stats", None)
+    if isinstance(stats, dict):
+        stats["kernel_mode"] = kernel_mode_of(meta)
+
+
 def _linear_op(X, fit_intercept, meta, matmul_dtype=None):
     """The one construction point of the fit problems' matvec
     interface (``sparse.LinearOperator``): dense X reproduces the

@@ -135,6 +135,13 @@ def resolve_hist_config(n_features, n_bins, hist_mode="auto",
     if (resolved and hist_mode in ("matmul", "matmul_sib", "pallas")
             and d * B > calib.get("max_matmul_db", DEFAULT_MAX_MATMUL_DB)):
         hist_mode = "scatter"
+    # the compiled pallas histogram needs n_bins >= 8 (TPU sublane
+    # tiling): a RESOLVED pick degrades to the shape heuristic — only
+    # an explicit hist_mode='pallas' request raises (build_tree_kernel)
+    if resolved and hist_mode == "pallas" and B < 8:
+        hist_mode = (
+            "matmul" if jax.default_backend() != "cpu" else "scatter"
+        )
     if hist_block is None:
         hist_block = calib.get("hist_block") or 8
     return hist_mode, int(hist_block)

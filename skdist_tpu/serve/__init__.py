@@ -19,7 +19,10 @@ over the same compiled block-inference programs the offline
 - :class:`ModelRegistry` — validated, versioned model store; stages
   parameters on device once and AOT-prewarms every shape-bucket
   program via ``parallel.compile_cache`` so the first real request
-  never compiles.
+  never compiles. ``register(..., serve_dtype='bfloat16'|'int8')``
+  publishes a quantized precision tier (``serve.quantize``: weight-only
+  storage, f32 accumulation, parity-gated against the f32 reference at
+  registration) as its own AOT-cached program family.
 - :class:`MicroBatcher` / :func:`shape_buckets` — the dynamic batching
   core: flush on size or deadline, pad to power-of-two row buckets
   (floored at the mesh task-slot count, capped by the backend's HBM
@@ -55,11 +58,13 @@ from .batcher import (
     shape_buckets,
 )
 from .engine import ServingEngine
+from .quantize import SERVE_DTYPES
 from .registry import ModelEntry, ModelRegistry
 from .replicaset import AllReplicasUnhealthy, ReplicaSet
 from .stats import ServingStats
 
 __all__ = [
+    "SERVE_DTYPES",
     "ServingEngine",
     "ReplicaSet",
     "AllReplicasUnhealthy",

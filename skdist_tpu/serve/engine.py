@@ -125,13 +125,19 @@ class ServingEngine:
     # registration
     # ------------------------------------------------------------------
     def register(self, name, model, methods=("predict",), version=None,
-                 prewarm=True):
+                 prewarm=True, serve_dtype="float32",
+                 quant_parity_bound=None):
         """Register (and prewarm) a fitted model; returns its entry.
+        ``serve_dtype`` selects the stored-parameter precision tier
+        (see ``ModelRegistry.register`` — int8/bf16 entries are
+        parity-gated against the f32 reference before publishing).
         The warm mark moves AFTER each registration's prewarm, so
         ``compiles_after_warmup`` always measures from the last model
         onboarded."""
         entry = self.registry.register(
-            name, model, methods=methods, version=version, prewarm=prewarm
+            name, model, methods=methods, version=version,
+            prewarm=prewarm, serve_dtype=serve_dtype,
+            quant_parity_bound=quant_parity_bound,
         )
         if prewarm:
             self._stats.mark_warm()
@@ -208,14 +214,16 @@ class ServingEngine:
             else None,
             enq_t=enq_t,
         )
-        self._stats.record_submitted()
+        serve_dtype = getattr(entry, "serve_dtype", "float32")
+        self._stats.record_submitted(serve_dtype=serve_dtype)
         stats = self._stats
 
         def _done(fut):
             # a caller-cancelled future has no result/exception to read
             # (fut.exception() would itself raise CancelledError)
             if not fut.cancelled() and fut.exception() is None:
-                stats.record_completed(time.monotonic() - enq_t)
+                stats.record_completed(time.monotonic() - enq_t,
+                                       serve_dtype=serve_dtype)
 
         req.future.add_done_callback(_done)
         batcher.submit(req)

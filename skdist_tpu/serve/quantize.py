@@ -1,0 +1,122 @@
+"""
+Low-precision parameter tiers for the serving registry.
+
+Serving traffic at micro-batch sizes is WEIGHT-bound: every flush
+re-reads the model's parameters from HBM while the activations are a
+few rows. Shrinking the resident parameters is therefore the serving
+win, and it follows the mixed-precision recipe (Micikevicius et al.):
+low-precision STORAGE, full-precision ACCUMULATION —
+
+- ``float32``  — the reference tier: byte-identical to ``fit``'s
+  params, the default, and the parity baseline the others are gated
+  against at registration.
+- ``bfloat16`` — the weight matrix is stored bf16 (half the HBM);
+  the decision/proba kernel upcasts it in-register, so every matmul
+  still accumulates f32. Numerics class: one bf16 round of each
+  weight (~3 decimal digits) — screening traffic.
+- ``int8``     — per-channel symmetric weight quantization at PUBLISH
+  time: for each output channel ``c``, ``scale[c] =
+  max|W[:, c]| / 127`` and ``q = clip(round(W / scale), ±127)``
+  stored int8 (a quarter of the HBM) next to the f32 ``scale``
+  vector. The dequant (``q * scale``) is one fused elementwise op in
+  the compiled decision/proba program — the stored tier never leaves
+  int8 in HBM, and accumulation is f32.
+
+Quantization applies to the **linear-family params contract** (a
+``"W"`` leaf of shape ``(p,)`` or ``(p, k)`` — what every servable
+linear model exposes); params trees without it (tree ensembles, whose
+"weights" are structural) refuse loudly at registration rather than
+silently changing split semantics. The intercept row rides the same
+per-channel scale as its column — measured error stays inside the
+registration parity gate, which is the authority either way.
+"""
+
+import numpy as np
+
+__all__ = [
+    "SERVE_DTYPES",
+    "quantize_params",
+    "dequantize_params",
+    "quantized_nbytes",
+]
+
+#: the registry's routable precision tiers
+SERVE_DTYPES = ("float32", "bfloat16", "int8")
+
+#: key the int8 tier stores its per-channel scales under
+_SCALE_KEY = "w_scale"
+
+
+def _check_dtype(serve_dtype):
+    if serve_dtype not in SERVE_DTYPES:
+        raise ValueError(
+            f"serve_dtype must be one of {SERVE_DTYPES}; got "
+            f"{serve_dtype!r}"
+        )
+
+
+def quantize_params(params, serve_dtype):
+    """Host-side publish-time quantization of a staged params tree.
+
+    Returns a new tree whose ``"W"`` leaf is stored at the tier's
+    dtype (plus ``"w_scale"`` for int8); every other leaf passes
+    through untouched. Raises ``ValueError`` for trees without the
+    linear ``"W"`` contract — the registry turns that into its
+    "cannot serve this model quantized" message.
+    """
+    _check_dtype(serve_dtype)
+    if serve_dtype == "float32":
+        return params
+    if not isinstance(params, dict) or "W" not in params:
+        raise ValueError(
+            f"serve_dtype={serve_dtype!r} quantizes the linear-family "
+            "params contract (a 'W' coefficient leaf); this model's "
+            f"params have {sorted(params) if isinstance(params, dict) else type(params).__name__} "
+            "— only float32 serving is available for it"
+        )
+    W = np.asarray(params["W"], dtype=np.float32)
+    out = dict(params)
+    if serve_dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        out["W"] = np.asarray(jnp.asarray(W).astype(jnp.bfloat16))
+        return out
+    # int8: per-channel symmetric over the output axis (columns of a
+    # (p, k) W; the single channel of a (p,) W)
+    amax = np.max(np.abs(W), axis=0)  # (k,) or scalar
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(W / scale), -127, 127).astype(np.int8)
+    out["W"] = q
+    out[_SCALE_KEY] = scale
+    return out
+
+
+def dequantize_params(params, serve_dtype):
+    """In-program reconstruction of the f32 params tree — called
+    inside the decision/proba kernel trace, so XLA fuses the upcast /
+    ``q * scale`` into the matmul's operand read while HBM keeps the
+    stored tier."""
+    _check_dtype(serve_dtype)
+    if serve_dtype == "float32":
+        return params
+    import jax.numpy as jnp
+
+    out = dict(params)
+    if serve_dtype == "bfloat16":
+        out["W"] = jnp.asarray(params["W"]).astype(jnp.float32)
+        return out
+    scale = out.pop(_SCALE_KEY)
+    out["W"] = jnp.asarray(params["W"]).astype(jnp.float32) * scale
+    return out
+
+
+def quantized_nbytes(params):
+    """Total leaf bytes of a (possibly quantized) params tree — the
+    registry's evidence that a tier actually shrank the resident
+    weights."""
+    import jax
+
+    return int(sum(
+        np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(params)
+    ))

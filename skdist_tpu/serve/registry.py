@@ -39,11 +39,23 @@ from ..distribute.predict import device_predict_plan
 from ..parallel import resolve_backend
 from ..utils.validation import check_is_fitted
 from .batcher import shape_buckets
+from .quantize import SERVE_DTYPES, quantized_nbytes
 
 __all__ = ["ModelRegistry", "ModelEntry"]
 
 #: default largest bucket when the backend reports no memory stats
 _DEFAULT_MAX_BATCH_ROWS = 256
+
+#: registration-time parity bound for quantized tiers: max |quantized -
+#: f32| of the probe outputs, normalised by max(1, max|f32|). bf16
+#: measures ~1e-3 and int8 ~1e-2 on the serving smoke models; the gate
+#: sits above both with margin while still catching a broken scale or
+#: a model whose weight distribution quantizes badly. Overridable per
+#: register() call — the operator owns the quality/SLO trade.
+DEFAULT_QUANT_PARITY_BOUND = 5e-2
+
+#: rows in the registration parity probe (deterministic, seeded)
+_PARITY_PROBE_ROWS = 64
 
 
 class _MethodPath:
@@ -91,16 +103,27 @@ class ModelEntry:
     """One immutable registered (name, version, model)."""
 
     __slots__ = ("name", "version", "model", "methods", "buckets",
-                 "n_features")
+                 "n_features", "serve_dtype", "quant_error",
+                 "params_nbytes")
 
     def __init__(self, name, version, model, methods, buckets,
-                 n_features):
+                 n_features, serve_dtype="float32", quant_error=None,
+                 params_nbytes=None):
         self.name = name
         self.version = version
         self.model = model
         self.methods = methods        # {method: _MethodPath}
         self.buckets = buckets        # row buckets (device entries)
         self.n_features = n_features  # None: unknown width (host/text)
+        self.serve_dtype = serve_dtype
+        #: measured registration parity vs the f32 reference — the max
+        #: across the entry's methods (None for float32 entries — they
+        #: ARE the reference)
+        self.quant_error = quant_error
+        #: total staged parameter bytes SUMMED over the entry's
+        #: methods (each method stages its own tree) — the tier's
+        #: resident HBM bill
+        self.params_nbytes = params_nbytes
 
     @property
     def spec(self):
@@ -131,9 +154,31 @@ class ModelRegistry:
 
     # ------------------------------------------------------------------
     def register(self, name, model, methods=("predict",), version=None,
-                 prewarm=None):
-        """Validate, stage, prewarm, and store; returns the entry."""
+                 prewarm=None, serve_dtype="float32",
+                 quant_parity_bound=None):
+        """Validate, stage, prewarm, and store; returns the entry.
+
+        ``serve_dtype`` selects the stored-parameter precision tier
+        (``'float32'`` | ``'bfloat16'`` | ``'int8'`` — see
+        ``serve.quantize``). Non-f32 tiers require the device path (a
+        host-fallback model has no staged parameters to quantize) and
+        are parity-gated at registration: a deterministic probe runs
+        every requested method through both the quantized and the f32
+        kernels, and a normalised max deviation above
+        ``quant_parity_bound`` (default
+        :data:`DEFAULT_QUANT_PARITY_BOUND`) fails the registration —
+        a tier that cannot reproduce its own reference must never
+        enter the routing table. The dtype is part of every compile
+        key, so each registered tier is its own AOT-cached program
+        family (publish the same model under several names/versions to
+        route screening traffic at int8 next to exact f32).
+        """
         check_is_fitted(model)
+        if serve_dtype not in SERVE_DTYPES:
+            raise ValueError(
+                f"serve_dtype must be one of {SERVE_DTYPES}; got "
+                f"{serve_dtype!r}"
+            )
         methods = (methods,) if isinstance(methods, str) else tuple(methods)
         for m in methods:
             if m not in ("predict", "predict_proba", "decision_function"):
@@ -143,11 +188,40 @@ class ModelRegistry:
                     f"model {type(model).__name__} has no {m!r} method"
                 )
         paths = {}
+        quant_error = None
+        params_nbytes = None
         for m in methods:
-            plan = device_predict_plan(model, m)
+            plan = device_predict_plan(model, m, serve_dtype=serve_dtype)
             if plan is None:
+                if serve_dtype != "float32":
+                    raise ValueError(
+                        f"serve_dtype={serve_dtype!r} needs the device "
+                        "path (staged parameters to quantize); "
+                        f"{type(model).__name__} serves through the "
+                        "host fallback, which is float32-only"
+                    )
                 paths[m] = _MethodPath(model, m)
             else:
+                if serve_dtype != "float32":
+                    err = self._quant_parity_probe(model, m, plan)
+                    bound = (DEFAULT_QUANT_PARITY_BOUND
+                             if quant_parity_bound is None
+                             else float(quant_parity_bound))
+                    if err > bound:
+                        raise ValueError(
+                            f"{serve_dtype} parity probe for "
+                            f"{type(model).__name__}.{m} deviates "
+                            f"{err:.4g} from the f32 reference "
+                            f"(bound {bound:g}); this model's weights "
+                            "do not quantize to this tier — serve it "
+                            "float32 or raise quant_parity_bound if "
+                            "screening traffic tolerates it"
+                        )
+                    quant_error = max(quant_error or 0.0, err)
+                    params_nbytes = (
+                        (params_nbytes or 0)
+                        + quantized_nbytes(plan.params)
+                    )
                 batched = self.backend.prepare_batched(
                     plan.block_kernel(), {"params": plan.params},
                     cache_key=plan.cache_key(),
@@ -177,9 +251,37 @@ class ModelRegistry:
                         "versions are immutable — register a new one"
                     )
             entry = ModelEntry(name, version, model, paths, buckets,
-                               n_features)
+                               n_features, serve_dtype=serve_dtype,
+                               quant_error=quant_error,
+                               params_nbytes=params_nbytes)
             versions[version] = entry
         return entry
+
+    @staticmethod
+    def _quant_parity_probe(model, method, qplan):
+        """Normalised max deviation of the quantized kernel vs the f32
+        reference kernel on a deterministic probe — the registration
+        parity gate's measurement. Runs on the default device (one-time
+        registration cost, no backend dispatch)."""
+        import jax
+        import jax.numpy as jnp
+
+        ref_plan = device_predict_plan(model, method)
+        n_feat = int(ref_plan.n_features)
+        probe = np.random.RandomState(0).standard_normal(
+            (_PARITY_PROBE_ROWS, n_feat)).astype(np.float32)
+
+        def run(plan):
+            out = plan.kernel(
+                jax.tree_util.tree_map(jnp.asarray, plan.params),
+                jnp.asarray(probe),
+            )
+            return np.asarray(out, dtype=np.float32)
+
+        ref = run(ref_plan)
+        q = run(qplan)
+        denom = max(1.0, float(np.max(np.abs(ref))))
+        return float(np.max(np.abs(q - ref))) / denom
 
     def _resolve_width(self, model, paths):
         for p in paths.values():

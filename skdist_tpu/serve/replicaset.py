@@ -141,18 +141,24 @@ class ReplicaSet:
     # ------------------------------------------------------------------
     # rollout
     # ------------------------------------------------------------------
-    def rollout(self, name, model, methods=("predict",), version=None):
+    def rollout(self, name, model, methods=("predict",), version=None,
+                serve_dtype="float32"):
         """Fleet-wide prewarm-before-publish: register (and prewarm)
         the model on EVERY replica, then publish it to routing. Raises
         — and does not publish — if any replica's registration fails,
         so the routing table never names a version some replica cannot
-        serve. Returns the per-replica entries."""
+        serve. ``serve_dtype`` carries fleet-wide: every replica's
+        entry (and every respawned generation's re-registration)
+        serves the SAME precision tier — a version-pinned route must
+        never resolve to int8 on one replica and f32 on another.
+        Returns the per-replica entries."""
         if self._closed:
             raise ServingError("replica set is closed")
         entries = []
         for r in self._live():
             entries.append(r.engine.register(
                 name, model, methods=methods, version=version,
+                serve_dtype=serve_dtype,
             ))
         if not entries:
             raise AllReplicasUnhealthy(
@@ -165,9 +171,11 @@ class ReplicaSet:
         assigned = entries[0].version
         with self._lock:
             self._published.setdefault(name, []).append(
-                {"model": model, "methods": methods, "version": assigned}
+                {"model": model, "methods": methods, "version": assigned,
+                 "serve_dtype": serve_dtype}
             )
-        self._event("rollout", None, name=name, version=assigned)
+        self._event("rollout", None, name=name, version=assigned,
+                    serve_dtype=serve_dtype)
         return entries
 
     # an alias matching the single-engine verb
@@ -319,6 +327,7 @@ class ReplicaSet:
                     engine.register(
                         name, rec["model"], methods=rec["methods"],
                         version=rec["version"],
+                        serve_dtype=rec.get("serve_dtype", "float32"),
                     )
             r.engine = engine
             r.failures = 0

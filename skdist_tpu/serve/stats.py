@@ -42,6 +42,11 @@ class ServingStats:
     def __init__(self, window=4096):
         self._lock = threading.Lock()
         self._lat = deque(maxlen=window)
+        self._window = window
+        #: per-serve_dtype split: requests / completions / latency ring
+        #: per precision tier, so a mixed f32+int8 deployment can
+        #: attribute its latency (and its wins) to the right kernels
+        self._by_dtype = {}
         self._bucket_hits = {}
         self._rows_served = 0
         self._capacity_served = 0
@@ -58,14 +63,29 @@ class ServingStats:
     # ------------------------------------------------------------------
     # recording (batcher/engine side)
     # ------------------------------------------------------------------
-    def record_submitted(self):
+    def _dtype_cell(self, serve_dtype):
+        cell = self._by_dtype.get(serve_dtype)
+        if cell is None:
+            cell = self._by_dtype[serve_dtype] = {
+                "requests": 0, "completed": 0,
+                "lat": deque(maxlen=max(256, self._window // 4)),
+            }
+        return cell
+
+    def record_submitted(self, serve_dtype=None):
         with self._lock:
             self._requests += 1
+            if serve_dtype is not None:
+                self._dtype_cell(serve_dtype)["requests"] += 1
 
-    def record_completed(self, latency_s):
+    def record_completed(self, latency_s, serve_dtype=None):
         with self._lock:
             self._completed += 1
             self._lat.append(float(latency_s))
+            if serve_dtype is not None:
+                cell = self._dtype_cell(serve_dtype)
+                cell["completed"] += 1
+                cell["lat"].append(float(latency_s))
 
     def record_rejection(self, kind):
         with self._lock:
@@ -152,9 +172,27 @@ class ServingStats:
                 ),
                 "bucket_hits": dict(sorted(self._bucket_hits.items())),
             }
+            by_dtype = {
+                dt: {
+                    "requests": cell["requests"],
+                    "completed": cell["completed"],
+                    "lat": sorted(cell["lat"]),
+                }
+                for dt, cell in self._by_dtype.items()
+            }
         for name, q in (("p50_ms", 0.50), ("p95_ms", 0.95),
                         ("p99_ms", 0.99)):
             v = self._percentile(lat, q)
             out[name] = round(v * 1e3, 3) if v is not None else None
+        if by_dtype:
+            split = {}
+            for dt, cell in sorted(by_dtype.items()):
+                ent = {"requests": cell["requests"],
+                       "completed": cell["completed"]}
+                for name, q in (("p50_ms", 0.50), ("p99_ms", 0.99)):
+                    v = self._percentile(cell["lat"], q)
+                    ent[name] = round(v * 1e3, 3) if v is not None else None
+                split[dt] = ent
+            out["by_serve_dtype"] = split
         out["compiles_after_warmup"] = self.compiles_after_warmup()
         return out

@@ -38,13 +38,20 @@ batch prediction alike:
   pay max-row padding. ``SKDIST_SPARSE_FIT=0`` disables packing
   entirely; ``=1``/``force`` packs any 2-D sparse input.
 - matvec-mode selection (:func:`resolve_matvec_mode`): ``gather`` vs
-  ``dense`` (dense-matmul-on-packed) is a measured, persisted decision
-  per platform — the same calibration idiom as the tree kernels'
-  ``hist_mode`` (``models/hist_calib.py``): environment override, then
-  a committed ``sparse_calib.json`` table written by on-platform
-  sweeps (:func:`record_matvec_calibration`), then the heuristic
-  default (``gather`` — nnz-proportional everywhere; ``dense`` only
-  wins where an MXU makes the rebuilt matmul ~free).
+  ``dense`` (dense-matmul-on-packed) vs ``pallas`` (the on-chip
+  kernels of ``ops/pallas_sparse.py``: both contractions recast as
+  one-hot matmuls whose dense sub-block is rebuilt in VMEM — no
+  (n, d) tensor in HBM, no serialised gather/scatter) is a measured,
+  persisted decision per platform — the same calibration idiom as the
+  tree kernels' ``hist_mode`` (``models/hist_calib.py``): environment
+  override, then a committed ``sparse_calib.json`` table written by
+  on-platform sweeps (an extended ``build_tools/tpu_tree_sweep.py``
+  records both tables), then the heuristic default (``gather`` —
+  nnz-proportional everywhere; ``dense``/``pallas`` only win where an
+  MXU exists, which is the sweep's call to make). Off-TPU a selected
+  ``pallas`` runs through the Pallas interpreter — correct (the CPU
+  mesh tests it bitwise) but slow, so no CPU calibration ever picks
+  it.
 
 The 1-tuple-shape special case of scipy's 1-D sparse arrays
 (``csr_array`` of a vector) is handled ONCE here, in
@@ -101,7 +108,12 @@ PACK_SAVINGS_ENV = "SKDIST_SPARSE_PACK_SAVINGS"
 #: padding would bill every row for a handful of heavy ones
 OUTLIER_FACTOR = 4.0
 
-_VALID_MATVEC_MODES = ("gather", "dense")
+_VALID_MATVEC_MODES = ("gather", "dense", "pallas")
+
+#: explicit row-chunk override for the weighted-gram contraction; the
+#: automatic chunking derives from the meminfo budget (see
+#: :func:`packed_weighted_gram`)
+GRAM_CHUNK_ENV = "SKDIST_GRAM_CHUNK_ROWS"
 
 
 # ---------------------------------------------------------------------------
@@ -373,16 +385,96 @@ def packed_to_dense(idx, val, n_cols):
     return jnp.zeros((n, n_cols), val.dtype).at[rows, idx].add(val)
 
 
-def packed_weighted_gram(idx, val, sw, n_cols):
+#: task-batch factor billed by the automatic gram chunking: the gram
+#: usually runs inside a vmapped round (batched CV ridge fits), where
+#: EVERY lane of the traced program materialises its own (chunk, m, m)
+#: tensor simultaneously — and at trace time the kernel cannot see how
+#: many lanes the round stacked. Billing a conservative per-trace lane
+#: count keeps the guard effective in the batched case; over-chunking
+#: only lengthens the fori_loop, under-chunking OOMs.
+GRAM_BATCH_ASSUMPTION = 16
+
+
+def _gram_row_chunk(n, m):
+    """Rows per chunk for :func:`packed_weighted_gram`, or None for the
+    single-shot scatter. Env override first (absolute — the operator
+    knows the real round shape); otherwise the (n, m, m) contribution
+    tensor × :data:`GRAM_BATCH_ASSUMPTION` vmap lanes is billed against
+    the meminfo budget (the same plumbing the densify guardrail uses)
+    at 1/8 — the tensor, its XLA temps, and the scatter's operands
+    coexist — and chunking engages only when that bill overshoots the
+    share. The budget is host-RAM-derived (the plumbing the ISSUE
+    reuses); device-HBM-aware sizing stays the backend round sizer's
+    job."""
+    env = os.environ.get(GRAM_CHUNK_ENV, "").strip()
+    if env:
+        try:
+            v = int(float(env))
+            if v > 0:
+                return min(v, n)
+        except ValueError:
+            pass
+    from .utils.meminfo import densify_budget_bytes
+
+    budget, _ = densify_budget_bytes()
+    if budget is None:
+        return None
+    lane_bytes = int(m) * int(m) * 4 * GRAM_BATCH_ASSUMPTION
+    share = budget // 8
+    if int(n) * lane_bytes <= share:
+        return None
+    return max(1, int(share // max(lane_bytes, 1)))
+
+
+def packed_weighted_gram(idx, val, sw, n_cols, row_chunk=None):
     """``Xᵀ S X`` via the m² scatter: contribution
     ``sw[n]·val[n,a]·val[n,b]`` lands at ``(idx[n,a], idx[n,b])`` —
     O(nnz·m) scatter ops instead of the dense gram's O(n·d²) FLOPs.
-    The (n, m, m) contribution tensor is materialised, so this suits
-    the moderate-m regimes the ridge family actually runs at."""
-    vw = val * sw[:, None]
-    contrib = vw[:, :, None] * val[:, None, :]
-    out = jnp.zeros((n_cols, n_cols), val.dtype)
-    return out.at[idx[:, :, None], idx[:, None, :]].add(contrib)
+
+    The (n, m, m) contribution tensor is materialised, which suits the
+    moderate-m regimes the ridge family usually runs at — but above a
+    budget threshold (:func:`_gram_row_chunk`, reusing the meminfo
+    budget plumbing; ``SKDIST_GRAM_CHUNK_ROWS`` overrides) the
+    contraction switches to a row-chunked accumulation: a fori_loop
+    over fixed-size row chunks, each materialising only
+    (chunk, m, m). Chunk padding uses zero weights/values, so the
+    chunked result equals the single-shot scatter (exactly on integer
+    data; to f32 addition-order noise otherwise). The chunk decision
+    is made at TRACE time from static shapes, so it is vmap-safe (a
+    batched ``sw`` rides through the dynamic slices untouched)."""
+    n, m = idx.shape
+    if row_chunk is None:
+        row_chunk = _gram_row_chunk(n, m)
+    if row_chunk is None or int(row_chunk) >= n:
+        vw = val * sw[:, None]
+        contrib = vw[:, :, None] * val[:, None, :]
+        out = jnp.zeros((n_cols, n_cols), val.dtype)
+        return out.at[idx[:, :, None], idx[:, None, :]].add(contrib)
+    chunk = max(1, int(row_chunk))
+    n_pad = -(-n // chunk) * chunk
+    if n_pad != n:
+        # zero-weight padded rows contribute 0.0 at (0, 0) — exact
+        idx = jnp.concatenate(
+            [idx, jnp.zeros((n_pad - n, m), idx.dtype)], axis=0
+        )
+        val = jnp.concatenate(
+            [val, jnp.zeros((n_pad - n, m), val.dtype)], axis=0
+        )
+        sw = jnp.concatenate(
+            [sw, jnp.zeros((n_pad - n,), sw.dtype)], axis=0
+        )
+
+    def body(c, acc):
+        i0 = c * chunk
+        ii = jax.lax.dynamic_slice_in_dim(idx, i0, chunk, axis=0)
+        vv = jax.lax.dynamic_slice_in_dim(val, i0, chunk, axis=0)
+        ss = jax.lax.dynamic_slice_in_dim(sw, i0, chunk, axis=0)
+        vw = vv * ss[:, None]
+        contrib = vw[:, :, None] * vv[:, None, :]
+        return acc.at[ii[:, :, None], ii[:, None, :]].add(contrib)
+
+    out0 = jnp.zeros((n_cols, n_cols), val.dtype)
+    return jax.lax.fori_loop(0, n_pad // chunk, body, out0)
 
 
 def matvec_any(X, W):
@@ -411,20 +503,33 @@ class LinearOperator:
     intercept as one extra packed column (``idx=d, val=1``) and route
     through the gather/scatter kernels above — or, in ``mode='dense'``,
     through one :func:`packed_to_dense` rebuild followed by the exact
-    dense expressions (the MXU variant).
+    dense expressions (the MXU variant) — or, in ``mode='pallas'``,
+    through the on-chip Pallas kernels (``ops/pallas_sparse``): the
+    forward matvec carries a custom VJP whose backward IS the Pallas
+    rmatvec, so the solvers autodiff through it exactly as through the
+    gather form.
 
     ``matmul_dtype='bfloat16'`` applies the LogReg bf16 contract: bf16
     operands, f32 accumulation, solver state f32. On the gather path
     the products round to bf16 before the f32 row-sum — same
-    opt-in-screening precision class as the dense bf16 pass.
+    opt-in-screening precision class as the dense bf16 pass. The bf16
+    contract is DEFINED on the gather expressions: ``mode='pallas'``
+    under bf16 keeps the forward/backward on the gather path rather
+    than inventing a third precision class.
     """
 
     __slots__ = ("d", "p", "n", "Xa", "pidx", "pval", "bf16", "_Xmm",
-                 "dtype")
+                 "dtype", "pallas", "_pmv")
 
     def __init__(self, X, fit_intercept, matmul_dtype=None, mode="gather"):
+        if mode not in _VALID_MATVEC_MODES:
+            raise ValueError(
+                f"mode must be one of {_VALID_MATVEC_MODES}; got {mode!r}"
+            )
         self.bf16 = matmul_dtype == "bfloat16"
         self._Xmm = None
+        self.pallas = False
+        self._pmv = None
         self.dtype = X.val.dtype if isinstance(X, PackedX) else X.dtype
         if isinstance(X, PackedX):
             d = X.n_cols
@@ -446,6 +551,11 @@ class LinearOperator:
             else:
                 self.Xa = None
                 self.pidx, self.pval = idx, val
+                if mode == "pallas" and not self.bf16:
+                    from .ops.pallas_sparse import matvec_with_vjp
+
+                    self.pallas = True
+                    self._pmv = matvec_with_vjp(idx, val, self.p)
         else:
             if fit_intercept:
                 ones = jnp.ones((X.shape[0], 1), X.dtype)
@@ -481,34 +591,52 @@ class LinearOperator:
             return jnp.sum(
                 (v[:, :, None] * g).astype(jnp.float32), axis=1
             )
+        if self.pallas:
+            return self._pmv(W)
         return packed_matvec(self.pidx, self.pval, W)
 
     # -- X̃ᵀ @ r --------------------------------------------------------
     def rmatvec(self, r):
         if self.Xa is not None:
             return self.Xa.T @ r
+        if self.pallas:
+            from .ops.pallas_sparse import packed_rmatvec as pl_rmatvec
+
+            return pl_rmatvec(self.pidx, self.pval, r, self.p)
         return packed_rmatvec(self.pidx, self.pval, r, self.p)
 
     # -- row-batch forms (the SGD mini-batch contractions) --------------
     def row_matvec(self, i, W):
         if self.Xa is not None:
             return self.Xa[i] @ W
+        if self.pallas:
+            # the SGD family computes its gradients explicitly (no
+            # autodiff through the row forms), so the raw kernels serve
+            from .ops.pallas_sparse import packed_matvec as pl_matvec
+
+            return pl_matvec(self.pidx[i], self.pval[i], W)
         return packed_matvec(self.pidx[i], self.pval[i], W)
 
     def row_rmatvec(self, i, g):
         if self.Xa is not None:
             return self.Xa[i].T @ g
+        if self.pallas:
+            from .ops.pallas_sparse import packed_rmatvec as pl_rmatvec
+
+            return pl_rmatvec(self.pidx[i], self.pval[i], g, self.p)
         return packed_rmatvec(self.pidx[i], self.pval[i], g, self.p)
 
     # -- closed-form ridge pieces ---------------------------------------
     def weighted_gram_rhs(self, sw, T):
         """``(X̃ᵀSX̃, (SX̃)ᵀT)`` — the two solves of the ridge normal
-        equations. Dense keeps the historical op order exactly."""
+        equations. Dense keeps the historical op order exactly; the
+        packed gram stays on the m² scatter in every mode (it has no
+        Pallas form yet), while the rhs rides the mode's rmatvec."""
         if self.Xa is not None:
             Xw = self.Xa * sw[:, None]
             return self.Xa.T @ Xw, Xw.T @ T
         G = packed_weighted_gram(self.pidx, self.pval, sw, self.p)
-        b = packed_rmatvec(self.pidx, self.pval, sw[:, None] * T, self.p)
+        b = self.rmatvec(sw[:, None] * T)
         return G, b
 
 
@@ -585,8 +713,10 @@ def record_matvec_calibration(platform, mode, measured=None, source=None):
 def resolve_matvec_mode(platform=None):
     """The packed matvec mode for this process: environment override →
     calibration table → heuristic default (``gather`` — the
-    nnz-proportional kernels; ``dense`` is the rebuilt-MXU variant a
-    sweep may certify per platform)."""
+    nnz-proportional kernels; ``dense`` is the rebuilt-MXU variant and
+    ``pallas`` the on-chip VMEM-rebuild kernels, either of which a
+    sweep may certify per platform — CPU sweeps never pick ``pallas``,
+    whose off-TPU form is the interpreter)."""
     env = os.environ.get(SPARSE_MATVEC_ENV, "").strip().lower()
     if env in _VALID_MATVEC_MODES:
         return env

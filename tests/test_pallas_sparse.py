@@ -1,0 +1,477 @@
+"""Pallas packed-CSR kernels (ops/pallas_sparse) and the on-chip
+kernel-push routing (ISSUE 10 tentpole): interpret-mode parity fuzz of
+packed_matvec/packed_rmatvec vs the XLA kernels over (n, d, m, k)
+including padded rows and the intercept column, the custom-VJP
+transpose contract, LinearOperator mode='pallas' end to end through
+the solver families and the batched search, calibration/env routing,
+the chunked weighted-gram satellite, the hist auto/pallas degrade
+satellite, the bf16 packed-gather contract, and kernel_mode round
+observability."""
+
+import json
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from skdist_tpu import sparse as sx
+from skdist_tpu.ops import pallas_sparse as ps
+
+
+def _packed_case(seed, n, d, m, k, pad_frac=0.3):
+    """A packed pair with genuinely padded rows (idx 0 / val 0)."""
+    rng = np.random.RandomState(seed)
+    idx = rng.randint(0, d, size=(n, m)).astype(np.int32)
+    val = rng.randn(n, m).astype(np.float32)
+    mask = rng.rand(n, m) < pad_frac
+    idx[mask] = 0
+    val[mask] = 0.0
+    W = rng.randn(d, k).astype(np.float32)
+    r = rng.randn(n, k).astype(np.float32)
+    return idx, val, W, r
+
+
+# ---------------------------------------------------------------------------
+# kernel parity: pallas vs the XLA gather/scatter kernels
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,d,m,k", [
+    (37, 53, 5, 3),     # nothing aligned to any tile
+    (8, 300, 1, 1),     # single packed slot, single output
+    (200, 1000, 17, 20),  # the multinomial shape class
+    (5, 4, 4, 2),       # d smaller than every block default
+    (256, 512, 8, 4),   # exactly block-aligned
+])
+def test_pallas_kernels_match_xla(n, d, m, k):
+    idx, val, W, r = _packed_case(n * 7 + k, n, d, m, k)
+    mv_ref = np.asarray(sx.packed_matvec(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(W)))
+    mv_pl = np.asarray(ps.packed_matvec(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(W), S=8, DB=128))
+    np.testing.assert_allclose(mv_pl, mv_ref, atol=1e-5)
+    rv_ref = np.asarray(sx.packed_rmatvec(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(r), d))
+    rv_pl = np.asarray(ps.packed_rmatvec(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(r), d,
+        S=8, DB=128))
+    np.testing.assert_allclose(rv_pl, rv_ref, atol=1e-5)
+    # 1-D operand forms
+    np.testing.assert_allclose(
+        np.asarray(ps.packed_matvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(W[:, 0]),
+            S=8, DB=128)),
+        np.asarray(sx.packed_matvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(W[:, 0]))),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ps.packed_rmatvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(r[:, 0]), d,
+            S=8, DB=128)),
+        np.asarray(sx.packed_rmatvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(r[:, 0]), d)),
+        atol=1e-5,
+    )
+
+
+def test_pallas_kernels_bitwise_on_integers():
+    """Integer-valued data: f32 accumulation below 2^24 is exact in any
+    order, so the Pallas contraction must be BITWISE equal to the XLA
+    kernels — the same exactness class test_sparse_fit pins for the
+    gather/scatter pair."""
+    rng = np.random.RandomState(5)
+    n, d, m, k = 64, 96, 6, 3
+    idx = rng.randint(0, d, size=(n, m)).astype(np.int32)
+    val = rng.randint(-4, 5, size=(n, m)).astype(np.float32)
+    W = rng.randint(-4, 5, size=(d, k)).astype(np.float32)
+    r = rng.randint(-4, 5, size=(n, k)).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ps.packed_matvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(W),
+            S=8, DB=128)),
+        np.asarray(sx.packed_matvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(W))))
+    np.testing.assert_array_equal(
+        np.asarray(ps.packed_rmatvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(r), d,
+            S=8, DB=128)),
+        np.asarray(sx.packed_rmatvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(r), d)))
+
+
+def test_pallas_intercept_column_and_duplicates():
+    """The LinearOperator's intercept column (idx=d, val=1) and
+    duplicate (row, col) entries must accumulate exactly like the XLA
+    kernels (CSR semantics: duplicates add)."""
+    rng = np.random.RandomState(9)
+    n, d, m = 40, 30, 4
+    idx = rng.randint(0, d, size=(n, m)).astype(np.int32)
+    idx[:, 1] = idx[:, 0]  # force duplicates
+    val = rng.randn(n, m).astype(np.float32)
+    # intercept column appended exactly as LinearOperator does
+    idx = np.concatenate([idx, np.full((n, 1), d, np.int32)], axis=1)
+    val = np.concatenate([val, np.ones((n, 1), np.float32)], axis=1)
+    W = rng.randn(d + 1, 2).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ps.packed_matvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(W),
+            S=8, DB=128)),
+        np.asarray(sx.packed_matvec(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(W))),
+        atol=1e-5,
+    )
+
+
+def test_matvec_with_vjp_transpose_is_rmatvec():
+    """grad through the custom-VJP matvec must equal X.T @ cotangent —
+    the solvers' whole autodiff contract on the pallas path."""
+    idx, val, W, _ = _packed_case(3, 50, 64, 5, 3)
+    Xd = np.asarray(sx.packed_to_dense(
+        jnp.asarray(idx), jnp.asarray(val), 64))
+    mv = ps.matvec_with_vjp(jnp.asarray(idx), jnp.asarray(val), 64)
+
+    def loss(W):
+        return jnp.sum(mv(W) ** 2)
+
+    g = np.asarray(jax.grad(loss)(jnp.asarray(W)))
+    gref = Xd.T @ (2.0 * (Xd @ W))
+    np.testing.assert_allclose(g, gref, atol=1e-4)
+    # vmapped over the task axis (batched W, shared packed pair)
+    Wb = np.random.RandomState(1).randn(4, 64, 3).astype(np.float32)
+    gb = np.asarray(jax.vmap(jax.grad(loss))(jnp.asarray(Wb)))
+    for t in range(4):
+        np.testing.assert_allclose(
+            gb[t], Xd.T @ (2.0 * (Xd @ Wb[t])), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# routing: env override, calibration table, mode validation
+# ---------------------------------------------------------------------------
+
+def test_resolve_matvec_mode_pallas_env_and_calib(monkeypatch, tmp_path):
+    monkeypatch.setenv(sx.SPARSE_MATVEC_ENV, "pallas")
+    assert sx.resolve_matvec_mode() == "pallas"
+    monkeypatch.delenv(sx.SPARSE_MATVEC_ENV)
+    # calibration table entry routes 'auto' (staged in a scratch file)
+    path = tmp_path / "sparse_calib.json"
+    path.write_text(json.dumps({"cpu": {"mode": "pallas"}}))
+    monkeypatch.setenv(sx.CALIB_PATH_ENV, str(path))
+    assert sx.resolve_matvec_mode("cpu") == "pallas"
+    # unknown modes in the table are ignored (forward compat); a fresh
+    # path sidesteps the table's mtime-granularity reload cache
+    path2 = tmp_path / "sparse_calib2.json"
+    path2.write_text(json.dumps({"cpu": {"mode": "warp9"}}))
+    monkeypatch.setenv(sx.CALIB_PATH_ENV, str(path2))
+    assert sx.resolve_matvec_mode("cpu") == "gather"
+
+
+def test_committed_cpu_calibration_keeps_gather_default():
+    """The committed sparse_calib.json must keep today's gather default
+    on CPU — the 'XLA path byte-identical when pallas is not selected'
+    acceptance line depends on it."""
+    assert sx.resolve_matvec_mode("cpu") == "gather"
+    ent = sx.get_matvec_calibration("cpu")
+    assert ent is not None and ent["mode"] == "gather"
+
+
+def test_linear_operator_rejects_unknown_mode():
+    idx, val, _, _ = _packed_case(0, 10, 16, 2, 1)
+    packed = sx.PackedX(jnp.asarray(idx), jnp.asarray(val), 16)
+    with pytest.raises(ValueError, match="mode must be one of"):
+        sx.LinearOperator(packed, fit_intercept=True, mode="warp9")
+
+
+# ---------------------------------------------------------------------------
+# the one matvec interface: solver families + batched search on pallas
+# ---------------------------------------------------------------------------
+
+def _sparse_problem(seed=0, n=150, d=512, density=0.015, k=3):
+    rng = np.random.RandomState(seed)
+    X = sp.random(n, d, density=density, format="csr",
+                  dtype=np.float32, random_state=rng)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    logits = np.asarray(X @ W)
+    logits = (logits - logits.mean(0)) / (logits.std(0) + 1e-9)
+    y = np.argmax(logits + 0.5 * rng.normal(size=(n, k)), axis=1)
+    return X, y
+
+
+@pytest.mark.parametrize("family", ["logreg", "svc", "sgd", "ridge"])
+def test_family_fit_pallas_matches_gather(family, monkeypatch):
+    """Every linear family fits through mode='pallas' (interpret mode
+    on the CPU mesh) via the ONE LinearOperator interface and lands on
+    the gather path's coefficients."""
+    from skdist_tpu.base import clone
+    from skdist_tpu.models import (
+        LinearSVC,
+        LogisticRegression,
+        RidgeClassifier,
+        SGDClassifier,
+    )
+
+    X, y = _sparse_problem(seed=11, n=120, d=384)
+    est = {
+        "logreg": LogisticRegression(C=0.5, tol=1e-6, max_iter=60,
+                                     engine="xla"),
+        "svc": LinearSVC(C=0.5, tol=1e-6, max_iter=60, engine="xla"),
+        "sgd": SGDClassifier(loss="log_loss", max_iter=4, random_state=0),
+        "ridge": RidgeClassifier(alpha=1.0),
+    }[family]
+
+    def fit(mode):
+        monkeypatch.setenv(sx.SPARSE_MATVEC_ENV, mode)
+        try:
+            return clone(est).fit(X, y)
+        finally:
+            monkeypatch.delenv(sx.SPARSE_MATVEC_ENV)
+
+    m_p, m_g = fit("pallas"), fit("gather")
+    assert m_p._meta.get("x_matvec") == "pallas"
+    assert m_g._meta.get("x_matvec") == "gather"
+    tol = {"logreg": 1e-4, "svc": 5e-4, "sgd": 1e-5, "ridge": 1e-4}[family]
+    np.testing.assert_allclose(m_p.coef_, m_g.coef_, atol=tol)
+
+
+def test_grid_search_pallas_parity_and_kernel_mode(tpu_backend,
+                                                  monkeypatch):
+    """The batched CV search runs the pallas kernels through the same
+    vmapped program path, scores match gather, and the round stats
+    carry the kernel_mode attribution (observability satellite)."""
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = _sparse_problem(seed=21, n=150, d=400)
+    grid = {"C": [0.1, 1.0]}
+    est = LogisticRegression(max_iter=30, engine="xla")
+
+    def run(mode):
+        monkeypatch.setenv(sx.SPARSE_MATVEC_ENV, mode)
+        try:
+            gs = DistGridSearchCV(
+                est, grid, backend=tpu_backend, cv=3,
+                scoring="accuracy", refit=False,
+            ).fit(X, y)
+            return gs, dict(tpu_backend.last_round_stats or {})
+        finally:
+            monkeypatch.delenv(sx.SPARSE_MATVEC_ENV)
+
+    gs_p, st_p = run("pallas")
+    gs_g, st_g = run("gather")
+    np.testing.assert_allclose(
+        np.asarray(gs_p.cv_results_["mean_test_score"]),
+        np.asarray(gs_g.cv_results_["mean_test_score"]),
+        atol=1e-5,
+    )
+    assert st_p.get("kernel_mode") == "packed_pallas"
+    assert st_g.get("kernel_mode") == "packed_gather"
+
+
+def test_kernel_mode_dense_and_ovr(tpu_backend):
+    """Dense fits attribute 'dense'; the OvR batched path stamps the
+    packed mode too."""
+    from skdist_tpu.distribute.multiclass import DistOneVsRestClassifier
+    from skdist_tpu.distribute.search import DistGridSearchCV
+    from skdist_tpu.models import LinearSVC, LogisticRegression
+
+    rng = np.random.RandomState(0)
+    Xd = rng.normal(size=(90, 12)).astype(np.float32)
+    yd = (Xd[:, 0] > 0).astype(np.int64)
+    DistGridSearchCV(
+        LogisticRegression(max_iter=20, engine="xla"), {"C": [1.0]},
+        backend=tpu_backend, cv=3, scoring="accuracy", refit=False,
+    ).fit(Xd, yd)
+    assert tpu_backend.last_round_stats.get("kernel_mode") == "dense"
+
+    X, y = _sparse_problem(seed=31, n=120, d=400)
+    DistOneVsRestClassifier(
+        LinearSVC(max_iter=20, engine="xla"), backend=tpu_backend,
+    ).fit(X, y)
+    assert (tpu_backend.last_round_stats.get("kernel_mode")
+            == "packed_gather")
+
+
+def test_predict_and_batch_predict_on_pallas_fit(monkeypatch):
+    """A model fit under mode='pallas' predicts (packed decision
+    kernel) and batch_predicts identically to a gather fit — the
+    fitted artifact is representation-stable."""
+    from skdist_tpu.distribute.predict import batch_predict
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = _sparse_problem(seed=41, n=120, d=384)
+    monkeypatch.setenv(sx.SPARSE_MATVEC_ENV, "pallas")
+    model = LogisticRegression(max_iter=40, engine="xla").fit(X, y)
+    monkeypatch.delenv(sx.SPARSE_MATVEC_ENV)
+    Xh = np.asarray(X[:40].toarray(), np.float32)
+    np.testing.assert_allclose(
+        model.decision_function(X[:40]), model.decision_function(Xh),
+        atol=1e-4,
+    )
+    out = batch_predict(model, X[:40], method="predict_proba")
+    np.testing.assert_allclose(
+        out, model.predict_proba(Xh), atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite: chunked weighted gram
+# ---------------------------------------------------------------------------
+
+def test_weighted_gram_chunked_matches_unchunked():
+    rng = np.random.RandomState(7)
+    n, d, m = 100, 64, 5
+    idx = rng.randint(0, d, size=(n, m)).astype(np.int32)
+    val = rng.randn(n, m).astype(np.float32)
+    sw = rng.rand(n).astype(np.float32)
+    full = np.asarray(sx.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(sw), d,
+        row_chunk=None))
+    for chunk in (1, 7, 32, 100, 1000):
+        out = np.asarray(sx.packed_weighted_gram(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(sw), d,
+            row_chunk=chunk))
+        np.testing.assert_allclose(out, full, atol=1e-5)
+    # integer data: bitwise across every chunking (f32-exact sums)
+    vi = rng.randint(-3, 4, size=(n, m)).astype(np.float32)
+    si = rng.randint(0, 3, size=n).astype(np.float32)
+    fi = np.asarray(sx.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(vi), jnp.asarray(si), d,
+        row_chunk=n))
+    ci = np.asarray(sx.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(vi), jnp.asarray(si), d,
+        row_chunk=9))
+    np.testing.assert_array_equal(ci, fi)
+
+
+def test_weighted_gram_env_chunk_and_budget(monkeypatch):
+    """The env override engages chunking, and the budget plumbing
+    chunks automatically when the (n, m, m) tensor overshoots its
+    share — the ridge family's guard against the unguarded
+    materialisation."""
+    rng = np.random.RandomState(3)
+    n, d, m = 64, 48, 4
+    idx = rng.randint(0, d, size=(n, m)).astype(np.int32)
+    val = rng.randn(n, m).astype(np.float32)
+    sw = rng.rand(n).astype(np.float32)
+    ref = np.asarray(sx.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(sw), d,
+        row_chunk=n))
+    monkeypatch.setenv(sx.GRAM_CHUNK_ENV, "5")
+    assert sx._gram_row_chunk(n, m) == 5
+    out = np.asarray(sx.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(sw), d))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    monkeypatch.delenv(sx.GRAM_CHUNK_ENV)
+    # a budget far below the contribution tensor forces a small chunk
+    from skdist_tpu.utils.meminfo import BUDGET_ENV
+
+    monkeypatch.setenv(BUDGET_ENV, str(n * m * m * 4 // 2))
+    chunk = sx._gram_row_chunk(n, m)
+    assert chunk is not None and 1 <= chunk < n
+    monkeypatch.delenv(BUDGET_ENV)
+
+
+def test_ridge_fit_with_forced_gram_chunk(monkeypatch):
+    """A ridge fit (the gram consumer) under a forced tiny chunk lands
+    on the dense path's coefficients. Order matters: the env must be
+    set BEFORE this shape's packed fit kernel first traces (trace-time
+    decision, memoised kernel), and the reference comes from the
+    dense-forced path — a different program family — so the chunked
+    gram is genuinely the one under test."""
+    from skdist_tpu.models import Ridge
+
+    X, _ = _sparse_problem(seed=5, n=151, d=257, density=0.02)
+    rng = np.random.RandomState(2)
+    yr = np.asarray(
+        X @ rng.normal(size=X.shape[1]).astype(np.float32)
+    ) + 0.05 * rng.normal(size=X.shape[0]).astype(np.float32)
+    monkeypatch.setenv(sx.GRAM_CHUNK_ENV, "17")
+    m_chunk = Ridge(alpha=1.0).fit(X, yr)
+    monkeypatch.delenv(sx.GRAM_CHUNK_ENV)
+    assert m_chunk._meta.get("x_format") == "packed"
+    monkeypatch.setenv(sx.SPARSE_FIT_ENV, "0")
+    m_dense = Ridge(alpha=1.0).fit(X, yr)
+    monkeypatch.delenv(sx.SPARSE_FIT_ENV)
+    np.testing.assert_allclose(m_chunk.coef_, m_dense.coef_, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# satellite: hist auto must degrade (not raise) below 8 bins
+# ---------------------------------------------------------------------------
+
+def test_hist_auto_pallas_degrades_below_8_bins(monkeypatch, tmp_path):
+    from skdist_tpu.models.hist_calib import PATH_ENV, record_calibration
+    from skdist_tpu.models.tree import build_tree_kernel, resolve_hist_config
+
+    scratch = tmp_path / "hist_calib.json"
+    monkeypatch.setenv(PATH_ENV, str(scratch))
+    record_calibration("cpu", "pallas", source="test")
+    # auto resolution: degrade to an XLA engine, never 'pallas'
+    mode, _ = resolve_hist_config(10, 4, "auto")
+    assert mode in ("scatter", "matmul")
+    # and the kernel builder accepts it (the explicit-request path at
+    # models/tree.py raises; auto must not reach that raise)
+    kern = build_tree_kernel(
+        n_features=6, n_bins=4, channels=3, max_depth=2,
+        max_features=None, min_samples_split=2, min_samples_leaf=1,
+        min_impurity_decrease=0.0, extra=False, classification=True,
+        hist_mode="auto",
+    )
+    assert callable(kern)
+    # >= 8 bins keeps the calibrated pallas pick
+    mode8, _ = resolve_hist_config(10, 8, "auto")
+    assert mode8 == "pallas"
+    # an EXPLICIT pallas request below 8 bins still raises
+    with pytest.raises(ValueError, match="n_bins >= 8"):
+        build_tree_kernel(
+            n_features=6, n_bins=4, channels=3, max_depth=2,
+            max_features=None, min_samples_split=2, min_samples_leaf=1,
+            min_impurity_decrease=0.0, extra=False, classification=True,
+            hist_mode="pallas",
+        )
+
+
+# ---------------------------------------------------------------------------
+# satellite: the bf16 matmul_dtype contract on the packed gather path
+# ---------------------------------------------------------------------------
+
+def test_bf16_contract_on_packed_gather():
+    """sparse.py documents the packed bf16 pass as round-to-bf16
+    products before the f32 row-sum: pin that exact numerics contract
+    (reference emulation, bitwise) and its agreement class with the
+    dense bf16 pass."""
+    rng = np.random.RandomState(13)
+    n, d, m, k = 80, 96, 6, 3
+    X = sp.random(n, d, density=m / d, format="csr",
+                  dtype=np.float32, random_state=rng)
+    packed = sx.pack_for_fit(X)
+    if packed is None:  # density heuristics: force-pack for the test
+        idx, val = sx.pack_csr_rows(X)
+        packed = sx.PackedX(idx, val, d)
+    W = jnp.asarray(rng.randn(d + 1, k).astype(np.float32))
+    op = sx.LinearOperator(packed, fit_intercept=True,
+                           matmul_dtype="bfloat16")
+    out = np.asarray(op.matvec(W))
+    # reference emulation of the documented contract
+    g = W.astype(jnp.bfloat16)[op.pidx]
+    v = op.pval.astype(jnp.bfloat16)
+    ref = np.asarray(jnp.sum(
+        (v[:, :, None] * g).astype(jnp.float32), axis=1))
+    np.testing.assert_array_equal(out, ref)
+    # agreement with the dense bf16 pass: same precision class (bf16
+    # has ~3 significant decimal digits; magnitudes here are O(1-10))
+    Xd = jnp.asarray(np.asarray(X.toarray(), np.float32))
+    op_d = sx.LinearOperator(Xd, fit_intercept=True,
+                             matmul_dtype="bfloat16")
+    dense = np.asarray(op_d.matvec(W))
+    f32 = np.asarray(sx.LinearOperator(
+        Xd, fit_intercept=True).matvec(W))
+    scale = np.maximum(1.0, np.abs(f32))
+    assert np.max(np.abs(out - dense) / scale) < 0.02
+    assert np.max(np.abs(out - f32) / scale) < 0.02
+    # pallas mode under bf16 keeps the gather contract (no third class)
+    op_p = sx.LinearOperator(packed, fit_intercept=True,
+                             matmul_dtype="bfloat16", mode="pallas")
+    np.testing.assert_array_equal(np.asarray(op_p.matvec(W)), ref)

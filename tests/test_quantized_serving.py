@@ -1,0 +1,203 @@
+"""Quantized serving tier (ISSUE 10): serve_dtype as a routable
+compile dimension — per-channel symmetric int8 / bf16 weight storage
+with f32 accumulation, the registration parity gate, distinct
+AOT-cached program families per dtype with 0 post-warmup compiles,
+per-dtype request/latency stats, and fleet-wide rollout through
+ReplicaSet (respawn included)."""
+
+import numpy as np
+import pytest
+
+from skdist_tpu.models import LogisticRegression
+from skdist_tpu.parallel import TPUBackend, compile_cache
+from skdist_tpu.serve import ModelRegistry, ReplicaSet, ServingEngine
+from skdist_tpu.serve.quantize import (
+    SERVE_DTYPES,
+    dequantize_params,
+    quantize_params,
+)
+
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    rng = np.random.RandomState(0)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.6, size=(80, 16)) for c in (-2, 0, 2)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1, 2], 80)
+    return LogisticRegression(max_iter=80, engine="xla").fit(X, y), X, y
+
+
+# ---------------------------------------------------------------------------
+# quantize/dequantize round trip
+# ---------------------------------------------------------------------------
+
+def test_int8_per_channel_symmetric_round_trip():
+    rng = np.random.RandomState(1)
+    # channels at very different scales: per-channel scales must keep
+    # the small channel's resolution (a per-tensor scale would not)
+    W = np.stack([
+        rng.randn(40) * 10.0, rng.randn(40) * 0.01, rng.randn(40),
+    ], axis=1).astype(np.float32)
+    q = quantize_params({"W": W}, "int8")
+    assert q["W"].dtype == np.int8
+    assert q["w_scale"].shape == (3,)
+    back = np.asarray(dequantize_params(q, "int8")["W"])
+    for c in range(3):
+        amax = np.abs(W[:, c]).max()
+        assert np.abs(back[:, c] - W[:, c]).max() <= amax / 127.0 + 1e-7
+
+
+def test_quantize_requires_linear_contract():
+    with pytest.raises(ValueError, match="'W' coefficient leaf"):
+        quantize_params({"tree": np.zeros(3)}, "int8")
+    with pytest.raises(ValueError, match="serve_dtype must be one of"):
+        quantize_params({"W": np.zeros(3, np.float32)}, "float16")
+
+
+def test_bf16_halves_and_int8_quarters_params():
+    from skdist_tpu.serve.quantize import quantized_nbytes
+
+    W = np.random.RandomState(2).randn(256, 4).astype(np.float32)
+    f32 = quantized_nbytes({"W": W})
+    assert quantized_nbytes(quantize_params({"W": W}, "bfloat16")) == f32 // 2
+    q8 = quantized_nbytes(quantize_params({"W": W}, "int8"))
+    assert q8 <= f32 // 4 + 16  # + the per-channel scale vector
+
+
+# ---------------------------------------------------------------------------
+# registry: parity gate, distinct programs, zero steady-state compiles
+# ---------------------------------------------------------------------------
+
+def test_registry_dtypes_publish_and_parity(fitted_model):
+    model, X, _ = fitted_model
+    reg = ModelRegistry(backend=TPUBackend(), max_batch_rows=64)
+    e32 = reg.register("m", model, methods=("predict_proba",))
+    e8 = reg.register("m", model, methods=("predict_proba",),
+                      serve_dtype="int8")
+    eb = reg.register("m", model, methods=("predict_proba",),
+                      serve_dtype="bfloat16")
+    assert (e32.serve_dtype, e8.serve_dtype, eb.serve_dtype) == (
+        "float32", "int8", "bfloat16")
+    # parity was measured and is inside the documented bound
+    assert e32.quant_error is None
+    assert 0 <= e8.quant_error <= 5e-2
+    assert 0 <= eb.quant_error <= 5e-2
+    # the quantized tier really shrank the staged params
+    assert e8.params_nbytes < eb.params_nbytes
+    # versioning: three immutable versions of one name
+    assert reg.versions("m") == [1, 2, 3]
+    # distinct program families: the dtype is in every plan cache key
+    keys = {e.methods["predict_proba"].plan.cache_key() for e in
+            (e32, e8, eb)}
+    assert len(keys) == 3
+
+
+def test_registry_rejects_dtype_on_host_fallback():
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(60, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    sk = SkLR(max_iter=50).fit(X, y)
+    reg = ModelRegistry(backend=TPUBackend(), max_batch_rows=64)
+    with pytest.raises(ValueError, match="float32-only"):
+        reg.register("sk", sk, serve_dtype="int8")
+
+
+def test_registry_parity_bound_is_enforced(fitted_model):
+    model, _, _ = fitted_model
+    reg = ModelRegistry(backend=TPUBackend(), max_batch_rows=64)
+    with pytest.raises(ValueError, match="parity probe"):
+        reg.register("m", model, methods=("predict_proba",),
+                     serve_dtype="int8", quant_parity_bound=1e-9)
+
+
+def test_engine_quantized_zero_postwarm_compiles(fitted_model):
+    """The acceptance invariant: int8/bf16 variants are distinct
+    AOT-cached programs and traffic across ALL dtypes compiles nothing
+    after warmup."""
+    model, X, _ = fitted_model
+    with ServingEngine(backend=TPUBackend(), max_batch_rows=64) as eng:
+        eng.register("m32", model, methods=("predict_proba",))
+        eng.register("m8", model, methods=("predict_proba",),
+                     serve_dtype="int8")
+        eng.register("mb", model, methods=("predict_proba",),
+                     serve_dtype="bfloat16")
+        p32 = eng.predict_proba(X[:6], model="m32")
+        p8 = eng.predict_proba(X[:6], model="m8")
+        pb = eng.predict_proba(X[:6], model="mb")
+        # int8/bf16 proba parity on real traffic within the documented
+        # serving bound (proba are in [0, 1]: absolute comparison)
+        assert np.abs(p32 - p8).max() < 5e-2
+        assert np.abs(p32 - pb).max() < 5e-2
+        snap = compile_cache.snapshot()
+        for i in range(8):
+            for name in ("m32", "m8", "mb"):
+                eng.predict_proba(X[i:i + 3], model=name)
+        after = compile_cache.snapshot()
+        assert all(
+            after[k] == snap[k]
+            for k in ("kernel_misses", "jit_misses", "aot_misses")
+        )
+        assert eng.stats()["compiles_after_warmup"] == 0
+
+
+def test_engine_stats_split_by_dtype(fitted_model):
+    model, X, _ = fitted_model
+    with ServingEngine(backend=TPUBackend(), max_batch_rows=64) as eng:
+        eng.register("m32", model, methods=("predict_proba",))
+        eng.register("m8", model, methods=("predict_proba",),
+                     serve_dtype="int8")
+        for _ in range(3):
+            eng.predict_proba(X[:4], model="m8")
+        eng.predict_proba(X[:4], model="m32")
+        split = eng.stats()["by_serve_dtype"]
+        assert split["int8"]["requests"] == 3
+        assert split["int8"]["completed"] == 3
+        assert split["float32"]["requests"] == 1
+        assert split["int8"]["p50_ms"] is not None
+
+
+# ---------------------------------------------------------------------------
+# fleet: rollout carries the dtype, respawn reproduces it
+# ---------------------------------------------------------------------------
+
+def test_replicaset_rollout_carries_dtype(fitted_model):
+    model, X, _ = fitted_model
+    rs = ReplicaSet(n_replicas=2, backend=TPUBackend(), max_batch_rows=64)
+    try:
+        entries = rs.rollout("q", model, methods=("predict_proba",),
+                             serve_dtype="int8")
+        assert all(e.serve_dtype == "int8" for e in entries)
+        out = rs.predict_proba(X[:4], model="q")
+        # kill + heal: the respawned generation re-registers the SAME
+        # dtype and serves identically (prewarm-before-publish)
+        rs.kill_replica(0)
+        out2 = rs.predict_proba(X[:4], model="q")
+        np.testing.assert_array_equal(out, out2)
+        rs.heal()
+        ent = rs.replica(0).engine.registry.get("q")
+        assert ent.serve_dtype == "int8"
+        assert rs.replica(0).generation == 1
+    finally:
+        rs.close()
+
+
+def test_all_dtypes_are_valid_rollout_args(fitted_model):
+    model, X, _ = fitted_model
+    rs = ReplicaSet(n_replicas=1, backend=TPUBackend(), max_batch_rows=64)
+    try:
+        for dt in SERVE_DTYPES:
+            rs.rollout(f"m-{dt}", model, methods=("decision_function",),
+                       serve_dtype=dt)
+        outs = {
+            dt: rs.decision_function(X[:4], model=f"m-{dt}")
+            for dt in SERVE_DTYPES
+        }
+        scale = max(1.0, np.abs(outs["float32"]).max())
+        for dt in ("bfloat16", "int8"):
+            assert (np.abs(outs[dt] - outs["float32"]).max() / scale
+                    < 5e-2)
+    finally:
+        rs.close()
