@@ -1,0 +1,99 @@
+"""Native histogram-GBDT smoke: the gradient-boosting PR's acceptance
+gate, standalone on the 8-virtual-device CPU mesh.
+
+Runs ``bench.gbdt_aux`` (covtype-shaped quality-skewed grid through
+``DistGridSearchCV(DistHistGradientBoostingClassifier, ...)``) and
+asserts:
+
+- batched warm-wall speedup >= RATIO (default 2.0) over the same
+  (candidate x fold) tasks fit sequentially through the estimator's
+  own fit (one dispatch per task, identical weight-mask fold math);
+- the adaptive (``HalvingSpec``) race returns the SAME best candidate
+  as the exhaustive run and actually killed candidates at rungs;
+- accuracy parity vs sklearn ``HistGradientBoostingClassifier`` at the
+  best candidate's params within 0.02;
+- per-task score parity: the fused device CV scores equal the
+  sequential per-task log losses to f32 (same masks, same bin edges);
+- 0 post-warmup compiles: the warm search moves only hit counters.
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/gbdt_smoke.py [--ratio 2.0]
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def main(ratio):
+    from bench import gbdt_aux
+
+    aux = gbdt_aux(quick=True)
+    print(json.dumps({"gbdt": aux, "target_ratio": ratio}, indent=1))
+    if "error" in aux:
+        raise SystemExit(f"FAIL: gbdt aux died: {aux['error']}")
+
+    failures = []
+    if aux["speedup_vs_sequential"] < ratio:
+        failures.append(
+            f"batched speedup {aux['speedup_vs_sequential']} < {ratio} "
+            "over sequential per-task fits"
+        )
+    if not aux["adaptive_same_best"]:
+        failures.append(
+            "adaptive race returned a different best candidate than "
+            "the exhaustive run — the rungs killed the winner"
+        )
+    if aux["adaptive_rung_killed_candidates"] <= 0:
+        failures.append(
+            "no candidate was rung-killed: the adaptive path did not "
+            "engage on the skewed grid"
+        )
+    if aux["accuracy_delta_vs_sklearn"] > 0.02:
+        failures.append(
+            f"accuracy delta vs sklearn {aux['accuracy_delta_vs_sklearn']}"
+            " > 0.02 at the best candidate"
+        )
+    if aux["sequential_batched_score_max_diff"] > 1e-3:
+        failures.append(
+            "batched device scores diverge from sequential per-task "
+            f"scores by {aux['sequential_batched_score_max_diff']}"
+        )
+    delta = aux.get("warm_compile_cache_delta") or {}
+    for key in ("jit_misses", "aot_misses"):
+        if delta.get(key, 0) != 0:
+            failures.append(
+                f"warm search compiled: {key} moved by {delta[key]}"
+            )
+    if aux.get("kernel_mode") != "hist_tree":
+        failures.append(
+            f"kernel_mode {aux.get('kernel_mode')!r} != 'hist_tree' — "
+            "the observability stamp is missing"
+        )
+
+    if failures:
+        print("FAIL:\n  - " + "\n  - ".join(failures))
+        raise SystemExit(1)
+    print(
+        f"PASS: {aux['speedup_vs_sequential']}x batched vs sequential, "
+        f"adaptive same-best with {aux['adaptive_rung_killed_candidates']}"
+        f" rung-killed candidates, sklearn accuracy delta "
+        f"{aux['accuracy_delta_vs_sklearn']}, 0 warm compiles"
+    )
+
+
+if __name__ == "__main__":
+    ratio = 2.0
+    if "--ratio" in sys.argv:
+        ratio = float(sys.argv[sys.argv.index("--ratio") + 1])
+    main(ratio)

@@ -70,6 +70,14 @@
 #                             drained+respawned warm (0 compiles),
 #                             respawned replica serves, p99 bounded
 #                             (elastic mesh + replica fleet PR).
+#   gbdt_smoke.py           — native histogram GBDT: batched
+#                             candidate x fold grid >= 2x warm wall
+#                             over sequential per-task fits, adaptive
+#                             race same-best with rung kills, sklearn
+#                             HistGradientBoosting accuracy parity
+#                             <= 0.02, per-task score parity vs the
+#                             sequential leg, kernel_mode stamped,
+#                             0 post-warmup compiles (GBDT fan-out PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
@@ -81,3 +89,4 @@ python build_tools/fault_smoke.py
 python build_tools/streaming_smoke.py
 python build_tools/elastic_smoke.py
 python build_tools/kernels_smoke.py
+python build_tools/gbdt_smoke.py

@@ -54,6 +54,8 @@ _EXPORTS = {
         "DistExtraTreesRegressor": "skdist_tpu.distribute.ensemble",
         "DistRandomTreesEmbedding": "skdist_tpu.distribute.ensemble",
         "DistFeatureEliminator": "skdist_tpu.distribute.eliminate",
+        "DistHistGradientBoostingClassifier": "skdist_tpu.models.gbdt",
+        "DistHistGradientBoostingRegressor": "skdist_tpu.models.gbdt",
         "ChunkedDataset": "skdist_tpu.data",
         "Encoderizer": "skdist_tpu.distribute.encoder",
         "EncoderizerExtractor": "skdist_tpu.distribute.encoder",

@@ -45,6 +45,7 @@ from ..metrics import (
     default_device_scorer,
     device_scorer_compatible,
     resolve_rung_scorer,
+    scorer_task_compatible,
 )
 from ..parallel import (
     RungController,
@@ -386,6 +387,14 @@ def _resolve_device_scoring(estimator, scoring):
     for out_name, metric in names:
         if metric not in DEVICE_SCORERS:
             return None
+        # task-kind mismatches (a regression metric on a classifier,
+        # whose device 'predict' output is decision scores rather than
+        # labels; a classification metric on a regressor, whose meta
+        # has no n_classes to trace against) route to the host path,
+        # where sklearn's own scorer semantics — including its raises
+        # under the error_score contract — apply per task
+        if not scorer_task_compatible(metric, estimator):
+            return None
         kernel, kind = DEVICE_SCORERS[metric]
         specs.append((out_name, metric, kernel, kind))
     return specs
@@ -416,6 +425,19 @@ def _resolve_stream_scoring(estimator, scoring, y=None):
                 f"scoring={metric!r} has no streamed (decomposable) "
                 "kernel; streamed search supports "
                 f"{sorted(STREAM_SCORERS)}"
+            )
+        if not scorer_task_compatible(metric, estimator):
+            # the streamed path has no host fallback: a task-kind
+            # mismatch must raise — a regression metric on a
+            # classifier would silently score raw decision values
+            # (sklearn scores predicted labels), and a classification
+            # metric on a regressor would trace against a meta with
+            # no n_classes and crash mid-dispatch
+            raise ValueError(
+                f"scoring={metric!r} does not fit a "
+                f"{getattr(estimator, '_estimator_type', 'model')}: "
+                "streamed scoring has no host fallback, so the metric "
+                "must match the estimator kind"
             )
         if metric in BINARY_ONLY_SCORERS and not \
                 device_scorer_compatible(metric, classes):

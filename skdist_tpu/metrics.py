@@ -199,6 +199,26 @@ DEVICE_SCORERS = {
 #: semantics (including raising on multiclass)
 BINARY_ONLY_SCORERS = {"f1", "roc_auc"}
 
+#: task-kind split of the device scorers: the classification kernels
+#: read ``meta["n_classes"]`` / encoded labels (tracing them against a
+#: regressor's meta would CRASH, and their semantics are meaningless
+#: for continuous targets), and the regression kernels score raw
+#: predictions (a classifier's device 'predict' output is its decision
+#: scores, NOT its labels, so e.g. device-r2 would silently disagree
+#: with sklearn's r2-on-predicted-labels). Mismatches route to the
+#: host path (exact sklearn semantics, incl. its own raises) — and an
+#: adaptive rung metric that mismatches warns + runs exhaustive
+#: instead of crashing mid-dispatch.
+CLASSIFICATION_ONLY_SCORERS = {
+    "accuracy", "f1", "f1_macro", "f1_micro", "f1_weighted",
+    "precision_weighted", "recall_weighted", "balanced_accuracy",
+    "neg_log_loss", "roc_auc",
+}
+REGRESSION_ONLY_SCORERS = {
+    "r2", "neg_mean_squared_error", "neg_root_mean_squared_error",
+    "neg_mean_absolute_error",
+}
+
 
 # ---------------------------------------------------------------------------
 # streamed (decomposable) scorer kernels
@@ -346,9 +366,28 @@ def device_scorer_supported(name):
     return name in DEVICE_SCORERS
 
 
-def device_scorer_compatible(metric, classes):
+def scorer_task_compatible(metric, task):
+    """Whether ``metric``'s device kernel fits this estimator kind
+    (``task``: an estimator, estimator class, or ``'classifier'``/
+    ``'regressor'`` string — unknown kinds pass, the shape/meta checks
+    downstream own those)."""
+    kind = task if isinstance(task, str) else getattr(
+        task, "_estimator_type", None
+    )
+    if kind == "classifier" and metric in REGRESSION_ONLY_SCORERS:
+        return False
+    if kind == "regressor" and metric in CLASSIFICATION_ONLY_SCORERS:
+        return False
+    return True
+
+
+def device_scorer_compatible(metric, classes, task=None):
     """Whether the device kernel for ``metric`` agrees with sklearn's
-    semantics for this label set."""
+    semantics for this label set — and, when ``task`` (an estimator,
+    estimator class, or ``'classifier'``/``'regressor'`` string) is
+    given, for this estimator kind (see the task-kind split above)."""
+    if task is not None and not scorer_task_compatible(metric, task):
+        return False
     if metric in BINARY_ONLY_SCORERS:
         if classes is None or len(classes) != 2:
             return False
@@ -416,7 +455,11 @@ def resolve_rung_scorer(metric, scorer_specs, refit, classes=None,
         return producible(scorer_specs[0])
     if metric not in DEVICE_SCORERS:
         return None
-    if not device_scorer_compatible(metric, classes):
+    # the task-kind guard matters doubly here: a classification rung
+    # kernel traced against a regressor's meta (no n_classes) would
+    # crash mid-dispatch rather than score wrongly — None makes the
+    # caller warn and run exhaustive instead
+    if not device_scorer_compatible(metric, classes, task=est_cls):
         return None
     kernel, kind = DEVICE_SCORERS[metric]
     return producible(("rung", metric, kernel, kind))

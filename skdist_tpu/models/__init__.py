@@ -32,9 +32,15 @@ from .forest import (
     RandomForestRegressor,
     RandomTreesEmbedding,
 )
+from .gbdt import (
+    DistHistGradientBoostingClassifier,
+    DistHistGradientBoostingRegressor,
+)
 from .naive_bayes import GaussianNB, MultinomialNB
 
 __all__ = [
+    "DistHistGradientBoostingClassifier",
+    "DistHistGradientBoostingRegressor",
     "LogisticRegression",
     "LinearSVC",
     "SGDClassifier",

@@ -1,0 +1,700 @@
+"""
+Native histogram gradient-boosted trees as a first-class fan-out
+workload.
+
+The reference treated gradient boosting as an external drop-in (xgboost
+listed among the compute sk-dist "leans on", SURVEY §0), so the largest
+real tabular workload class — ranking/CTR — never touched the fan-out
+machinery. Here boosting is built FROM the framework's own parts:
+
+- one boosting round = one histogram tree (``models/tree.py``'s
+  ``build_tree_kernel`` in its ``newton=True`` objective: grad/hess
+  channels via ``newton_channels``, gain ``G²/(H+λ)`` with the traced
+  ``l2_regularization``, Newton-step leaves), quantile binning once at
+  fit entry (``ops/binning.py``);
+- the ensemble is a **carry chain**: the carry holds the raw
+  predictions F, the stacked tree arrays, and the early-stop
+  bookkeeping — exactly the shape ``batched_map_iterative`` /
+  :class:`~skdist_tpu.parallel.IterativeKernelSpec` schedule, so a
+  candidate×fold grid races through ``DistGridSearchCV`` as batched
+  tasks, lanes retire at boosting-round boundaries (early stopping →
+  the done flag), and ``adaptive=HalvingSpec(...)`` scores the LIVE
+  ensemble every slice (``score_params`` shapes a valid model from the
+  current carry — trees grown so far plus the baseline);
+- prediction walks the stacked trees (depth-static gathers), so fitted
+  models ride ``device_predict_plan`` into ``batch_predict`` and the
+  serving registry (``serve_dtype`` tiers quantize the leaf-value
+  arrays — ``serve/quantize.py``).
+
+sklearn ``HistGradientBoosting*`` parity semantics: ``learning_rate``,
+``max_iter``, ``max_depth``, ``max_bins``, ``l2_regularization``,
+``min_samples_leaf``, ``early_stopping``/``validation_fraction``/
+``n_iter_no_change``/``tol`` follow sklearn's meanings. Deliberate
+divergences (inherent to the fixed-shape device design, shared with
+every GPU/TPU tree library): trees are depth-bounded
+(``max_depth=5`` ≈ sklearn's default ``max_leaf_nodes=31``) instead of
+leaf-count-bounded, split thresholds are quantile-bin boundaries
+(``max_bins`` defaults 64, not 255 — raise it when fidelity beats
+wall), and the early-stopping validation split is a hash-style
+deterministic row mask (sklearn uses ``train_test_split``), so
+``n_iter_`` matches sklearn's stopping *rule*, not its exact round.
+
+``learning_rate``, ``l2_regularization`` and ``tol`` are traced
+hyperparameters — a grid over them vmaps into ONE XLA program; the
+structure params (``max_iter``, ``max_depth``, ``max_bins``, the
+early-stop knobs) are compile-shaping statics, so candidates differing
+there bucket into separate programs like every other family.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import BaseEstimator, ClassifierMixin, RegressorMixin
+from ..ops.binning import MAX_BINS, apply_bins, quantile_bin_edges
+from .linear import (
+    _freeze,
+    _to_jnp,
+    as_dense_f32,
+    encode_labels,
+    get_kernel,
+    host_stage,
+    hyper_float,
+    prepare_sample_weight,
+)
+from .tree import (
+    build_tree_kernel,
+    n_tree_nodes,
+    newton_channels,
+    tree_predict_kernel,
+)
+
+__all__ = [
+    "DistHistGradientBoostingClassifier",
+    "DistHistGradientBoostingRegressor",
+]
+
+#: sklearn's early_stopping='auto' rule: on iff the fit sees more rows
+EARLY_STOP_AUTO_N = 10000
+
+#: probability floor for the baseline log-odds / log-prior init
+_P_EPS = 1e-7
+
+
+def _check_early_stopping(early_stopping):
+    if early_stopping not in ("auto", True, False):
+        raise ValueError(
+            "early_stopping must be 'auto', True or False; got "
+            f"{early_stopping!r}"
+        )
+
+
+def _resolve_early_stopping(early_stopping, n_samples):
+    # re-validated because set_params bypasses __init__ (the
+    # library-wide convention): a typo'd value must not silently
+    # coerce through bool()
+    _check_early_stopping(early_stopping)
+    if early_stopping == "auto":
+        return bool(n_samples > EARLY_STOP_AUTO_N)
+    return bool(early_stopping)
+
+
+def _stacked_tree_walk(Xb, feat, thr, split, leaf, max_depth):
+    """Leaf values of ONE stacked tree bank: ``feat/thr/split/leaf``
+    are (Kt, N) heap arrays, returns (n, Kt) — the per-round F update
+    and the decision kernel share this walker, which is the ONE
+    existing traversal (``tree_predict_kernel`` — the single-tree and
+    forest families' walker) vmapped over the bank axis, so split
+    semantics can never drift between the families."""
+    walk = tree_predict_kernel(max_depth)
+
+    def walk_one(f_a, t_a, s_a, l_a):
+        tree = {"feat": f_a, "thr": t_a, "is_split": s_a,
+                "leaf": l_a[:, None]}
+        return walk(tree, Xb)[:, 0]
+
+    return jnp.transpose(jax.vmap(walk_one)(feat, thr, split, leaf))
+
+
+def _build_boost_parts(meta, static):
+    """The one construction point of a GBDT fit's traced pieces:
+    ``init_carry`` / ``resume`` / ``finalize`` closures over (X, y, sw,
+    hyper, aux). The plain fit kernel (init + one full resume) and the
+    iteration-sliced kernels (init + n_slice, step = n_slice more) are
+    both generated from these, so a sliced run is bitwise identical to
+    the fused solve — the same guarded round body runs in the same
+    order, only the loop partitioning differs."""
+    st = dict(static)
+    K = st.get("_n_classes", 0)
+    classification = K > 0
+    Kt = 1 if (not classification or K <= 2) else K
+    D = int(st["max_depth"])
+    T = int(st["max_iter"])
+    N = n_tree_nodes(D)
+    es = bool(st["_early_stopping"])
+    vf = st["validation_fraction"]
+    patience = int(st["n_iter_no_change"])
+    seed = int(st["random_state"] or 0)
+    loss_name = st["loss"]
+    if T < 1:
+        raise ValueError(f"max_iter must be >= 1; got {T}")
+    if patience < 1:
+        raise ValueError(
+            f"n_iter_no_change must be >= 1; got {patience}"
+        )
+    if classification and loss_name != "log_loss":
+        raise ValueError(
+            "DistHistGradientBoostingClassifier supports loss='log_loss'"
+        )
+    if not classification and loss_name != "squared_error":
+        raise ValueError(
+            "DistHistGradientBoostingRegressor supports "
+            "loss='squared_error'"
+        )
+    if vf is not None and not 0.0 < float(vf) < 1.0:
+        raise ValueError(
+            f"validation_fraction must be in (0, 1) or None; got {vf!r}"
+        )
+
+    grow = build_tree_kernel(
+        n_features=st["_n_features"], n_bins=st["max_bins"], channels=3,
+        max_depth=D, max_features=st["_n_features"], min_samples_split=2,
+        min_samples_leaf=st["min_samples_leaf"],
+        min_impurity_decrease=0.0, extra=False, classification=False,
+        hist_mode=st.get("hist_mode", "auto"),
+        # grad/hess channels are fractional by construction: a
+        # calibrated matmul_sib 'auto' pick must degrade to matmul
+        fractional_weights=True, newton=True,
+    )
+
+    def grads(F, y):
+        """Per-sample (gradient, hessian) of the boosting loss at raw
+        predictions ``F`` (n, Kt)."""
+        if not classification:
+            return F[:, 0] - y, jnp.ones_like(F[:, 0])
+        if K <= 2:
+            y01 = (y == (K - 1)).astype(jnp.float32)
+            p = jax.nn.sigmoid(F[:, 0])
+            return p - y01, p * (1.0 - p)
+        P = jax.nn.softmax(F, axis=1)
+        Y1 = jax.nn.one_hot(y, K, dtype=jnp.float32)
+        return P - Y1, P * (1.0 - P)
+
+    def loss_vals(F, y):
+        """Per-sample loss at raw predictions — what the early-stop
+        monitor averages (sklearn's scoring='loss')."""
+        if not classification:
+            return 0.5 * (y - F[:, 0]) ** 2
+        if K <= 2:
+            y01 = (y == (K - 1)).astype(jnp.float32)
+            z = F[:, 0]
+            return jax.nn.softplus(z) - y01 * z
+        lse = jax.nn.logsumexp(F, axis=1)
+        fy = jnp.take_along_axis(
+            F, y.astype(jnp.int32)[:, None], axis=1
+        )[:, 0]
+        return lse - fy
+
+    def baseline_of(y, w):
+        """Constant raw prediction minimising the loss on the weighted
+        train rows: weighted mean / log-odds / log-priors."""
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+        if not classification:
+            return (jnp.sum(w * y) / wsum)[None]
+        if K <= 2:
+            y01 = (y == (K - 1)).astype(jnp.float32)
+            p = jnp.clip(jnp.sum(w * y01) / wsum, _P_EPS, 1.0 - _P_EPS)
+            return jnp.log(p / (1.0 - p))[None]
+        pri = jax.nn.one_hot(y, K, dtype=jnp.float32).T @ w / wsum
+        return jnp.log(jnp.clip(pri, _P_EPS, None))
+
+    def fit_weights(sw, n):
+        """(train_w, monitor_w): the early-stop validation split is a
+        deterministic PRNG row mask shared by init/step/finalize (they
+        are separate jit entries, so the mask must be a pure function
+        of static config + n). Rows outside this task's CV fold carry
+        sw == 0 and drop out of both sides."""
+        if es and vf is not None:
+            r = jax.random.uniform(
+                jax.random.PRNGKey(seed ^ 0x5DEECE66), (n,)
+            )
+            vmask = (r < float(vf)).astype(jnp.float32)
+            return sw * (1.0 - vmask), sw * vmask
+        return sw, sw
+
+    def init_carry(X, y, sw, hyper, aux=None):
+        n = X.shape[0]
+        train_w, _ = fit_weights(sw, n)
+        b0 = baseline_of(y, train_w).astype(jnp.float32)  # (Kt*,)
+        F0 = jnp.broadcast_to(b0[None, :], (n, Kt)).astype(jnp.float32)
+        zi = jnp.zeros((T, Kt, N), jnp.int32)
+        return {
+            "F": F0,
+            "feat": jnp.full((T, Kt, N), -1, jnp.int32),
+            "thr": zi,
+            "split": jnp.zeros((T, Kt, N), bool),
+            "leaf": jnp.zeros((T, Kt, N), jnp.float32),
+            "baseline": b0,
+            "it": jnp.int32(0),
+            "done": jnp.asarray(False),
+            "best": jnp.float32(np.inf),
+            "bad": jnp.int32(0),
+        }
+
+    def resume(X, y, sw, hyper, carry, n_rounds, aux=None):
+        n = X.shape[0]
+        Xb = apply_bins(X, aux["edges"])
+        lr = hyper["learning_rate"]
+        lam = hyper["l2_regularization"]
+        tol = hyper["tol"]
+        train_w, monitor_w = fit_weights(sw, n)
+        base_key = jax.random.PRNGKey(seed)
+
+        def round_body(c):
+            it = c["it"]
+            g, h = grads(c["F"], y)  # (n,) or (n, K)
+            key = jax.random.fold_in(base_key, it)
+            if Kt == 1:
+                Ych = newton_channels(g, h, train_w)
+                tree = grow(Xb, Ych, key, lam)
+                feat_r = tree["feat"][None]        # (1, N)
+                thr_r = tree["thr"][None]
+                split_r = tree["is_split"][None]
+                leaf_r = (tree["leaf"][:, 0] * lr)[None]
+            else:
+                Ych_k = jax.vmap(
+                    lambda gk, hk: newton_channels(gk, hk, train_w),
+                    in_axes=(1, 1),
+                )(g, h)  # (K, n, 3)
+                keys = jax.random.split(key, Kt)
+                trees = jax.vmap(
+                    lambda ych, k: grow(Xb, ych, k, lam),
+                    in_axes=(0, 0),
+                )(Ych_k, keys)
+                feat_r = trees["feat"]             # (K, N)
+                thr_r = trees["thr"]
+                split_r = trees["is_split"]
+                leaf_r = trees["leaf"][..., 0] * lr
+            F_new = c["F"] + _stacked_tree_walk(
+                Xb, feat_r, thr_r, split_r, leaf_r, D
+            )
+            mon = jnp.sum(monitor_w * loss_vals(F_new, y)) / jnp.maximum(
+                jnp.sum(monitor_w), 1e-12
+            )
+            improved = mon < c["best"] - tol
+            it1 = it + 1
+            done = it1 >= T
+            bad = jnp.where(improved, 0, c["bad"] + 1).astype(jnp.int32)
+            if es:
+                done = done | (bad >= patience)
+            return {
+                "F": F_new,
+                "feat": c["feat"].at[it].set(feat_r),
+                "thr": c["thr"].at[it].set(thr_r),
+                "split": c["split"].at[it].set(split_r),
+                "leaf": c["leaf"].at[it].set(leaf_r),
+                "baseline": c["baseline"],
+                "it": it1,
+                "done": done,
+                "best": jnp.minimum(c["best"], mon),
+                "bad": bad,
+            }
+
+        def guarded(_, c):
+            new = round_body(c)
+            return jax.tree_util.tree_map(
+                lambda o, v: jnp.where(c["done"], o, v), c, new
+            )
+
+        return lax.fori_loop(0, int(n_rounds), guarded, carry)
+
+    def finalize(carry, aux=None):
+        return {
+            "feat": carry["feat"],
+            "thr": carry["thr"],
+            "is_split": carry["split"],
+            "leaf": carry["leaf"],
+            "baseline": carry["baseline"],
+            "n_iter": carry["it"],
+            "edges": aux["edges"],
+        }
+
+    return {
+        "init_carry": init_carry, "resume": resume, "finalize": finalize,
+        "Kt": Kt, "D": D, "T": T, "classification": classification,
+        "K": K,
+    }
+
+
+class _BaseGBDT(BaseEstimator):
+    """Shared surface of the two boosting estimators: the batched-fit
+    contract (``_hyper_names``/``_static_names``/``_prep_fit_data``/
+    ``_build_fit_kernel``/``_build_decision_kernel``), the
+    iteration-sliced contract the convergence-compacted scheduler and
+    ASHA consume (``_build_fit_slice_kernels`` — one boosting round per
+    iteration, the live carry scoreable at every slice boundary), and
+    the fitted predict surface ``device_predict_plan`` stages into the
+    serving registry."""
+
+    _hyper_names = ("learning_rate", "l2_regularization", "tol")
+    _static_names = (
+        "loss", "max_iter", "max_depth", "max_bins", "min_samples_leaf",
+        "early_stopping", "validation_fraction", "n_iter_no_change",
+        "random_state", "hist_mode",
+    )
+    #: tree kernels opt out of the 'highest' matmul pass (see
+    #: linear.exact_matmuls): the histogram contraction accumulates f32
+    #: via preferred_element_type on every engine already
+    _exact_matmuls = False
+    #: packed-CSR input has no histogram form; prepare_fit_X densifies
+    _supports_packed_X = False
+    #: the compacted scheduler's gate: boosting rounds are the
+    #: iteration axis, early stopping is the done flag
+    _supports_sliced_fit = True
+
+    def __init__(self, loss, learning_rate=0.1, max_iter=100, max_depth=5,
+                 max_bins=64, l2_regularization=0.0, min_samples_leaf=20,
+                 early_stopping="auto", validation_fraction=0.1,
+                 n_iter_no_change=10, tol=1e-7, random_state=0,
+                 hist_mode="auto"):
+        self.loss = loss
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.l2_regularization = l2_regularization
+        self.min_samples_leaf = min_samples_leaf
+        self.early_stopping = early_stopping
+        self.validation_fraction = validation_fraction
+        self.n_iter_no_change = n_iter_no_change
+        self.tol = tol
+        self.random_state = random_state
+        self.hist_mode = hist_mode
+        if not 2 <= int(max_bins) <= MAX_BINS:
+            raise ValueError(
+                f"max_bins must be in [2, {MAX_BINS}]; got {max_bins}"
+            )
+        _check_early_stopping(early_stopping)
+        self._check_hypers()
+
+    def _check_hypers(self):
+        """sklearn-parity domain validation of the traced hypers (the
+        values sklearn's HistGradientBoosting* rejects): called from
+        __init__ AND from _prep_fit_data, so clone+set_params fits (the
+        generic search path included) revalidate like the statics do.
+        Batched grids validate the estimator's own values per bucket;
+        per-candidate hyper arrays ride the traced task axis unchecked
+        — grid authors own those like every traced hyper."""
+        lr = getattr(self, "learning_rate", 0.1)
+        if not (lr is None or float(lr) > 0):
+            raise ValueError(
+                f"learning_rate must be > 0; got {lr!r}"
+            )
+        l2 = getattr(self, "l2_regularization", 0.0)
+        if not (l2 is None or float(l2) >= 0):
+            raise ValueError(
+                f"l2_regularization must be >= 0; got {l2!r}"
+            )
+
+    @property
+    def _classification(self):
+        return isinstance(self, ClassifierMixin)
+
+    @classmethod
+    def _batched_task_cost(cls, hyper):
+        """Round-packing heuristic: a smaller learning rate needs more
+        boosting rounds before the no-improvement rule fires, and a
+        tighter tol delays it further (tol=None → -inf never stops
+        early and sorts last — the linear families' convention)."""
+        lr = np.asarray(hyper.get("learning_rate", 0.1), dtype=np.float64)
+        tol = np.asarray(hyper.get("tol", 1e-7), dtype=np.float64)
+        cost = -np.log(np.maximum(lr, 1e-30)) - np.where(
+            tol > 0, np.log(np.where(tol > 0, tol, 1.0)), -np.inf
+        )
+        return np.broadcast_to(
+            cost, np.broadcast_shapes(lr.shape, tol.shape)
+        )
+
+    # ---- fit-data prep ----------------------------------------------------
+    def _prep_fit_data(self, X, y, sample_weight=None):
+        self._check_hypers()
+        X = as_dense_f32(X)
+        sw = prepare_sample_weight(sample_weight, X.shape[0])
+        edges = quantile_bin_edges(X, self.max_bins)
+        meta = {
+            "n_features": X.shape[1],
+            "n_samples": X.shape[0],
+            "edges": edges,
+            # stamps batched dispatches as the histogram-tree family in
+            # last_round_stats (linear.kernel_mode_of)
+            "kernel_family": "hist_tree",
+        }
+        if self._classification:
+            y_idx, classes = encode_labels(y)
+            meta.update(classes=classes, n_classes=len(classes))
+            data = {"X": host_stage(X), "y": host_stage(y_idx),
+                    "sw": host_stage(sw)}
+        else:
+            data = {"X": host_stage(X),
+                    "y": np.asarray(y, np.float32).reshape(-1),
+                    "sw": host_stage(sw)}
+        data["edges"] = host_stage(edges)
+        return data, meta
+
+    def _static_config(self, meta):
+        cfg = {k: getattr(self, k) for k in self._static_names}
+        cfg["_n_classes"] = meta.get("n_classes", 0)
+        cfg["_n_features"] = meta["n_features"]
+        # 'auto' resolves against the fit's row count, so it must ride
+        # the compiled program's key — two datasets straddling the
+        # threshold are different programs
+        cfg["_early_stopping"] = _resolve_early_stopping(
+            self.early_stopping, meta.get("n_samples", 0)
+        )
+        return cfg
+
+    # ---- kernels ----------------------------------------------------------
+    @classmethod
+    def _build_fit_kernel(cls, meta, static):
+        parts = _build_boost_parts(meta, static)
+
+        def kernel(X, y, sw, hyper, aux=None):
+            carry = parts["init_carry"](X, y, sw, hyper, aux)
+            carry = parts["resume"](
+                X, y, sw, hyper, carry, parts["T"], aux
+            )
+            return parts["finalize"](carry, aux)
+
+        return kernel
+
+    @classmethod
+    def _build_fit_slice_kernels(cls, meta, static, n_slice):
+        """Iteration-sliced boosting: ``init`` starts the carry chain
+        and runs the first ``n_slice`` rounds, ``step`` boosts another
+        slice, ``finalize`` shapes the ensemble params. The carry's
+        ``done`` leaf (early-stopped or round budget exhausted) is the
+        flags-only gather the compaction loop reads, and
+        ``score_params`` shapes a VALID model from the live carry —
+        trees grown so far plus the baseline — so ASHA rungs read
+        trajectories without perturbing them."""
+        parts = _build_boost_parts(meta, static)
+        n_slice = int(n_slice)
+
+        def init(X, y, sw, hyper, aux=None):
+            carry = parts["init_carry"](X, y, sw, hyper, aux)
+            return parts["resume"](X, y, sw, hyper, carry, n_slice, aux)
+
+        def step(X, y, sw, hyper, carry, aux=None):
+            return parts["resume"](X, y, sw, hyper, carry, n_slice, aux)
+
+        def finalize(X, y, sw, hyper, carry, aux=None):
+            return parts["finalize"](carry, aux)
+
+        return {
+            "init": init, "step": step, "finalize": finalize,
+            # F (n, Kt) and the early-stop scalars never leave the
+            # device at retirement — only the tree bank does
+            "finalize_keys": ("feat", "thr", "split", "leaf",
+                              "baseline", "it"),
+            "score_params": finalize,
+        }
+
+    @classmethod
+    def _build_decision_kernel(cls, meta, static):
+        st = dict(static)
+        K = st.get("_n_classes", 0)
+        classification = K > 0
+        Kt = 1 if (not classification or K <= 2) else K
+        D = int(st["max_depth"])
+
+        @jax.jit
+        def decision(params, X):
+            Xb = apply_bins(X, params["edges"])
+
+            def one_round(F, tr):
+                feat_r, thr_r, split_r, leaf_r = tr
+                return F + _stacked_tree_walk(
+                    Xb, feat_r, thr_r, split_r, leaf_r, D
+                ), None
+
+            n = Xb.shape[0]
+            F0 = jnp.broadcast_to(
+                params["baseline"][None, :], (n, Kt)
+            ).astype(jnp.float32)
+            # rounds past n_iter hold all-zero trees (no splits, zero
+            # leaves), so scanning the full static T is exact
+            F, _ = lax.scan(
+                one_round, F0,
+                (params["feat"], params["thr"], params["is_split"],
+                 params["leaf"]),
+            )
+            return F[:, 0] if Kt == 1 else F
+
+        return decision
+
+    # ---- fitted surface ---------------------------------------------------
+    def fit(self, X, y, sample_weight=None):
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            raise TypeError(
+                f"{type(self).__name__} has no streamed (out-of-core) "
+                "fit driver yet; materialise the ChunkedDataset "
+                "(dataset.materialize()) or fit on a resident array"
+            )
+        if y is None:
+            raise TypeError(f"{type(self).__name__}.fit requires y")
+        data, meta = self._prep_fit_data(X, y, sample_weight)
+        static = _freeze(self._static_config(meta))
+        hyper = {k: jnp.asarray(hyper_float(getattr(self, k)))
+                 for k in self._hyper_names}
+        kernel = get_kernel(type(self), "fit", meta, static)
+        params = kernel(data["X"], data["y"], data["sw"], hyper,
+                        {"edges": jnp.asarray(meta["edges"])})
+        self._params = jax.device_get(params)
+        self._meta = meta
+        self.n_features_in_ = meta["n_features"]
+        if "classes" in meta:
+            self.classes_ = meta["classes"]
+        self.n_iter_ = int(self._params["n_iter"])
+        return self
+
+    def _check_fitted(self):
+        if not hasattr(self, "_params"):
+            raise AttributeError(
+                f"This {type(self).__name__} instance is not fitted yet."
+            )
+
+    def decision_function(self, X):
+        self._check_fitted()
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            raise TypeError(
+                "decision_function does not take a ChunkedDataset; use "
+                "skdist_tpu.distribute.batch_predict(model, dataset)"
+            )
+        X = as_dense_f32(X)
+        static = _freeze(self._static_config(self._meta))
+        kernel = get_kernel(type(self), "decision", self._meta, static)
+        return np.asarray(kernel(_to_jnp(self._params), jnp.asarray(X)))
+
+
+class DistHistGradientBoostingClassifier(_BaseGBDT, ClassifierMixin):
+    """Histogram gradient-boosting classifier (log loss).
+
+    Binary fits grow one tree per round on the sigmoid gradient/
+    hessian; K-class fits grow K trees per round (one compiled program
+    — the class axis vmaps inside the round) on the softmax grad/hess,
+    sklearn/XGBoost's one-vs-all Newton boosting. ``decision_function``
+    returns raw logits ((n,) binary / (n, K) multiclass), so the
+    device scorers, ``DistGridSearchCV``'s fused CV kernel, and the
+    serving plane consume it exactly like the linear classifiers.
+    """
+
+    def __init__(self, loss="log_loss", learning_rate=0.1, max_iter=100,
+                 max_depth=5, max_bins=64, l2_regularization=0.0,
+                 min_samples_leaf=20, early_stopping="auto",
+                 validation_fraction=0.1, n_iter_no_change=10, tol=1e-7,
+                 random_state=0, hist_mode="auto"):
+        super().__init__(
+            loss=loss, learning_rate=learning_rate, max_iter=max_iter,
+            max_depth=max_depth, max_bins=max_bins,
+            l2_regularization=l2_regularization,
+            min_samples_leaf=min_samples_leaf,
+            early_stopping=early_stopping,
+            validation_fraction=validation_fraction,
+            n_iter_no_change=n_iter_no_change, tol=tol,
+            random_state=random_state, hist_mode=hist_mode,
+        )
+        if loss != "log_loss":
+            raise ValueError(
+                "DistHistGradientBoostingClassifier supports "
+                "loss='log_loss'"
+            )
+
+    @classmethod
+    def _build_proba_kernel(cls, meta, static):
+        decision = cls._build_decision_kernel(meta, static)
+        binary = meta.get("n_classes", 2) <= 2
+
+        @jax.jit
+        def proba(params, X):
+            z = decision(params, X)
+            if binary:
+                p1 = jax.nn.sigmoid(z)
+                return jnp.stack([1.0 - p1, p1], axis=1)
+            return jax.nn.softmax(z, axis=1)
+
+        return proba
+
+    def predict_proba(self, X):
+        self._check_fitted()
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            from ..distribute.predict import batch_predict
+
+            return batch_predict(self, X, method="predict_proba")
+        X = as_dense_f32(X)
+        static = _freeze(self._static_config(self._meta))
+        kernel = get_kernel(type(self), "proba", self._meta, static)
+        return np.asarray(kernel(_to_jnp(self._params), jnp.asarray(X)))
+
+    def predict_log_proba(self, X):
+        return np.log(np.clip(self.predict_proba(X), 1e-15, None))
+
+    def predict(self, X):
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            from ..distribute.predict import batch_predict
+
+            return batch_predict(self, X, method="predict")
+        scores = self.decision_function(X)
+        if scores.ndim == 1:
+            idx = (scores > 0).astype(np.int64)
+        else:
+            idx = np.argmax(scores, axis=1)
+        return self.classes_[idx]
+
+
+class DistHistGradientBoostingRegressor(_BaseGBDT, RegressorMixin):
+    """Histogram gradient-boosting regressor (squared error): one tree
+    per round on the residuals (unit hessian), Newton leaves with the
+    traced ``l2_regularization``. ``decision_function``/``predict``
+    return raw predictions (n,), the shape the regression device
+    scorers (r2 / neg_mean_squared_error / ...) consume as ``kind
+    ='predict'`` — including as ASHA rung metrics."""
+
+    def __init__(self, loss="squared_error", learning_rate=0.1,
+                 max_iter=100, max_depth=5, max_bins=64,
+                 l2_regularization=0.0, min_samples_leaf=20,
+                 early_stopping="auto", validation_fraction=0.1,
+                 n_iter_no_change=10, tol=1e-7, random_state=0,
+                 hist_mode="auto"):
+        super().__init__(
+            loss=loss, learning_rate=learning_rate, max_iter=max_iter,
+            max_depth=max_depth, max_bins=max_bins,
+            l2_regularization=l2_regularization,
+            min_samples_leaf=min_samples_leaf,
+            early_stopping=early_stopping,
+            validation_fraction=validation_fraction,
+            n_iter_no_change=n_iter_no_change, tol=tol,
+            random_state=random_state, hist_mode=hist_mode,
+        )
+        if loss != "squared_error":
+            raise ValueError(
+                "DistHistGradientBoostingRegressor supports "
+                "loss='squared_error'"
+            )
+
+    def predict(self, X):
+        from ..data import is_chunked
+
+        if is_chunked(X):
+            from ..distribute.predict import batch_predict
+
+            return batch_predict(self, X, method="predict")
+        return self.decision_function(X)

@@ -288,11 +288,17 @@ def _annotate_stream_meta(meta, dataset):
 
 
 def kernel_mode_of(meta):
-    """The contraction variant a fit with this ``meta`` runs —
-    ``"dense"`` or ``"packed_<matvec mode>"``. The batched dispatch
-    sites stamp it into ``backend.last_round_stats["kernel_mode"]`` so
-    round observability (and the chip-leg bench captures) can attribute
-    walls to the kernel that actually ran."""
+    """The kernel variant a fit with this ``meta`` runs — ``"dense"``
+    or ``"packed_<matvec mode>"`` for the matvec families, or the
+    family tag a non-linear family stamps in ``meta["kernel_family"]``
+    (the GBDT histogram trees stamp ``"hist_tree"``). The batched
+    dispatch sites stamp it into
+    ``backend.last_round_stats["kernel_mode"]`` so round observability
+    (and the chip-leg bench captures) can attribute walls to the
+    kernel that actually ran."""
+    family = meta.get("kernel_family")
+    if family is not None:
+        return family
     if meta.get("x_format") == "packed":
         return "packed_" + meta.get("x_matvec", "gather")
     return "dense"

@@ -52,6 +52,7 @@ __all__ = [
     "ExtraTreeClassifier",
     "ExtraTreeRegressor",
     "build_tree_kernel",
+    "newton_channels",
     "tree_predict_kernel",
 ]
 
@@ -151,14 +152,27 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
                       min_samples_split, min_samples_leaf,
                       min_impurity_decrease, extra, classification,
                       hist_block=None, hist_mode="auto",
-                      fractional_weights=False):
+                      fractional_weights=False, newton=False):
     """Returns ``kernel(Xb, Ych, key) -> tree`` growing one tree.
 
     - ``Xb`` (n, d) int32 binned features
     - ``Ych`` (n, C) f32 per-sample channels:
       classification C = K + 1: [w·onehot(y) ..., count(w>0)]
       regression C = 4: [w, w·y, w·y², count(w>0)]
+      newton C = 3: [s·g, s·h, count(s>0)] (gradient/hessian channels)
     - ``key``: PRNG key (feature subsampling / random thresholds)
+
+    ``newton=True`` is the gradient-boosting objective (XGBoost /
+    LightGBM / sklearn-HistGradientBoosting lineage): the channels are
+    per-sample gradient/hessian sums of the boosting loss, split gain
+    is ``G_L²/(H_L+λ) + G_R²/(H_R+λ) − G_T²/(H_T+λ)`` and the leaf
+    value is the Newton step ``−G/(H+λ)``. λ (``l2_regularization``)
+    arrives as the kernel's optional 4th argument — a *traced* scalar,
+    so a CV grid over λ vmaps into one compiled program. The histogram
+    machinery (scatter / matmul / matmul_sib / pallas engines) is
+    channel-agnostic and runs unchanged; only the gain and the leaf
+    read differently. ``classification`` must be False (the tree
+    regresses the Newton step whatever the boosting loss is).
 
     ``tree`` = {feat (N,), thr (N,), is_split (N,), leaf (N, K_out)}
     with N = 2^(D+1)-1 heap-indexed nodes (children of i: 2i+1, 2i+2).
@@ -217,6 +231,12 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
     XLA runner-up (``resolve_hist_config(allow_native=False)``).
     """
     d, B, C, D = n_features, n_bins, channels, max_depth
+    if newton and classification:
+        raise ValueError(
+            "newton=True grows a regression tree on gradient/hessian "
+            "channels; pass classification=False (the boosting LOSS, "
+            "not the tree, decides classification semantics)"
+        )
     K = C - 1 if classification else 1  # leaf output width
     # allow_native=False: the host C engine (models/native_forest.py) is
     # selected at the FOREST level (forest.py routes around the XLA
@@ -236,16 +256,26 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
             f"tiling); got n_bins={B}"
         )
 
-    def node_scores(hist_cum):
+    def node_scores(hist_cum, lam=None):
         """hist_cum (d, nl, B, C) cumulative over bins → per-(f, node,
         threshold) gain proxies + counts. Returns (gain, cnt_l, cnt_r,
-        node_cnt, node_stats)."""
+        node_totals) with node_totals (d, nl, C). ``lam`` is the
+        traced Newton λ (only consumed by the newton objective)."""
         tot = hist_cum[:, :, -1, :]  # (d, nl, C)
         L = hist_cum  # left stats for threshold t = bins <= t
         R = tot[:, :, None, :] - L
         cnt_l = L[..., -1]
         cnt_r = R[..., -1]
-        if classification:
+        if newton:
+            g_l, h_l = L[..., 0], L[..., 1]
+            g_r, h_r = R[..., 0], R[..., 1]
+            g_t, h_t = tot[..., 0], tot[..., 1]
+            gain = (
+                g_l**2 / jnp.maximum(h_l + lam, 1e-12)
+                + g_r**2 / jnp.maximum(h_r + lam, 1e-12)
+                - (g_t**2 / jnp.maximum(h_t + lam, 1e-12))[:, :, None]
+            )
+        elif classification:
             wl = jnp.sum(L[..., :K], axis=-1)
             wr = jnp.sum(R[..., :K], axis=-1)
             sl = jnp.sum(L[..., :K] ** 2, axis=-1) / jnp.maximum(wl, 1e-12)
@@ -266,17 +296,23 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
             gain = sse_t[:, :, None] - (sse_l + sse_r)
         return gain, cnt_l, cnt_r, tot
 
-    def kernel(Xb, Ych, key):
+    def kernel(Xb, Ych, key, l2=None):
         n = Xb.shape[0]
         N = n_tree_nodes(D)
+        lam = (
+            (jnp.float32(0.0) if l2 is None else l2) if newton else None
+        )
         feat = jnp.full((N,), -1, jnp.int32)
         thr = jnp.zeros((N,), jnp.int32)
         is_split = jnp.zeros((N,), bool)
         gain_rec = jnp.zeros((N,), jnp.float32)
         node_id = jnp.zeros((n,), jnp.int32)
-        w_root = (
-            jnp.sum(Ych[:, :K]) if classification else jnp.sum(Ych[:, 0])
-        )
+        if newton:
+            w_root = jnp.sum(Ych[:, 1])  # total hessian mass
+        elif classification:
+            w_root = jnp.sum(Ych[:, :K])
+        else:
+            w_root = jnp.sum(Ych[:, 0])
 
         # level-invariant histogram inputs, hoisted out of the unrolled
         # level loop
@@ -381,7 +417,7 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
                 _, hist = lax.scan(hist_blk, None, XbT_blocks)
                 hist = hist.reshape(d_pad, nl, B, C)[:d]  # (d, nl, B, C)
             cum = jnp.cumsum(hist, axis=2)
-            gain, cnt_l, cnt_r, tot = node_scores(cum)
+            gain, cnt_l, cnt_r, tot = node_scores(cum, lam)
 
             # ---- validity
             node_cnt = tot[0, :, -1]  # (nl,) unweighted occupancy
@@ -444,7 +480,14 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
 
         # ---- leaf statistics over final assignments
         stats = jnp.zeros((N, C), Ych.dtype).at[node_id].add(Ych)
-        if classification:
+        if newton:
+            # Newton step per node: −G/(H+λ); empty nodes hold exact 0
+            # (their stats are all-zero), so unused heap slots — and
+            # unused boosting rounds' whole trees — contribute nothing
+            leaf = (
+                -stats[:, 0] / jnp.maximum(stats[:, 1] + lam, 1e-12)
+            )[:, None]
+        elif classification:
             wsum = jnp.sum(stats[:, :K], axis=1, keepdims=True)
             leaf = stats[:, :K] / jnp.maximum(wsum, 1e-12)
             leaf = jnp.where(wsum > 0, leaf, 1.0 / K)
@@ -504,6 +547,16 @@ def classification_channels(y_idx, sw, n_classes):
 def regression_channels(y, sw):
     cnt = (sw > 0).astype(jnp.float32)
     return jnp.stack([sw, sw * y, sw * y * y, cnt], axis=1)
+
+
+def newton_channels(g, h, sw):
+    """GBDT's generalization of the channel builders above: per-sample
+    gradient/hessian of the boosting loss, weighted by the (possibly
+    fold-masked) sample weights, plus the unweighted-occupancy channel
+    the min_samples rules read. Consumed with
+    ``build_tree_kernel(newton=True, channels=3)``."""
+    cnt = (sw > 0).astype(jnp.float32)
+    return jnp.stack([sw * g, sw * h, cnt], axis=1)
 
 
 def resolve_max_features(max_features, d):

@@ -22,13 +22,24 @@ low-precision STORAGE, full-precision ACCUMULATION —
   the compiled decision/proba program — the stored tier never leaves
   int8 in HBM, and accumulation is f32.
 
-Quantization applies to the **linear-family params contract** (a
-``"W"`` leaf of shape ``(p,)`` or ``(p, k)`` — what every servable
-linear model exposes); params trees without it (tree ensembles, whose
-"weights" are structural) refuse loudly at registration rather than
-silently changing split semantics. The intercept row rides the same
-per-channel scale as its column — measured error stays inside the
-registration parity gate, which is the authority either way.
+Quantization applies to two params contracts:
+
+- the **linear-family contract** — a ``"W"`` leaf of shape ``(p,)`` or
+  ``(p, k)``. int8 scales are per output channel; the intercept row
+  rides the same per-channel scale as its column.
+- the **boosted-tree contract** (``models/gbdt.py``) — a ``"leaf"``
+  value array of shape ``(T, Kt, N)``. Only the leaf VALUES quantize
+  (int8 scales per ``(tree, class)`` bank over the node axis); the
+  structural arrays (``feat``/``thr``/``is_split``) and the bin
+  ``edges`` pass through untouched — quantizing thresholds would
+  change split semantics, and they are int32/bool bytes anyway. The
+  leaf bank is the params tree's dominant f32 mass, so the tier still
+  shrinks the resident ensemble.
+
+Params trees matching neither contract refuse loudly at registration
+rather than silently changing model semantics. Measured error stays
+inside the registration parity gate, which is the authority either
+way.
 """
 
 import numpy as np
@@ -45,6 +56,10 @@ SERVE_DTYPES = ("float32", "bfloat16", "int8")
 
 #: key the int8 tier stores its per-channel scales under
 _SCALE_KEY = "w_scale"
+
+#: key the int8 tier stores the tree contract's per-(tree, class)
+#: leaf scales under
+_LEAF_SCALE_KEY = "leaf_scale"
 
 
 def _check_dtype(serve_dtype):
@@ -67,11 +82,23 @@ def quantize_params(params, serve_dtype):
     _check_dtype(serve_dtype)
     if serve_dtype == "float32":
         return params
+    if (isinstance(params, dict) and "W" not in params
+            and "leaf" in params and "baseline" in params
+            and np.asarray(params["leaf"]).ndim == 3):
+        # the GBDT contract specifically: a (T, Kt, N) leaf bank next
+        # to its baseline. Single decision trees / forests also carry
+        # a "leaf" array, but theirs is (N, K) class-probability rows
+        # — per-(tree, class) scaling over the last axis would scale
+        # over CLASSES and could flip near-tie argmax predictions, so
+        # they keep the loud float32-only refusal below
+        return _quantize_leaf(params, serve_dtype)
     if not isinstance(params, dict) or "W" not in params:
         raise ValueError(
             f"serve_dtype={serve_dtype!r} quantizes the linear-family "
-            "params contract (a 'W' coefficient leaf); this model's "
-            f"params have {sorted(params) if isinstance(params, dict) else type(params).__name__} "
+            "params contract (a 'W' coefficient leaf) or the "
+            "boosted-tree contract (a 'leaf' value array); this "
+            "model's params have "
+            f"{sorted(params) if isinstance(params, dict) else type(params).__name__} "
             "— only float32 serving is available for it"
         )
     W = np.asarray(params["W"], dtype=np.float32)
@@ -83,11 +110,39 @@ def quantize_params(params, serve_dtype):
         return out
     # int8: per-channel symmetric over the output axis (columns of a
     # (p, k) W; the single channel of a (p,) W)
-    amax = np.max(np.abs(W), axis=0)  # (k,) or scalar
+    out["W"], out[_SCALE_KEY] = _int8_symmetric(W, axis=0)
+    return out
+
+
+def _int8_symmetric(arr, axis, keepdims=False):
+    """The ONE int8 symmetric-quantization grid (both contracts route
+    here, so the zero-amax passthrough and clip range can never
+    drift): per-channel ``scale = max|x|/127`` over ``axis``,
+    ``q = clip(round(x/scale), ±127)``. Returns ``(q int8, scale
+    f32)``."""
+    amax = np.max(np.abs(arr), axis=axis, keepdims=keepdims)
     scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
-    q = np.clip(np.rint(W / scale), -127, 127).astype(np.int8)
-    out["W"] = q
-    out[_SCALE_KEY] = scale
+    q = np.clip(np.rint(arr / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _quantize_leaf(params, serve_dtype):
+    """The boosted-tree side of :func:`quantize_params`: leaf VALUES
+    only, per-(tree, class) int8 scales over the node axis (each
+    round's leaves share a magnitude — the learning-rate-scaled Newton
+    steps of one tree — so per-bank scaling keeps the relative error
+    per tree at the int8 grid, and all-zero unused rounds get the
+    scale-1 passthrough)."""
+    L = np.asarray(params["leaf"], dtype=np.float32)
+    out = dict(params)
+    if serve_dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        out["leaf"] = np.asarray(jnp.asarray(L).astype(jnp.bfloat16))
+        return out
+    out["leaf"], out[_LEAF_SCALE_KEY] = _int8_symmetric(
+        L, axis=-1, keepdims=True,  # scale shape (T, Kt, 1)
+    )
     return out
 
 
@@ -102,11 +157,12 @@ def dequantize_params(params, serve_dtype):
     import jax.numpy as jnp
 
     out = dict(params)
+    key = "W" if "W" in out else "leaf"
     if serve_dtype == "bfloat16":
-        out["W"] = jnp.asarray(params["W"]).astype(jnp.float32)
+        out[key] = jnp.asarray(params[key]).astype(jnp.float32)
         return out
-    scale = out.pop(_SCALE_KEY)
-    out["W"] = jnp.asarray(params["W"]).astype(jnp.float32) * scale
+    scale = out.pop(_SCALE_KEY if key == "W" else _LEAF_SCALE_KEY)
+    out[key] = jnp.asarray(params[key]).astype(jnp.float32) * scale
     return out
 
 

@@ -630,12 +630,22 @@ class LinearOperator:
     def weighted_gram_rhs(self, sw, T):
         """``(X̃ᵀSX̃, (SX̃)ᵀT)`` — the two solves of the ridge normal
         equations. Dense keeps the historical op order exactly; the
-        packed gram stays on the m² scatter in every mode (it has no
-        Pallas form yet), while the rhs rides the mode's rmatvec."""
+        packed gram runs the m² scatter in the gather/dense modes and
+        the on-chip Pallas rebuild-and-matmul form in ``mode='pallas'``
+        (``ops/pallas_sparse.packed_weighted_gram`` — the last packed
+        contraction with a Pallas kernel, interpret mode off-TPU),
+        while the rhs rides the mode's rmatvec."""
         if self.Xa is not None:
             Xw = self.Xa * sw[:, None]
             return self.Xa.T @ Xw, Xw.T @ T
-        G = packed_weighted_gram(self.pidx, self.pval, sw, self.p)
+        if self.pallas:
+            from .ops.pallas_sparse import (
+                packed_weighted_gram as pl_gram,
+            )
+
+            G = pl_gram(self.pidx, self.pval, sw, self.p)
+        else:
+            G = packed_weighted_gram(self.pidx, self.pval, sw, self.p)
         b = self.rmatvec(sw[:, None] * T)
         return G, b
 

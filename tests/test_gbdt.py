@@ -1,0 +1,582 @@
+"""
+Native histogram gradient-boosted trees
+(``DistHistGradientBoosting{Classifier,Regressor}``).
+
+Pins the PR's contracts:
+
+- sklearn ``HistGradientBoosting*`` parity fuzz (classifier +
+  regressor, sample_weight, early-stopping ``n_iter_`` behaviour);
+- the iteration-sliced fit (one boosting round per iteration) is
+  BITWISE identical to the fused kernel across slice sizes — the
+  convergence-compacted scheduler's contract;
+- search/ASHA parity: ``adaptive=None`` vs ``HalvingSpec(eta=inf)``
+  identical cv_results_ score columns; an eta<inf race engages, kills,
+  and records the ``rung_`` column; regression rung metrics resolve as
+  device kernels and incompatible metrics warn + fall back exhaustive;
+- pickle round-trip; registry/serving predict parity including the
+  quantized (bf16/int8) leaf-value tiers; 0 post-warmup compiles on a
+  repeated search; ``kernel_mode='hist_tree'`` stamped into
+  ``last_round_stats``; OvR rides the class axis.
+"""
+
+import pickle
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skdist_tpu.distribute.adaptive import HalvingSpec
+from skdist_tpu.distribute.search import DistGridSearchCV
+from skdist_tpu.models.gbdt import (
+    DistHistGradientBoostingClassifier,
+    DistHistGradientBoostingRegressor,
+)
+from skdist_tpu.models.linear import _freeze, hyper_float
+from skdist_tpu.parallel import compile_cache
+
+
+def _nontime_score_cols(cv):
+    return [
+        c for c in cv
+        if ("test_" in c or c.startswith("rank")) and "_time" not in c
+    ]
+
+
+def _clf(**kw):
+    kw.setdefault("max_iter", 16)
+    kw.setdefault("max_depth", 3)
+    kw.setdefault("early_stopping", False)
+    return DistHistGradientBoostingClassifier(**kw)
+
+
+def _reg(**kw):
+    kw.setdefault("max_iter", 16)
+    kw.setdefault("max_depth", 3)
+    kw.setdefault("early_stopping", False)
+    return DistHistGradientBoostingRegressor(**kw)
+
+
+_GRID = {
+    "learning_rate": [0.02, 0.05, 0.1, 0.3],
+    "l2_regularization": [0.0, 1.0],
+}  # 8 candidates x 3 folds = 24 tasks >= the compaction threshold
+
+
+# ---------------------------------------------------------------------------
+# estimator semantics vs sklearn
+# ---------------------------------------------------------------------------
+
+def test_regressor_sklearn_parity(reg_data):
+    from sklearn.ensemble import HistGradientBoostingRegressor
+
+    X, y = reg_data
+    ours = _reg(max_iter=40, min_samples_leaf=5).fit(X, y)
+    ref = HistGradientBoostingRegressor(
+        max_iter=40, max_depth=3, early_stopping=False,
+        min_samples_leaf=5,
+    ).fit(X, y)
+    assert ours.score(X, y) > ref.score(X, y) - 0.05
+    assert ours.n_iter_ == 40
+    assert ours.predict(X).shape == (len(y),)
+
+
+def test_classifier_sklearn_parity_binary(binary_data):
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    X, y = binary_data
+    ours = _clf(max_iter=30).fit(X, y)
+    ref = HistGradientBoostingClassifier(
+        max_iter=30, max_depth=3, early_stopping=False,
+    ).fit(X, y)
+    assert ours.score(X, y) > ref.score(X, y) - 0.02
+    z = ours.decision_function(X)
+    assert z.ndim == 1
+    proba = ours.predict_proba(X)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    # raw-logit sign maps to classes_[1] like every binary classifier
+    np.testing.assert_array_equal(
+        ours.predict(X), ours.classes_[(z > 0).astype(int)]
+    )
+
+
+def test_classifier_sklearn_parity_multiclass(clf_data):
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    X, y = clf_data
+    ours = _clf(max_iter=20).fit(X, y)
+    ref = HistGradientBoostingClassifier(
+        max_iter=20, max_depth=3, early_stopping=False,
+    ).fit(X, y)
+    assert ours.score(X, y) > ref.score(X, y) - 0.02
+    assert ours.decision_function(X).shape == (len(y), 3)
+    proba = ours.predict_proba(X)
+    assert proba.shape == (len(y), 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_sample_weight(binary_data):
+    X, y = binary_data
+    # upweighting one class must move predictions toward it
+    sw = np.where(y == 1, 25.0, 1.0).astype(np.float32)
+    plain = _clf(max_iter=10).fit(X, y)
+    weighted = _clf(max_iter=10).fit(X, y, sample_weight=sw)
+    assert (weighted.predict(X) == 1).sum() >= (plain.predict(X) == 1).sum()
+    # (n, 1) column weights flatten like the other families
+    col = _clf(max_iter=5).fit(X, y, sample_weight=sw.reshape(-1, 1))
+    np.testing.assert_array_equal(
+        col.predict(X),
+        _clf(max_iter=5).fit(X, y, sample_weight=sw).predict(X),
+    )
+
+
+def test_early_stopping_n_iter(clf_data):
+    X, y = clf_data
+    stopped = DistHistGradientBoostingClassifier(
+        max_iter=120, max_depth=3, early_stopping=True,
+        validation_fraction=0.2, n_iter_no_change=4, tol=1e-4,
+    ).fit(X, y)
+    assert stopped.n_iter_ < 120  # the done flag fired
+    assert stopped.score(X, y) > 0.9
+    full = _clf(max_iter=12, early_stopping=False).fit(X, y)
+    assert full.n_iter_ == 12
+    # validation_fraction=None monitors the train loss (sklearn rule)
+    trainmon = DistHistGradientBoostingClassifier(
+        max_iter=120, max_depth=3, early_stopping=True,
+        validation_fraction=None, n_iter_no_change=4, tol=1e-4,
+    ).fit(X, y)
+    assert trainmon.n_iter_ <= 120
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="loss"):
+        DistHistGradientBoostingClassifier(loss="exponential")
+    with pytest.raises(ValueError, match="loss"):
+        DistHistGradientBoostingRegressor(loss="absolute_error")
+    with pytest.raises(ValueError, match="max_bins"):
+        DistHistGradientBoostingRegressor(max_bins=1)
+    with pytest.raises(ValueError, match="early_stopping"):
+        DistHistGradientBoostingRegressor(early_stopping="yes")
+
+
+def test_set_params_revalidated_in_kernel_build(binary_data):
+    """set_params bypasses __init__ (the library-wide convention): a
+    typo'd loss must fail loudly at fit, not silently train log loss."""
+    X, y = binary_data
+    est = _clf().set_params(loss="exponential")
+    with pytest.raises(ValueError, match="log_loss"):
+        est.fit(X, y)
+    est = _reg().set_params(n_iter_no_change=0)
+    with pytest.raises(ValueError, match="n_iter_no_change"):
+        est.fit(X, np.zeros(len(y), np.float32))
+    # traced hypers keep sklearn's domains on the estimator surface
+    with pytest.raises(ValueError, match="learning_rate"):
+        _clf(learning_rate=-0.5)
+    with pytest.raises(ValueError, match="learning_rate"):
+        _clf().set_params(learning_rate=0.0).fit(X, y)
+    with pytest.raises(ValueError, match="l2_regularization"):
+        _clf().set_params(l2_regularization=-1.0).fit(X, y)
+    # early_stopping revalidates at static resolution (bool('bogus')
+    # must not silently coerce to True)
+    with pytest.raises(ValueError, match="early_stopping"):
+        _clf().set_params(early_stopping="bogus").fit(X, y)
+
+
+def test_newton_tree_leaf_values():
+    """The newton objective's leaf is the Newton step -G/(H+λ) of the
+    rows routed to it (unit check on a stump)."""
+    from skdist_tpu.models.tree import build_tree_kernel, newton_channels
+    from skdist_tpu.ops.binning import apply_bins, quantile_bin_edges
+    import jax
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    g = rng.normal(size=64).astype(np.float32)
+    h = rng.uniform(0.5, 2.0, 64).astype(np.float32)
+    sw = np.ones(64, np.float32)
+    edges = quantile_bin_edges(X, 16)
+    Xb = apply_bins(jnp.asarray(X), jnp.asarray(edges))
+    grow = build_tree_kernel(
+        n_features=3, n_bins=16, channels=3, max_depth=1, max_features=3,
+        min_samples_split=2, min_samples_leaf=1,
+        min_impurity_decrease=0.0, extra=False, classification=False,
+        hist_mode="scatter", newton=True,
+    )
+    lam = 0.7
+    tree = grow(Xb, newton_channels(jnp.asarray(g), jnp.asarray(h),
+                                    jnp.asarray(sw)),
+                jax.random.PRNGKey(0), jnp.float32(lam))
+    assert bool(tree["is_split"][0])
+    f, t = int(tree["feat"][0]), int(tree["thr"][0])
+    left = np.asarray(Xb)[:, f] <= t
+    for mask, node in ((left, 1), (~left, 2)):
+        G, H = g[mask].sum(), h[mask].sum()
+        np.testing.assert_allclose(
+            float(tree["leaf"][node, 0]), -G / (H + lam), rtol=1e-5,
+        )
+
+
+def test_newton_rejects_classification():
+    from skdist_tpu.models.tree import build_tree_kernel
+
+    with pytest.raises(ValueError, match="newton"):
+        build_tree_kernel(
+            n_features=3, n_bins=16, channels=3, max_depth=2,
+            max_features=3, min_samples_split=2, min_samples_leaf=1,
+            min_impurity_decrease=0.0, extra=False, classification=True,
+            newton=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# sliced (carry-chain) execution
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_slice", [1, 5, 7, 40])
+def test_sliced_fit_bitwise_equals_fused(binary_data, n_slice):
+    X, y = binary_data
+    est = DistHistGradientBoostingClassifier(
+        max_iter=18, max_depth=3, early_stopping=True,
+        validation_fraction=0.25, n_iter_no_change=3, tol=1e-4,
+    )
+    cls = type(est)
+    data, meta = est._prep_fit_data(X, y)
+    static = _freeze(est._static_config(meta))
+    hyper = {k: jnp.asarray(hyper_float(getattr(est, k)))
+             for k in cls._hyper_names}
+    aux = {"edges": jnp.asarray(meta["edges"])}
+    fused = cls._build_fit_kernel(meta, static)(
+        data["X"], data["y"], data["sw"], hyper, aux
+    )
+    ks = cls._build_fit_slice_kernels(meta, static, n_slice)
+    carry = ks["init"](data["X"], data["y"], data["sw"], hyper, aux)
+    for _ in range(-(-18 // n_slice)):  # enough steps to pass max_iter
+        carry = ks["step"](data["X"], data["y"], data["sw"], hyper,
+                           carry, aux)
+    assert bool(carry["done"])
+    sliced = ks["finalize"](data["X"], data["y"], data["sw"], hyper,
+                            carry, aux)
+    for k in fused:
+        np.testing.assert_array_equal(
+            np.asarray(fused[k]), np.asarray(sliced[k]), err_msg=k
+        )
+
+
+def test_live_carry_scoreable_mid_race(binary_data):
+    """score_params shapes a VALID model from a live carry at any slice
+    boundary — the ASHA rung contract."""
+    X, y = binary_data
+    est = _clf(max_iter=20)
+    cls = type(est)
+    data, meta = est._prep_fit_data(X, y)
+    static = _freeze(est._static_config(meta))
+    hyper = {k: jnp.asarray(hyper_float(getattr(est, k)))
+             for k in cls._hyper_names}
+    aux = {"edges": jnp.asarray(meta["edges"])}
+    ks = cls._build_fit_slice_kernels(meta, static, 4)
+    carry = ks["init"](data["X"], data["y"], data["sw"], hyper, aux)
+    params = ks["score_params"](data["X"], data["y"], data["sw"], hyper,
+                                carry, aux)
+    assert int(np.asarray(params["n_iter"])) == 4
+    dec = cls._build_decision_kernel(meta, static)
+    z = np.asarray(dec(params, jnp.asarray(X)))
+    acc = float(np.mean((z > 0).astype(int) == y))
+    assert acc > 0.7  # 4 rounds already beat chance by a wide margin
+
+
+# ---------------------------------------------------------------------------
+# search / ASHA
+# ---------------------------------------------------------------------------
+
+def test_search_adaptive_none_vs_eta_inf_identical(tpu_backend, clf_data):
+    X, y = clf_data
+    s1 = DistGridSearchCV(_clf(), _GRID, backend=tpu_backend, cv=3,
+                          refit=False).fit(X, y)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s2 = DistGridSearchCV(
+            _clf(), _GRID, backend=tpu_backend, cv=3, refit=False,
+            adaptive=HalvingSpec(eta=float("inf")),
+        ).fit(X, y)
+    for k in _nontime_score_cols(s1.cv_results_):
+        np.testing.assert_array_equal(
+            np.asarray(s1.cv_results_[k]), np.asarray(s2.cv_results_[k]),
+            err_msg=k,
+        )
+    assert np.all(np.asarray(s2.cv_results_["rung_"]) == -1)
+
+
+def test_search_batched_matches_host_scorer_path(tpu_backend, clf_data):
+    """The fused device CV kernel scores close to sklearn's accuracy
+    scorer on the host generic path (a callable scorer forces it).
+    NOT exact by design: the batched path quantile-bins the SHARED X
+    once at prep (fold selection is weight masks over one resident
+    tree), while the host path re-fits on row-sliced folds whose bin
+    edges come from the train slice alone — same estimator, slightly
+    different histograms. The bound is the documented smoke-gate
+    tolerance."""
+    from sklearn.metrics import accuracy_score, make_scorer
+
+    X, y = clf_data
+    grid = {"learning_rate": [0.05, 0.3]}
+    dev = DistGridSearchCV(_clf(max_iter=10), grid, backend=tpu_backend,
+                           cv=3, refit=False).fit(X, y)
+    host = DistGridSearchCV(
+        _clf(max_iter=10), grid, backend=tpu_backend, cv=3, refit=False,
+        scoring=make_scorer(accuracy_score),
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        dev.cv_results_["mean_test_score"],
+        host.cv_results_["mean_test_score"], atol=0.05,
+    )
+
+
+def test_asha_race_kills_and_records(tpu_backend):
+    """A quality-skewed GBDT grid under an eta=3 race: rungs kill the
+    degenerate candidates, the exhaustive winner survives, and the
+    observability stamps cover the batch.
+
+    Design note: the rung metric must be MAGNITUDE-sensitive for a
+    learning-rate race — accuracy's argmax is invariant to the uniform
+    leaf scaling a learning rate applies, so the race scores log loss
+    (scoring='neg_log_loss', metric='auto' follows it). The quality
+    skew comes from both axes: tiny learning rates barely move the
+    logits off the baseline, and an absurd l2_regularization zeroes
+    every Newton leaf."""
+    rng = np.random.RandomState(0)
+    n, d, k = 600, 12, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.argmax(X @ W + 1.5 * rng.normal(size=(n, k)), axis=1)
+    skewed = {
+        "learning_rate": [1e-4, 1e-3, 1e-2, 0.3],
+        "l2_regularization": [0.0, 1e12],
+    }
+    s_ex = DistGridSearchCV(_clf(max_iter=24), skewed,
+                            backend=tpu_backend, cv=3, refit=False,
+                            scoring="neg_log_loss").fit(X, y)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s_ad = DistGridSearchCV(
+            _clf(max_iter=24), skewed, backend=tpu_backend, cv=3,
+            refit=False, scoring="neg_log_loss",
+            adaptive=HalvingSpec(eta=3),
+        ).fit(X, y)
+    rung = np.asarray(s_ad.cv_results_["rung_"])
+    assert (rung >= 0).any()  # the race killed someone
+    assert rung[s_ad.best_index_] == -1  # never the winner
+    assert s_ad.best_params_ == s_ex.best_params_
+    stats = tpu_backend.last_round_stats
+    assert stats.get("kernel_mode") == "hist_tree"
+    assert stats.get("retired_rung", 0) > 0
+    # retirement-reason split covers the whole 8x3 task batch
+    assert stats["retired_rung"] + stats["retired_convergence"] == 24
+
+
+def test_regression_rung_metric_engages(tpu_backend, reg_data):
+    X, y = reg_data
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        s = DistGridSearchCV(
+            _reg(max_iter=24), _GRID, backend=tpu_backend, cv=3,
+            refit=False, scoring="neg_mean_squared_error",
+            adaptive=HalvingSpec(eta=2.0,
+                                 metric="neg_mean_squared_error"),
+        ).fit(X, y)
+    assert not any("could not engage" in str(x.message) for x in w)
+    assert "rung_" in s.cv_results_
+    assert np.isfinite(s.best_score_)
+
+
+def test_incompatible_rung_metric_warns_falls_back(tpu_backend, reg_data):
+    """A classification rung metric on a regressor must warn + run
+    exhaustive (the device_scorer_compatible task-kind guard), never
+    crash mid-dispatch."""
+    X, y = reg_data
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        s = DistGridSearchCV(
+            _reg(max_iter=24), _GRID, backend=tpu_backend, cv=3,
+            refit=False, scoring="r2",
+            adaptive=HalvingSpec(eta=2.0, metric="neg_log_loss"),
+        ).fit(X, y)
+    assert any("could not engage" in str(x.message) for x in w)
+    assert np.all(np.asarray(s.cv_results_["rung_"]) == -1)
+
+
+def test_regression_metric_on_classifier_takes_host_path(tpu_backend,
+                                                         binary_data):
+    """scoring='r2' on a classifier must score sklearn's way (r2 of
+    predicted LABELS) — the device 'predict' output is decision scores,
+    so the task-kind guard routes the whole search to the host path."""
+    from sklearn.metrics import r2_score
+
+    X, y = binary_data
+    s = DistGridSearchCV(
+        _clf(max_iter=8), {"learning_rate": [0.1, 0.3]},
+        backend=tpu_backend, cv=2, refit=False, scoring="r2",
+    ).fit(X, y)
+    est = _clf(max_iter=8, learning_rate=0.1)
+    from sklearn.model_selection import check_cv
+
+    cv = check_cv(2, y, classifier=True)
+    train, test = next(iter(cv.split(X, y)))
+    est.fit(X[train], y[train])
+    expect = r2_score(y[test], est.predict(X[test]))
+    np.testing.assert_allclose(
+        s.cv_results_["split0_test_score"][0], expect, atol=1e-6,
+    )
+
+
+def test_search_no_recompile_second_run(tpu_backend, clf_data):
+    X, y = clf_data
+
+    def run():
+        return DistGridSearchCV(
+            _clf(), _GRID, backend=tpu_backend, cv=3, refit=False,
+        ).fit(X, y)
+
+    run()
+    snap1 = compile_cache.last_stats()
+    run()
+    snap2 = compile_cache.last_stats()
+    assert snap2["aot_misses"] == snap1["aot_misses"]
+    assert snap2["jit_misses"] == snap1["jit_misses"]
+    assert snap2["aot_hits"] > snap1["aot_hits"]
+
+
+# ---------------------------------------------------------------------------
+# artifacts: pickle, predict plane, serving
+# ---------------------------------------------------------------------------
+
+def test_pickle_roundtrip(clf_data):
+    X, y = clf_data
+    est = _clf(max_iter=10).fit(X, y)
+    clone = pickle.loads(pickle.dumps(est))
+    np.testing.assert_array_equal(clone.predict(X), est.predict(X))
+    np.testing.assert_allclose(
+        clone.predict_proba(X), est.predict_proba(X), rtol=1e-6,
+    )
+    assert clone.n_iter_ == est.n_iter_
+
+
+def test_batch_predict_parity(tpu_backend, clf_data):
+    from skdist_tpu.distribute.predict import batch_predict
+
+    X, y = clf_data
+    est = _clf(max_iter=10).fit(X, y)
+    np.testing.assert_array_equal(
+        batch_predict(est, X, backend=tpu_backend), est.predict(X)
+    )
+    np.testing.assert_allclose(
+        batch_predict(est, X, method="predict_proba",
+                      backend=tpu_backend),
+        est.predict_proba(X), rtol=1e-6,
+    )
+
+
+def test_registry_serving_parity_and_quantized_tiers(tpu_backend,
+                                                     binary_data):
+    from skdist_tpu.serve import ModelRegistry, ServingEngine
+
+    X, y = binary_data
+    est = _clf(max_iter=20, max_depth=4).fit(X, y)
+    reg = ModelRegistry(backend=tpu_backend)
+    e32 = reg.register("gbdt", est, methods=("predict", "predict_proba"))
+    assert e32.device
+    e8 = reg.register("gbdt8", est, methods=("predict",),
+                      serve_dtype="int8")
+    ebf = reg.register("gbdtb", est, methods=("predict",),
+                       serve_dtype="bfloat16")
+    # the parity gate measured a real (small) deviation and passed it
+    assert e8.quant_error is not None and e8.quant_error < 5e-2
+    assert ebf.quant_error is not None and ebf.quant_error < 5e-2
+    # the quantized tier actually shrank the staged leaf bank
+    assert e8.params_nbytes < ebf.params_nbytes
+    eng = ServingEngine(registry=reg)
+    try:
+        ref = est.predict(X[:32])
+        np.testing.assert_array_equal(
+            eng.predict(X[:32], model="gbdt"), ref
+        )
+        agree = np.mean(eng.predict(X[:32], model="gbdt8") == ref)
+        assert agree >= 0.95
+    finally:
+        eng.close()
+
+
+def test_quantize_leaf_contract_units():
+    from skdist_tpu.serve.quantize import (
+        dequantize_params, quantize_params, quantized_nbytes,
+    )
+
+    rng = np.random.RandomState(0)
+    params = {
+        "leaf": rng.normal(scale=0.3, size=(6, 2, 15)).astype(np.float32),
+        "feat": rng.randint(0, 4, (6, 2, 15)).astype(np.int32),
+        "baseline": np.zeros(2, np.float32),
+    }
+    params["leaf"][5] = 0.0  # an unused round: all-zero bank
+    q8 = quantize_params(params, "int8")
+    assert q8["leaf"].dtype == np.int8
+    assert q8["leaf_scale"].shape == (6, 2, 1)
+    back = np.asarray(dequantize_params(q8, "int8")["leaf"])
+    err = np.abs(back - params["leaf"]).max()
+    assert err <= np.abs(params["leaf"]).max() / 127 + 1e-7
+    np.testing.assert_array_equal(back[5], 0.0)  # zero bank survives
+    np.testing.assert_array_equal(q8["feat"], params["feat"])
+    assert quantized_nbytes(q8) < quantized_nbytes(params)
+    qb = quantize_params(params, "bfloat16")
+    assert quantized_nbytes(qb) < quantized_nbytes(params)
+    # a tree with no leaf/W contract still refuses loudly
+    with pytest.raises(ValueError, match="float32 serving"):
+        quantize_params({"theta": np.ones(3, np.float32)}, "int8")
+    # a SINGLE decision tree's (N, K) class-probability leaves must
+    # keep the loud refusal too — per-(tree, class) scaling over its
+    # last axis would scale over CLASSES and could flip near-tie
+    # argmax predictions (review finding)
+    single_tree = {
+        "leaf": rng.rand(15, 3).astype(np.float32),
+        "feat": rng.randint(0, 4, 15).astype(np.int32),
+    }
+    with pytest.raises(ValueError, match="float32 serving"):
+        quantize_params(single_tree, "int8")
+
+
+def test_stream_scoring_task_kind_guard(tmp_path, binary_data):
+    """The streamed search has no host fallback: a task-kind-mismatched
+    metric must raise at resolve (a regression metric on a classifier
+    would silently score raw decision values; a classification metric
+    on a regressor would trace against a meta with no n_classes)."""
+    from skdist_tpu.data import ChunkedDataset
+    from skdist_tpu.models import LogisticRegression
+
+    X, y = binary_data
+    ds = ChunkedDataset.from_arrays(X, y=y, block_rows=64)
+    with pytest.raises(ValueError, match="must match the estimator"):
+        DistGridSearchCV(
+            LogisticRegression(max_iter=5), {"C": [0.1, 1.0]},
+            cv=2, refit=False, scoring="r2",
+        ).fit(ds)
+
+
+def test_ovr_rides_class_axis(tpu_backend, clf_data):
+    from skdist_tpu.distribute.multiclass import DistOneVsRestClassifier
+
+    X, y = clf_data
+    ovr = DistOneVsRestClassifier(
+        _clf(max_iter=12), backend=tpu_backend
+    ).fit(X, y)
+    assert float(np.mean(ovr.predict(X) == y)) > 0.85
+    assert tpu_backend.last_round_stats.get("kernel_mode") == "hist_tree"
+    assert ovr.predict_proba(X).shape == (len(y), 3)
+
+
+def test_chunked_dataset_raises_with_remedy(tmp_path, binary_data):
+    from skdist_tpu.data import ChunkedDataset
+
+    X, y = binary_data
+    ds = ChunkedDataset.from_arrays(X, y=y, block_rows=64)
+    with pytest.raises(TypeError, match="materialise"):
+        _clf().fit(ds, None)

@@ -345,6 +345,71 @@ def test_weighted_gram_chunked_matches_unchunked():
     np.testing.assert_array_equal(ci, fi)
 
 
+def test_pallas_weighted_gram_matches_xla():
+    """The Pallas gram (the LAST packed contraction to get an on-chip
+    form) reproduces the m²-scatter gram: float parity at small
+    blocks, bitwise on integer data, vmap-safe over a batched sw (the
+    ridge CV task axis)."""
+    rng = np.random.RandomState(11)
+    n, d, m = 90, 70, 6
+    idx = rng.randint(0, d, size=(n, m)).astype(np.int32)
+    val = rng.randn(n, m).astype(np.float32)
+    mask = rng.rand(n, m) < 0.3
+    idx[mask] = 0
+    val[mask] = 0.0
+    sw = rng.rand(n).astype(np.float32)
+    ref = np.asarray(sx.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(sw), d))
+    out = np.asarray(ps.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(val), jnp.asarray(sw), d,
+        S=8, DB=64))
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+    np.testing.assert_allclose(out, out.T, atol=1e-5)  # symmetric
+    # integer data: bitwise (exact f32 sums on both paths)
+    vi = rng.randint(-3, 4, size=(n, m)).astype(np.float32)
+    vi[mask] = 0.0
+    si = rng.randint(0, 3, size=n).astype(np.float32)
+    fi = np.asarray(sx.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(vi), jnp.asarray(si), d))
+    pi = np.asarray(ps.packed_weighted_gram(
+        jnp.asarray(idx), jnp.asarray(vi), si, d, S=8, DB=64))
+    np.testing.assert_array_equal(pi, fi)
+    # vmapped sw — the batched ridge CV shape
+    SW = rng.rand(3, n).astype(np.float32)
+    vm = np.asarray(jax.vmap(
+        lambda s: ps.packed_weighted_gram(
+            jnp.asarray(idx), jnp.asarray(val), s, d, S=8, DB=64)
+    )(jnp.asarray(SW)))
+    for i in range(3):
+        np.testing.assert_allclose(
+            vm[i],
+            np.asarray(sx.packed_weighted_gram(
+                jnp.asarray(idx), jnp.asarray(val),
+                jnp.asarray(SW[i]), d)),
+            atol=1e-4,
+        )
+
+
+def test_ridge_mode_pallas_routes_gram(monkeypatch):
+    """LinearOperator(mode='pallas') now routes the ridge normal
+    equations through the Pallas gram — coefficients land on the
+    gather path's to float tolerance."""
+    from skdist_tpu.models import Ridge
+
+    X, _ = _sparse_problem(seed=9, n=140, d=300, density=0.02)
+    rng = np.random.RandomState(4)
+    yr = np.asarray(
+        X @ rng.normal(size=X.shape[1]).astype(np.float32)
+    ) + 0.05 * rng.normal(size=X.shape[0]).astype(np.float32)
+    monkeypatch.setenv("SKDIST_SPARSE_MATVEC", "pallas")
+    m_pl = Ridge(alpha=1.0).fit(X, yr)
+    assert m_pl._meta.get("x_matvec") == "pallas"
+    monkeypatch.setenv("SKDIST_SPARSE_MATVEC", "gather")
+    m_ga = Ridge(alpha=1.0).fit(X, yr)
+    monkeypatch.delenv("SKDIST_SPARSE_MATVEC")
+    np.testing.assert_allclose(m_pl.coef_, m_ga.coef_, atol=1e-3)
+
+
 def test_weighted_gram_env_chunk_and_budget(monkeypatch):
     """The env override engages chunking, and the budget plumbing
     chunks automatically when the (n, m, m) tensor overshoots its
