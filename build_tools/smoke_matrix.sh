@@ -78,6 +78,14 @@
 #                             <= 0.02, per-task score parity vs the
 #                             sequential leg, kernel_mode stamped,
 #                             0 post-warmup compiles (GBDT fan-out PR).
+#   obs_smoke.py            — telemetry plane: tracing-off overhead
+#                             bound <= 1% and tracing-on <= 5% warm
+#                             wall on the compacted ASHA grid,
+#                             Perfetto-loadable trace with >= 1 span
+#                             per round + rung/retire events,
+#                             Prometheus exposition parses with
+#                             per-replica / per-name@version serving
+#                             labels (telemetry-plane PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
@@ -90,3 +98,4 @@ python build_tools/streaming_smoke.py
 python build_tools/elastic_smoke.py
 python build_tools/kernels_smoke.py
 python build_tools/gbdt_smoke.py
+python build_tools/obs_smoke.py

@@ -37,6 +37,11 @@ Public surface (mirrors sk-dist's component inventory):
   sklearn Cython / liblinear compute the reference leaned on
 - ``skdist_tpu.preprocessing`` / ``skdist_tpu.postprocessing``: pipeline
   transformers and ``SimpleVoter``
+- ``skdist_tpu.obs``: the unified telemetry plane — process-wide
+  metrics registry (the store behind ``last_round_stats``,
+  ``serve.stats()`` and the fault/compile counters), structured span
+  tracing with Perfetto export (``SKDIST_TRACE=1``), and
+  Prometheus/JSON exporters
 """
 
 __version__ = "0.1.0"

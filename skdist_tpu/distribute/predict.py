@@ -355,9 +355,11 @@ def _batch_predict_chunked(model, dataset, method, backend, plan):
         plan.block_kernel(), {"params": plan.params},
         cache_key=plan.cache_key(),
     )
-    stats = backend.last_round_stats = {
-        "mode": "streamed_predict", "rounds": 0, "retries": 0,
-    }
+    from ..obs import metrics as obs_metrics
+
+    stats = backend.last_round_stats = obs_metrics.new_round_stats(
+        "streamed_predict", tasks=int(dataset.n_blocks),
+    )
     sync = bool(getattr(backend, "sync_rounds", False))
 
     # blocks ride the TASK axis in groups of the mesh's task slots (a
@@ -433,6 +435,7 @@ def _batch_predict_chunked(model, dataset, method, backend, plan):
     finally:
         feeder.close()
     out = np.concatenate([outs[i] for i in range(n_blocks)], axis=0)
+    obs_metrics.publish_round_stats(stats)
     return plan.postprocess(out)
 
 

@@ -306,10 +306,18 @@ def kernel_mode_of(meta):
 
 def annotate_round_kernel_mode(backend, meta):
     """Stamp :func:`kernel_mode_of` onto the backend's most recent
-    round stats (no-op when the backend has none)."""
+    round stats (no-op when the backend has none), and bill the
+    registry's per-kernel-mode dispatch counter — the stamp happens
+    AFTER the dispatch published its RoundStats, so the registry leg
+    records it here."""
     stats = getattr(backend, "last_round_stats", None)
     if isinstance(stats, dict):
-        stats["kernel_mode"] = kernel_mode_of(meta)
+        mode = stats["kernel_mode"] = kernel_mode_of(meta)
+        from ..obs import metrics as obs_metrics
+
+        obs_metrics.counter("rounds.kernel_mode").inc(
+            1, kernel_mode=str(mode)
+        )
 
 
 def _linear_op(X, fit_intercept, meta, matmul_dtype=None):

@@ -47,6 +47,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import metrics as obs_metrics
 from ..parallel import faults
 from ..parallel.backend import BlockFeeder, _RetryState, _RoundFault
 
@@ -123,12 +124,10 @@ def _example_block(dataset, row_arrays, extra_scalars=()):
 
 
 def _stream_stats(backend, sync):
-    stats = backend.last_round_stats = {
-        "mode": "streamed",
-        "stream_mode": "serial" if sync else "pipelined",
-        "retries": 0,
-        "dispatch_s": 0.0,
-    }
+    stats = backend.last_round_stats = obs_metrics.new_round_stats(
+        "streamed",
+        stream_mode="serial" if sync else "pipelined",
+    )
     return stats
 
 
@@ -931,8 +930,14 @@ def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
         "sgd": _fit_sgd_stream,
         "gram": _fit_gram_stream,
     }[kind]
-    return driver(backend, est_cls, meta, static, dataset, row_arrays,
-                  task_args, derive, stats, sync, key_extra=key_extra)
+    stats["tasks"] = stats.get("tasks", 0) + _n_tasks(task_args)
+    out = driver(backend, est_cls, meta, static, dataset, row_arrays,
+                 task_args, derive, stats, sync, key_extra=key_extra)
+    # delta-publication (publish_round_stats): safe on a shared/
+    # re-published dict — the CV driver hands this same dict to
+    # stream_scores, whose own publish folds only the scoring pass
+    obs_metrics.publish_round_stats(stats)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -953,7 +958,11 @@ def stream_scores(backend, est_cls, meta, static, dataset, row_arrays,
 
     sync = _resolve_sync(backend, sync)
     if stats is None:
-        stats = backend.last_round_stats or {}
+        # continue the fit's dict when one exists (the CV driver's
+        # contract) — else a fresh schema-complete dict, NOT a bare {}
+        # (the feed/dispatch accounting below += into required keys)
+        stats = (backend.last_round_stats
+                 or obs_metrics.new_round_stats("streamed_scores"))
     decision_kernel = maybe_exact_matmuls(
         est_cls, est_cls._build_decision_kernel(meta, static)
     )
@@ -1010,6 +1019,7 @@ def stream_scores(backend, est_cls, meta, static, dataset, row_arrays,
     acc = _streamed_sum(plan, read, dataset.n_blocks,
                         lambda: state["tc"], stats, sync,
                         restart=restart)
+    obs_metrics.publish_round_stats(stats)  # delta of the scoring pass
     out = {}
     for key, parts in acc.items():
         prefix, name = key.split("_", 1)

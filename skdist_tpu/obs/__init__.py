@@ -1,0 +1,60 @@
+"""
+skdist_tpu.obs — the unified telemetry plane.
+
+Three parts, one store:
+
+- :mod:`obs.metrics` — a thread-safe process-wide registry of labeled
+  counters / gauges / histograms. Every subsystem's signals live here
+  (compile cache hit/miss/lower-time, fault retry/quarantine, elastic
+  shrinks/regrows, streaming byte accounting, serving request/latency
+  stats); the legacy surfaces (``faults.snapshot()``,
+  ``compile_cache.snapshot()``, ``backend.last_round_stats``,
+  ``ServingEngine.stats()``) are views over it.
+- :mod:`obs.trace` — structured nested spans (``round_dispatch``,
+  ``compile``, ``block_feed``, ``flush``, ``rung_eval``,
+  ``replica_failover``) in a bounded ring behind ``SKDIST_TRACE=1``,
+  exported as Perfetto-loadable Chrome trace-event JSON, with optional
+  ``jax.profiler.TraceAnnotation`` passthrough (``SKDIST_TRACE_JAX=1``)
+  for chip-side device-time attribution.
+- :mod:`obs.export` — Prometheus text exposition + JSON snapshot over
+  the registry, including the serving fleet's per-replica and
+  per-``name@version`` label dimensions.
+
+See docs/DESIGN.md "Telemetry plane".
+"""
+
+from . import export, metrics, trace  # noqa: F401
+from .metrics import (  # noqa: F401
+    ROUND_STATS_REQUIRED,
+    RoundStats,
+    compile_scope,
+    counter,
+    gauge,
+    histogram,
+    new_round_stats,
+    publish_round_stats,
+    registry,
+)
+from .trace import (  # noqa: F401
+    export_chrome_trace,
+    instant,
+    span,
+)
+
+__all__ = [
+    "metrics",
+    "trace",
+    "export",
+    "registry",
+    "counter",
+    "gauge",
+    "histogram",
+    "compile_scope",
+    "RoundStats",
+    "ROUND_STATS_REQUIRED",
+    "new_round_stats",
+    "publish_round_stats",
+    "span",
+    "instant",
+    "export_chrome_trace",
+]

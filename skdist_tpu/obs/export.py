@@ -1,0 +1,159 @@
+"""
+Exporters over the metrics registry: Prometheus text exposition and a
+JSON snapshot.
+
+The registry (``obs.metrics``) is the store; this module is the read
+side a scrape endpoint or a dump-to-disk debug path serves:
+
+- :func:`prometheus_text` — the Prometheus text exposition format
+  (version 0.0.4): one ``# TYPE`` header per family, one sample line
+  per label child, counters suffixed ``_total``, histograms expanded
+  to cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``. Metric
+  names are sanitized (``compile.kernel_hits`` →
+  ``skdist_compile_kernel_hits``) so the output parses under the
+  official exposition grammar.
+- :func:`json_snapshot` — the same content as nested plain dicts
+  (JSON-serializable), for the serving fleet's stats endpoints and the
+  bench/smoke capture files.
+- :func:`fleet_text` / :func:`fleet_snapshot` — the serving-fleet
+  views: the registry's serve.* families already carry ``replica`` and
+  ``model`` (``name@version``) label dimensions (recorded by
+  ``serve/stats.py``), so per-tenant dashboards are a label filter,
+  not a new collection path — the groundwork ROADMAP item 2's
+  per-tenant stats/breakers build on.
+"""
+
+import json
+import re
+
+from . import metrics as _metrics
+
+__all__ = [
+    "prometheus_text",
+    "json_snapshot",
+    "fleet_text",
+    "fleet_snapshot",
+]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_LABEL_ESC = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def _prom_name(name, prefix="skdist"):
+    name = _NAME_RE.sub("_", name)
+    return f"{prefix}_{name}" if prefix else name
+
+
+def _prom_labels(key, extra=()):
+    pairs = list(extra) + list(key)
+    if not pairs:
+        return ""
+    body = ",".join(
+        '{}="{}"'.format(
+            _NAME_RE.sub("_", k),
+            "".join(_LABEL_ESC.get(c, c) for c in str(v)),
+        )
+        for k, v in pairs
+    )
+    return "{" + body + "}"
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def prometheus_text(registry=None, prefix="skdist"):
+    """Render ``registry`` (default: the process registry) in the
+    Prometheus text exposition format. Returns one string ending in a
+    newline."""
+    reg = registry if registry is not None else _metrics.registry()
+    lines = []
+    for name, fam in sorted(reg.families().items()):
+        pname = _prom_name(name, prefix)
+        if fam.kind == "counter":
+            lines.append(f"# TYPE {pname}_total counter")
+            for key, v in sorted(fam.children().items()):
+                lines.append(
+                    f"{pname}_total{_prom_labels(key)} {_fmt(v)}"
+                )
+        elif fam.kind == "gauge":
+            lines.append(f"# TYPE {pname} gauge")
+            for key, v in sorted(fam.children().items()):
+                lines.append(f"{pname}{_prom_labels(key)} {_fmt(v)}")
+        elif fam.kind == "histogram":
+            lines.append(f"# TYPE {pname} histogram")
+            bounds = fam.buckets
+            for key, child in sorted(fam.children().items()):
+                cum = 0
+                for b, c in zip(bounds, child["counts"]):
+                    cum += c
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_prom_labels(key, [('le', _fmt(float(b)))])} "
+                        f"{cum}"
+                    )
+                lines.append(
+                    f"{pname}_bucket"
+                    f"{_prom_labels(key, [('le', '+Inf')])} "
+                    f"{child['count']}"
+                )
+                lines.append(
+                    f"{pname}_sum{_prom_labels(key)} "
+                    f"{_fmt(float(child['sum']))}"
+                )
+                lines.append(
+                    f"{pname}_count{_prom_labels(key)} {child['count']}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def json_snapshot(registry=None, path=None):
+    """The registry as nested plain dicts (JSON-serializable); written
+    to ``path`` when given."""
+    reg = registry if registry is not None else _metrics.registry()
+    snap = reg.snapshot()
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(snap, fh, indent=1, sort_keys=True)
+    return snap
+
+
+def _serve_only(reg):
+    out = {}
+    for name, fam in reg.families().items():
+        if name.startswith("serve.") or name.startswith("rounds."):
+            out[name] = fam
+    return out
+
+
+class _View:
+    """Minimal registry-shaped wrapper over a family subset."""
+
+    def __init__(self, fams):
+        self._fams = fams
+
+    def families(self):
+        return dict(self._fams)
+
+    def snapshot(self):
+        return _metrics.snapshot_families(self._fams)
+
+
+def fleet_text(registry=None, prefix="skdist"):
+    """Prometheus exposition restricted to the serving-fleet families
+    (``serve.*`` with their replica / ``name@version`` labels, plus the
+    ``rounds.*`` dispatch totals the replicas' flushes fold into)."""
+    reg = registry if registry is not None else _metrics.registry()
+    return prometheus_text(_View(_serve_only(reg)), prefix=prefix)
+
+
+def fleet_snapshot(registry=None, path=None):
+    """JSON counterpart of :func:`fleet_text`."""
+    reg = registry if registry is not None else _metrics.registry()
+    snap = _View(_serve_only(reg)).snapshot()
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(snap, fh, indent=1, sort_keys=True)
+    return snap

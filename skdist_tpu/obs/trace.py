@@ -1,0 +1,232 @@
+"""
+Structured span tracing: one timeline for every plane of the framework.
+
+Dapper-style nested spans (Sigelman et al., 2010) recorded into a
+bounded in-process ring and exported as Chrome trace-event JSON —
+loadable directly in Perfetto (``ui.perfetto.dev``) or
+``chrome://tracing`` — so a whole search's dispatch structure
+(``round_dispatch`` per device round, ``compile`` on every cache miss,
+``block_feed`` per streamed block, ``flush`` per serving micro-batch,
+``rung_eval`` per ASHA rung, ``replica_failover``/``replica_respawn``
+on fleet events) reads as one picture instead of five subsystems' log
+lines.
+
+**Cost model.** Tracing is OFF by default and the off path is
+allocation-free: ``span(name)`` returns a module-level no-op singleton
+(no object construction, no ring append, no clock read) — the
+``SKDIST_TRACE=0`` hot-path contract ``tests/test_obs.py`` pins with
+an allocation spy. ``SKDIST_TRACE=1`` turns recording on; each span
+costs two ``perf_counter`` reads and one ring append at exit.
+Instrumentation sites are per-ROUND / per-BLOCK / per-FLUSH — never
+per-task or per-row — so even traced overhead stays inside the
+obs-smoke's 5% gate.
+
+**Device-time attribution.** ``SKDIST_TRACE_JAX=1`` additionally
+enters a ``jax.profiler.TraceAnnotation`` for every span, so a
+chip-side profile capture (``jax.profiler.trace`` / XProf) attributes
+device time to framework phases — the capture prerequisite of ROADMAP
+item 5's chip legs. Off by default: the annotation has nonzero cost
+even with no profiler session active.
+
+**Bounding.** The ring holds the most recent ``SKDIST_TRACE_RING``
+events (default 65536, ~15 MB of dicts at export time); older events
+drop oldest-first, so a long-lived server can leave tracing on and
+export a bounded tail on demand.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "enabled",
+    "set_enabled",
+    "span",
+    "instant",
+    "events",
+    "clear",
+    "set_ring_size",
+    "export_chrome_trace",
+    "chrome_trace_events",
+]
+
+
+def _env_flag(name, default=False):
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw in ("1", "true", "yes", "on")
+
+
+#: module-level enabled flag — ONE attribute read on the hot path
+_ENABLED = _env_flag("SKDIST_TRACE")
+_JAX_ANNOTATE = _env_flag("SKDIST_TRACE_JAX")
+
+_RING_SIZE = int(os.environ.get("SKDIST_TRACE_RING", "") or 65536)
+#: (name, ph, t_start_s, dur_s, thread_id, args_or_None) tuples;
+#: deque.append is atomic under the GIL — no lock on the record path
+_RING = deque(maxlen=_RING_SIZE)
+
+#: perf_counter epoch the exported timestamps are relative to, so a
+#: trace's ts values start near 0 instead of at host-uptime microseconds
+_EPOCH = time.perf_counter()
+
+
+def enabled():
+    """Whether span recording is on (cached; see :func:`set_enabled`)."""
+    return _ENABLED
+
+
+def set_enabled(flag=None):
+    """Turn tracing on/off at runtime (tests, smokes, a server's admin
+    endpoint). ``None`` re-reads ``SKDIST_TRACE`` from the environment.
+    Returns the new state."""
+    global _ENABLED
+    _ENABLED = _env_flag("SKDIST_TRACE") if flag is None else bool(flag)
+    return _ENABLED
+
+
+def set_ring_size(n):
+    """Re-bound the event ring (drops current contents)."""
+    global _RING, _RING_SIZE
+    _RING_SIZE = max(1, int(n))
+    _RING = deque(maxlen=_RING_SIZE)
+
+
+def clear():
+    _RING.clear()
+
+
+def _annotation(name):
+    """A live jax.profiler.TraceAnnotation, or None when the passthrough
+    is off or jax is unavailable."""
+    if not _JAX_ANNOTATE:
+        return None
+    try:
+        from jax.profiler import TraceAnnotation
+
+        return TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+class _Span:
+    """One live span: records a complete ('X') event at exit. Nesting
+    needs no explicit depth bookkeeping — Perfetto derives it from the
+    containment of each thread's ts/dur intervals."""
+
+    __slots__ = ("name", "args", "t0", "_ann")
+
+    def __init__(self, name, args):
+        self.name = name
+        self.args = args
+        self.t0 = 0.0
+        self._ann = None
+
+    def __enter__(self):
+        ann = _annotation(self.name)
+        if ann is not None:
+            ann.__enter__()
+            self._ann = ann
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        _RING.append((
+            self.name, "X", self.t0, t1 - self.t0,
+            threading.get_ident(), self.args,
+        ))
+        return False
+
+
+class _NoopSpan:
+    """The disabled-path singleton: enter/exit do nothing and allocate
+    nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name, args=None):
+    """Context manager recording one nested span while tracing is on.
+
+    ``args`` (an optional dict) lands in the exported event's ``args``
+    — build it only when :func:`enabled` is true, or the allocation
+    defeats the off-path zero-cost contract (which is also why this is
+    a positional dict rather than ``**kwargs``: an empty kwargs dict
+    would be allocated per call even when disabled)."""
+    if not _ENABLED:
+        return _NOOP
+    return _Span(name, args)
+
+
+def instant(name, args=None):
+    """Record a zero-duration instant event ('i' phase — rendered as a
+    flag line in Perfetto): rung kills, lane retirements, elastic
+    shrinks, replica failovers."""
+    if not _ENABLED:
+        return
+    _RING.append((
+        name, "i", time.perf_counter(), 0.0,
+        threading.get_ident(), args,
+    ))
+
+
+def events():
+    """The ring's current events as raw tuples (oldest first)."""
+    return list(_RING)
+
+
+def chrome_trace_events():
+    """The ring rendered as Chrome trace-event dicts (the
+    ``traceEvents`` array): complete events carry ``ph="X"`` with
+    microsecond ``ts``/``dur``; instants carry ``ph="i"`` with thread
+    scope. Timestamps are relative to the module's import epoch."""
+    pid = os.getpid()
+    out = []
+    for name, ph, t0, dur, tid, args in list(_RING):
+        ev = {
+            "name": name,
+            "cat": "skdist",
+            "ph": ph,
+            "ts": (t0 - _EPOCH) * 1e6,
+            "pid": pid,
+            "tid": tid,
+        }
+        if ph == "X":
+            ev["dur"] = dur * 1e6
+        else:
+            ev["s"] = "t"
+        if args:
+            ev["args"] = dict(args)
+        out.append(ev)
+    return out
+
+
+def export_chrome_trace(path=None):
+    """Export the ring as a Chrome trace-event JSON object (and write
+    it to ``path`` when given). The object form (``{"traceEvents":
+    [...], "displayTimeUnit": "ms"}``) is what Perfetto's legacy JSON
+    importer and ``chrome://tracing`` both load."""
+    doc = {
+        "traceEvents": chrome_trace_events(),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "skdist_tpu.obs.trace"},
+    }
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+    return doc

@@ -33,6 +33,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from . import compile_cache, faults
+from ..obs import metrics as obs_metrics, trace as obs_trace
 
 
 def _env_flag(name):
@@ -662,7 +663,10 @@ class LocalBackend(TaskBackend):
         else:
             chunk = min(n_tasks, round_size or n_tasks)
         timings = [] if return_timings else None
-        stats = self.last_round_stats = {}
+        stats = self.last_round_stats = obs_metrics.new_round_stats(
+            tasks=int(n_tasks),
+            shared_bytes=int(self.last_shared_bytes or 0),
+        )
         import jax
 
         retry = _RetryState()
@@ -694,6 +698,7 @@ class LocalBackend(TaskBackend):
                 retry.admit(rf, offset)
         out = _concat_rounds(rounds_out)
         stats["retries"] = retry.total
+        obs_metrics.publish_round_stats(stats)
         return (out, timings) if return_timings else out
 
 
@@ -1194,7 +1199,10 @@ class TPUBackend(TaskBackend):
         # `partitions` by hand, automated; a new chunk size is a new
         # shape, so jax recompiles transparently.
         timings = [] if return_timings else None
-        stats = self.last_round_stats = {}
+        stats = self.last_round_stats = obs_metrics.new_round_stats(
+            tasks=int(n_tasks),
+            shared_bytes=int(self.last_shared_bytes or 0),
+        )
         retry = _RetryState()
         rounds_out = []
         offset = 0
@@ -1317,6 +1325,7 @@ class TPUBackend(TaskBackend):
                     faults.record("shared_replacements")
         out = _concat_rounds(rounds_out)
         stats["retries"] = retry.total
+        obs_metrics.publish_round_stats(stats)
         return (out, timings) if return_timings else out
 
 
@@ -1511,9 +1520,12 @@ class BlockFeeder:
 
     def _produce(self, i):
         t0 = time.perf_counter()
-        host = self.read(i)
-        dev = self.place(host)
-        nbytes = tree_nbytes(host)
+        with obs_trace.span("block_feed",
+                            {"block": int(i)}
+                            if obs_trace.enabled() else None):
+            host = self.read(i)
+            dev = self.place(host)
+            nbytes = tree_nbytes(host)
         return dev, nbytes, time.perf_counter() - t0
 
     def _account(self, nbytes, dt):
@@ -1892,7 +1904,8 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
         dev_out, keep, pad, inj_round = pending.pop(0)
         in_gather = True
         t_g = time.perf_counter() if stats is not None else None
-        out = _gather_host(dev_out)
+        with obs_trace.span("round_gather"):
+            out = _gather_host(dev_out)
         if stats is not None:
             stats["gather_wait_s"] += time.perf_counter() - t_g
         in_gather = False
@@ -1947,7 +1960,8 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
                 injector.round_dispatched() if injector is not None
                 else None
             )
-            dev_out = fn(shared_args, sl)
+            with obs_trace.span("round_dispatch"):
+                dev_out = fn(shared_args, sl)
             pending.append((dev_out, stop - start, pad, inj_round))
             if stats is not None:
                 stats["rounds"] += 1
@@ -2064,7 +2078,10 @@ def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
     it is bitwise identical (the slice loop is deterministic). When the
     budget is spent, the classic fallback kernel (which retries per
     round) is the last resort before failing loud."""
-    stats = backend.last_round_stats = {}
+    stats = backend.last_round_stats = obs_metrics.new_round_stats(
+        tasks=int(n_tasks),
+        shared_bytes=int(backend.last_shared_bytes or 0),
+    )
     t0 = time.perf_counter()
     retry = _RetryState()
     while True:
@@ -2080,6 +2097,7 @@ def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
                 rung=rung,
             )
             stats["retries"] = retry.total
+            obs_metrics.publish_round_stats(stats)
             break
         except Exception as exc:
             if isinstance(exc, (_RoundsExhausted, _RoundFault)):
@@ -2139,6 +2157,16 @@ def _dispatch_iterative(backend, plan, spec, task_args, shared_args,
                 # must not error-score lanes that will now finish — and
                 # the caller must learn no adaptive race happened
                 rung.deactivate()
+            # the abandoned compacted attempt still publishes what it
+            # accumulated (retries that forced this downgrade included)
+            # — the fallback's own dispatch publishes separately under
+            # its own path label. "rounds" is normally summed on clean
+            # slice-loop exit; fold the partial attempt's here.
+            stats["retries"] = retry.total
+            stats["rounds"] = int(sum(
+                stats.get("rounds_per_slice", []) or [0]
+            ))
+            obs_metrics.publish_round_stats(stats)
             return backend.batched_map(
                 spec.fallback, task_args, shared_args,
                 static_args=static_args, round_size=chunk,
@@ -2288,22 +2316,24 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
 
         for r in rounds:
             t_d = time.perf_counter()
-            if r.dev_task is None:
-                # task args never change between slices: place once per
-                # round and reuse (keep masks at OvR scale are
-                # chunk x n_samples — re-uploading them every slice
-                # would undo the flags-only-D2H economy on the H2D side)
-                r.dev_task = put(r.task_sl)
-            if r.dev_carry is None and r.host_carry is None:
-                dev = init_exec(r.dev_task)
-            else:
-                carry_in = (
-                    r.dev_carry if r.dev_carry is not None
-                    else put(r.host_carry)
-                )
-                r.host_carry = None
-                dev = step_exec({"task": r.dev_task,
-                                 "carry": carry_in})
+            with obs_trace.span("round_dispatch"):
+                if r.dev_task is None:
+                    # task args never change between slices: place once
+                    # per round and reuse (keep masks at OvR scale are
+                    # chunk x n_samples — re-uploading them every slice
+                    # would undo the flags-only-D2H economy on the H2D
+                    # side)
+                    r.dev_task = put(r.task_sl)
+                if r.dev_carry is None and r.host_carry is None:
+                    dev = init_exec(r.dev_task)
+                else:
+                    carry_in = (
+                        r.dev_carry if r.dev_carry is not None
+                        else put(r.host_carry)
+                    )
+                    r.host_carry = None
+                    dev = step_exec({"task": r.dev_task,
+                                     "carry": carry_in})
             r.dev_carry = dev
             try:
                 leaf = dev[spec.done_key]
@@ -2332,29 +2362,39 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
             # the host copy of the flags, so the retire/compaction
             # logic below treats a rung kill exactly like convergence.
             t_r = time.perf_counter()
-            scored = [
-                (r, score_exec({"task": r.dev_task, "carry": r.dev_carry}))
-                # an all-done round has no lane a rung could judge:
-                # scoring it would be a full discarded execution
-                for r in rounds if not r.done[:len(r.idx)].astype(bool).all()
-            ]
-            for _r, dev_s in scored:
-                _start_host_copy(dev_s)
-            live_ids = [np.empty(0, dtype=np.int64)]
-            live_scores = [np.empty(0)]
-            for r, dev_s in scored:
-                s = _flags_only_gather(dev_s)
-                keep = len(r.idx)
-                alive = ~r.done[:keep].astype(bool)
-                live_ids.append(r.idx[alive])
-                live_scores.append(np.asarray(s)[:keep][alive])
-            killed = rung.decide(
-                np.concatenate(live_ids), np.concatenate(live_scores),
-                stats["slices"],
-            )
-            if killed.size:
-                killed_mask[np.asarray(killed)] = True
-                apply_kills()
+            with obs_trace.span("rung_eval"):
+                scored = [
+                    (r, score_exec({"task": r.dev_task,
+                                    "carry": r.dev_carry}))
+                    # an all-done round has no lane a rung could judge:
+                    # scoring it would be a full discarded execution
+                    for r in rounds
+                    if not r.done[:len(r.idx)].astype(bool).all()
+                ]
+                for _r, dev_s in scored:
+                    _start_host_copy(dev_s)
+                live_ids = [np.empty(0, dtype=np.int64)]
+                live_scores = [np.empty(0)]
+                for r, dev_s in scored:
+                    s = _flags_only_gather(dev_s)
+                    keep = len(r.idx)
+                    alive = ~r.done[:keep].astype(bool)
+                    live_ids.append(r.idx[alive])
+                    live_scores.append(np.asarray(s)[:keep][alive])
+                killed = rung.decide(
+                    np.concatenate(live_ids),
+                    np.concatenate(live_scores),
+                    stats["slices"],
+                )
+                if killed.size:
+                    killed_mask[np.asarray(killed)] = True
+                    apply_kills()
+                    obs_trace.instant(
+                        "rung_kill",
+                        {"slice": int(stats["slices"]),
+                         "n": int(killed.size)}
+                        if obs_trace.enabled() else None,
+                    )
             stats["rung_wait_s"] += time.perf_counter() - t_r
 
         # retire rounds whose real lanes are all done (the padding
@@ -2375,9 +2415,13 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
                 still.append(r)
         # newly-finished lanes this slice (lanes already compacted out
         # of the rounds were counted when they finished)
-        stats["retired_per_slice"].append(
-            (n_tasks - n_alive) - n_done_prev
-        )
+        newly_retired = (n_tasks - n_alive) - n_done_prev
+        stats["retired_per_slice"].append(newly_retired)
+        if newly_retired and obs_trace.enabled():
+            obs_trace.instant(
+                "lane_retire",
+                {"slice": int(stats["slices"]), "n": int(newly_retired)},
+            )
         n_done_prev = n_tasks - n_alive
         if not still:
             break
@@ -2424,6 +2468,10 @@ def _run_compacted(plan, spec, task_args, n_tasks, chunk, stats,
         else:
             rounds = still
 
+    # the converged schema's "rounds": the slice loop's actual device
+    # dispatches (one per live round per slice; the finalize phase's
+    # rounds are tallied separately under stats["finalize"])
+    stats["rounds"] = int(sum(stats["rounds_per_slice"]))
     # retirement-reason accounting: every lane either converged (or hit
     # its iteration cap) or was killed by a rung — the quality/
     # convergence split the iterative stats dict exposes

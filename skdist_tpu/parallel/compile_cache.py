@@ -55,32 +55,36 @@ __all__ = [
     "aot_executable",
     "prewarm",
     "snapshot",
+    "scoped_misses",
     "last_stats",
     "reset_stats",
     "clear_memos",
 ]
+
+from ..obs import trace as _trace
 
 #: environment opt-in for the on-disk XLA compilation cache
 CACHE_DIR_ENV = "SKDIST_COMPILE_CACHE_DIR"
 
 _LOCK = threading.RLock()
 
-_STATS = {
-    "kernel_hits": 0,
-    "kernel_misses": 0,
-    "jit_hits": 0,
-    "jit_misses": 0,
-    "aot_hits": 0,
-    "aot_misses": 0,
+#: the counter kinds of the compile plane — billed into the telemetry
+#: registry (``skdist_tpu.obs.metrics``) as ``compile.events{kind=...}``
+#: plus a float ``compile.lower_time_s`` wall accumulator; snapshot()
+#: below is a VIEW over the registry, so the same numbers surface in
+#: the Prometheus/JSON exporters with no second bookkeeping path
+_COUNTER_KINDS = (
+    "kernel_hits",
+    "kernel_misses",
+    "jit_hits",
+    "jit_misses",
+    "aot_hits",
+    "aot_misses",
     # the on-disk EXPORT layer (serialized AOT programs; skips Python
     # tracing in warm-disk processes): file served / file written
-    "aot_export_hits": 0,
-    "aot_export_writes": 0,
-    # wall seconds spent building/lowering/compiling on misses (AOT
-    # lower+compile is measured directly; jit tracing happens lazily at
-    # first call, so jit misses record only closure construction)
-    "lower_time_s": 0.0,
-}
+    "aot_export_hits",
+    "aot_export_writes",
+)
 
 #: jit(vmap(kernel)) entries: (structural-or-identity key, static args,
 #: shardings) -> jitted fn
@@ -249,18 +253,68 @@ def structural_key(family, est_cls, *parts):
     return (family, est_cls) + tuple(parts)
 
 
+_FAMILIES = None
+
+
+def _families():
+    """(events counter, lower-time counter, scoped-miss counter) —
+    registry handles, built once."""
+    global _FAMILIES
+    if _FAMILIES is None:
+        from ..obs import metrics as obs_metrics
+
+        _FAMILIES = (
+            obs_metrics.counter(
+                "compile.events", help="compile-cache tier hits/misses"
+            ),
+            obs_metrics.counter(
+                "compile.lower_time_s",
+                help="wall seconds building/lowering/compiling on misses",
+            ),
+            obs_metrics.counter(
+                "compile.scoped_misses",
+                help="compile-shaped misses attributed to an active "
+                     "obs.metrics.compile_scope (serving engines)",
+            ),
+        )
+    return _FAMILIES
+
+
 def _record(counter, dt=0.0):
-    with _LOCK:
-        _STATS[counter] += 1
-        if dt:
-            _STATS["lower_time_s"] += dt
+    events, lower, scoped = _families()
+    events.inc(1, kind=counter)
+    if dt:
+        lower.inc(float(dt))
+    if counter.endswith("_misses"):
+        # scoped attribution: a serving engine's dispatch threads tag
+        # themselves (obs.metrics.compile_scope) so compiles THEY cause
+        # are separable from concurrent non-serving work — the basis of
+        # ServingStats.compiles_after_warmup's per-engine delta
+        from ..obs import metrics as obs_metrics
+
+        tag = obs_metrics.current_scope()
+        if tag is not None:
+            scoped.inc(1, scope=tag)
+
+
+def scoped_misses(tag):
+    """Compile-shaped misses billed while ``compile_scope(tag)`` was
+    active on the recording thread — the per-engine counter
+    ``ServingStats.compiles_after_warmup`` snapshots."""
+    return int(_families()[2].get(scope=str(tag)))
 
 
 def snapshot():
-    """Current counters (plus the disk cache dir), as a plain dict."""
-    with _LOCK:
-        out = dict(_STATS)
-    out["lower_time_s"] = round(out["lower_time_s"], 4)
+    """Current counters (plus the disk cache dir), as a plain dict —
+    a view over the telemetry registry's ``compile.*`` families. One
+    ``children()`` read per family (single lock acquisition), so the
+    event counters are mutually consistent within the snapshot."""
+    events, lower, _scoped = _families()
+    kids = events.children()
+    out = {
+        k: int(kids.get((("kind", k),), 0)) for k in _COUNTER_KINDS
+    }
+    out["lower_time_s"] = round(float(lower.get()), 4)
     out["disk_cache_dir"] = _DISK_DIR
     return out
 
@@ -273,10 +327,11 @@ def last_stats():
 
 
 def reset_stats():
-    """Zero the counters (memo contents and disk config are kept)."""
-    with _LOCK:
-        for k in _STATS:
-            _STATS[k] = 0.0 if k == "lower_time_s" else 0
+    """Zero the counters (memo contents and disk config are kept).
+    Scoped-miss attribution resets too — engines holding a warm mark
+    across a reset re-baseline on their next ``mark_warm``."""
+    for fam in _families():
+        fam.reset()
 
 
 def clear_memos():
@@ -301,7 +356,9 @@ def kernel_memo(key, build):
         _record("kernel_hits")
         return fn
     t0 = time.perf_counter()
-    fn = build()
+    with _trace.span("compile", {"tier": "kernel"}
+                     if _trace.enabled() else None):
+        fn = build()
     _record("kernel_misses", time.perf_counter() - t0)
     with _LOCK:
         return _KERNEL_MEMO.setdefault(key, fn)
@@ -350,15 +407,18 @@ def jit_vmapped(kernel, static_args, task_sharding=None,
         return jax.vmap(lambda t: kernel(shared, t, **static))(tasks)
 
     jit_kwargs = {"donate_argnums": (1,)} if donate_tasks else {}
-    if task_sharding is not None:
-        fn = jax.jit(
-            mapped,
-            in_shardings=(shared_shardings, task_sharding),
-            out_shardings=task_sharding,
-            **jit_kwargs,
-        )
-    else:
-        fn = jax.jit(mapped, **jit_kwargs)
+    with _trace.span("compile",
+                     {"tier": "jit", "key": repr(cache_key)[:120]}
+                     if _trace.enabled() else None):
+        if task_sharding is not None:
+            fn = jax.jit(
+                mapped,
+                in_shardings=(shared_shardings, task_sharding),
+                out_shardings=task_sharding,
+                **jit_kwargs,
+            )
+        else:
+            fn = jax.jit(mapped, **jit_kwargs)
     _record("jit_misses", time.perf_counter() - t0)
     with _LOCK:
         fn = _JIT_CACHE.setdefault(key, fn)
@@ -440,11 +500,14 @@ def aot_executable(fn, shared_args, task_like, n_chunk, shared_sig=None):
         warnings.filterwarnings(
             "ignore", message="Some donated buffers were not usable"
         )
-        comp = _exported_executable(
-            fn, shared_args, structs, shared_sig, task_sig, n_chunk
-        )
-        if comp is None:
-            comp = fn.lower(shared_args, structs).compile()
+        with _trace.span("compile",
+                         {"tier": "aot", "chunk": int(n_chunk)}
+                         if _trace.enabled() else None):
+            comp = _exported_executable(
+                fn, shared_args, structs, shared_sig, task_sig, n_chunk
+            )
+            if comp is None:
+                comp = fn.lower(shared_args, structs).compile()
     _record("aot_misses", time.perf_counter() - t0)
     with _LOCK:
         return _AOT_CACHE.setdefault(key, comp)

@@ -238,42 +238,67 @@ def _env_float(name, default):
 
 
 # ---------------------------------------------------------------------------
-# counters (test/smoke observability; process-global like compile_cache)
+# counters (test/smoke observability) — backed by the process-wide
+# telemetry registry (``skdist_tpu.obs.metrics``): one labeled family,
+# ``faults.events{kind=...}``. record/snapshot/reset_stats stay the
+# module's API; snapshot() is now a VIEW over the registry, so the same
+# numbers surface through the Prometheus/JSON exporters with no second
+# bookkeeping path.
 # ---------------------------------------------------------------------------
 
 _LOCK = threading.RLock()
-_STATS = {
-    "rounds_retried": 0,       # re-dispatches after a retryable fault
-    "retries_exhausted": 0,    # faults that ran out of policy budget
-    "shared_replacements": 0,  # shared-arg re-placements (preemption)
-    "lanes_quarantined": 0,    # tasks mapped to error_score by the guard
-    "lanes_rung_killed": 0,    # tasks retired early by an adaptive rung
-    "suppressed": 0,           # exceptions logged instead of swallowed
-    "checkpoint_hits": 0,      # tasks skipped because a journal had them
-    "watchdog_trips": 0,       # dispatches past their watchdog budget
-    "elastic_shrinks": 0,      # mesh rebuilt over survivors (preemption)
-    "elastic_regrows": 0,      # mesh re-grown after capacity returned
-    "elastic_tasks_salvaged": 0,  # tasks NOT re-run across an elastic
-                                  # shrink (journaled/gathered prefix)
-    "replica_failovers": 0,    # requests re-routed off a sick replica
-    "replica_respawns": 0,     # serving replicas drained + respawned
-}
+
+#: the taxonomy of fault-layer events; an unknown name in record() is
+#: a bug and raises (the old dict's KeyError contract)
+FAULT_COUNTERS = (
+    "rounds_retried",       # re-dispatches after a retryable fault
+    "retries_exhausted",    # faults that ran out of policy budget
+    "shared_replacements",  # shared-arg re-placements (preemption)
+    "lanes_quarantined",    # tasks mapped to error_score by the guard
+    "lanes_rung_killed",    # tasks retired early by an adaptive rung
+    "suppressed",           # exceptions logged instead of swallowed
+    "checkpoint_hits",      # tasks skipped because a journal had them
+    "watchdog_trips",       # dispatches past their watchdog budget
+    "elastic_shrinks",      # mesh rebuilt over survivors (preemption)
+    "elastic_regrows",      # mesh re-grown after capacity returned
+    "elastic_tasks_salvaged",  # tasks NOT re-run across an elastic
+                               # shrink (journaled/gathered prefix)
+    "replica_failovers",    # requests re-routed off a sick replica
+    "replica_respawns",     # serving replicas drained + respawned
+)
+
+
+_EVENTS = None
+
+
+def _events():
+    global _EVENTS
+    if _EVENTS is None:
+        from ..obs import metrics as obs_metrics
+
+        _EVENTS = obs_metrics.counter(
+            "faults.events", help="fault-layer events by kind"
+        )
+    return _EVENTS
 
 
 def record(counter, n=1):
-    with _LOCK:
-        _STATS[counter] += int(n)
+    if counter not in FAULT_COUNTERS:
+        raise KeyError(f"unknown fault counter {counter!r}")
+    _events().inc(int(n), kind=counter)
 
 
 def snapshot():
-    with _LOCK:
-        return dict(_STATS)
+    # one children() read = one lock acquisition, so the returned
+    # counters are mutually consistent (the old single-dict guarantee)
+    kids = _events().children()
+    return {
+        k: int(kids.get((("kind", k),), 0)) for k in FAULT_COUNTERS
+    }
 
 
 def reset_stats():
-    with _LOCK:
-        for k in _STATS:
-            _STATS[k] = 0
+    _events().reset()
 
 
 _SUPPRESSED_SEEN = set()

@@ -16,12 +16,14 @@ SparkContext. Here the cluster is a ``jax.sharding.Mesh``:
   ``create_hybrid_device_mesh`` produces.
 """
 
+import itertools
 import logging
 import time
 
 import numpy as np
 
 from . import faults
+from ..obs import metrics as obs_metrics, trace as obs_trace
 
 __all__ = [
     "initialize_cluster",
@@ -31,6 +33,9 @@ __all__ = [
 ]
 
 logger = logging.getLogger("skdist_tpu.mesh")
+
+#: per-process ordinal for elastic managers' registry gauge labels
+_MESH_IDS = itertools.count()
 
 
 def initialize_cluster(coordinator_address=None, num_processes=None,
@@ -219,6 +224,10 @@ class ElasticMeshManager:
         self.current_extent = self.full_extent
         #: shrink/regrow log: dicts with kind, lost, extents, wall time
         self.events = []
+        #: the `mesh` label of this manager's registry gauge — two
+        #: elastic backends in one process must not overwrite each
+        #: other's extent readings last-writer-wins
+        self._obs_id = f"mesh-{next(_MESH_IDS)}"
 
     # ------------------------------------------------------------------
     @property
@@ -285,6 +294,18 @@ class ElasticMeshManager:
         faults.record(
             "elastic_shrinks" if kind == "shrink" else "elastic_regrows"
         )
+        # the fleet timeline: an elastic resize is an instant on the
+        # trace next to the rounds it interrupts, and the mesh extent
+        # is a live gauge for the exporters
+        obs_trace.instant(
+            f"elastic_{kind}",
+            {"from": self.events[-1]["from_extent"], "to": extent}
+            if obs_trace.enabled() else None,
+        )
+        obs_metrics.gauge(
+            "mesh.task_extent",
+            help="current elastic task-axis extent per manager",
+        ).set(extent, mesh=self._obs_id)
         return mesh
 
     # ------------------------------------------------------------------

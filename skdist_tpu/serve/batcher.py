@@ -36,6 +36,8 @@ from collections import deque
 
 import numpy as np
 
+from ..obs import trace as obs_trace
+
 __all__ = [
     "ServingError",
     "Overloaded",
@@ -313,7 +315,13 @@ class MicroBatcher:
         # device, so the launch itself never exceeds the budget
         self._slots.acquire()
         try:
-            out = self._dispatch(X)
+            with obs_trace.span(
+                "flush",
+                {"name": self.name, "rows": int(live_rows),
+                 "bucket": int(bucket)}
+                if obs_trace.enabled() else None,
+            ):
+                out = self._dispatch(X)
         except Exception as exc:  # scatter the failure; loop survives
             self._slots.release()
             self._fail(live, exc)

@@ -52,6 +52,7 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
 from ..parallel import faults
 from .batcher import (
     CircuitOpen,
@@ -133,12 +134,17 @@ class ServingEngine:
         parity-gated against the f32 reference before publishing).
         The warm mark moves AFTER each registration's prewarm, so
         ``compiles_after_warmup`` always measures from the last model
-        onboarded."""
-        entry = self.registry.register(
-            name, model, methods=methods, version=version,
-            prewarm=prewarm, serve_dtype=serve_dtype,
-            quant_parity_bound=quant_parity_bound,
-        )
+        onboarded. Registration runs under this engine's compile
+        scope (``obs.metrics.compile_scope``) so the prewarm's
+        compiles — and any later steady-state compile this engine
+        causes — are attributable to it, not to whatever else the
+        process is compiling concurrently."""
+        with obs_metrics.compile_scope(self._stats.scope):
+            entry = self.registry.register(
+                name, model, methods=methods, version=version,
+                prewarm=prewarm, serve_dtype=serve_dtype,
+                quant_parity_bound=quant_parity_bound,
+            )
         if prewarm:
             self._stats.mark_warm()
         return entry
@@ -215,7 +221,9 @@ class ServingEngine:
             enq_t=enq_t,
         )
         serve_dtype = getattr(entry, "serve_dtype", "float32")
-        self._stats.record_submitted(serve_dtype=serve_dtype)
+        model_spec = entry.spec
+        self._stats.record_submitted(serve_dtype=serve_dtype,
+                                     model=model_spec)
         stats = self._stats
 
         def _done(fut):
@@ -223,7 +231,8 @@ class ServingEngine:
             # (fut.exception() would itself raise CancelledError)
             if not fut.cancelled() and fut.exception() is None:
                 stats.record_completed(time.monotonic() - enq_t,
-                                       serve_dtype=serve_dtype)
+                                       serve_dtype=serve_dtype,
+                                       model=model_spec)
 
         req.future.add_done_callback(_done)
         batcher.submit(req)
@@ -342,11 +351,27 @@ class ServingEngine:
         dropped — which also means the flush's in-flight slot frees
         early, so the budget briefly under-counts true device work.
         ``watchdog_s=None`` (the default) adds nothing to the hot path
-        beyond the breaker's per-flush lock."""
+        beyond the breaker's per-flush lock.
+
+        Every dispatch/finalize runs under this engine's compile
+        scope: a steady-state compile caused by a served shape bills
+        ``compile.scoped_misses{scope=<engine>}``, which is exactly
+        what ``compiles_after_warmup`` measures — including across the
+        watchdog's worker thread (the scope wraps ``fn`` itself, so it
+        travels with the work, not the calling thread)."""
         breaker = self._breaker
         watchdog_s = self.watchdog_s
+        scope_tag = self._stats.scope
+
+        def scoped(fn):
+            def run():
+                with obs_metrics.compile_scope(scope_tag):
+                    return fn()
+
+            return run
 
         def under_watchdog(fn):
+            fn = scoped(fn)
             if watchdog_s is None:
                 return fn()
             box = {}

@@ -59,6 +59,7 @@ import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 
+from ..obs import trace as obs_trace
 from ..parallel import faults
 from ..parallel.compile_cache import enable_disk_cache
 from .batcher import (
@@ -124,6 +125,8 @@ class ReplicaSet:
         self._replicas = [
             _Replica(i, engine_factory()) for i in range(int(n_replicas))
         ]
+        for r in self._replicas:
+            _bind_replica_label(r)
         #: rollout spec store: name -> [{model, methods, version}, ...]
         #: in publication order, versions as the fleet assigned them —
         #: a respawned replica re-registers EVERY published version
@@ -312,27 +315,40 @@ class ReplicaSet:
         with self._respawn_lock:
             if r.alive:  # a concurrent heal already did it
                 return r
-            try:
-                r.engine.close(drain=True, timeout=5.0)
-            except Exception as exc:
-                faults.log_suppressed("ReplicaSet._respawn.close", exc)
-            engine = self._factory()
-            with self._lock:
-                published = [
-                    (name, list(recs))
-                    for name, recs in self._published.items()
-                ]
-            for name, recs in published:
-                for rec in recs:
-                    engine.register(
-                        name, rec["model"], methods=rec["methods"],
-                        version=rec["version"],
-                        serve_dtype=rec.get("serve_dtype", "float32"),
+            with obs_trace.span(
+                "replica_respawn",
+                {"replica": int(r.index)}
+                if obs_trace.enabled() else None,
+            ):
+                try:
+                    r.engine.close(drain=True, timeout=5.0)
+                except Exception as exc:
+                    faults.log_suppressed(
+                        "ReplicaSet._respawn.close", exc
                     )
-            r.engine = engine
-            r.failures = 0
-            r.generation += 1
-            r.alive = True
+                engine = self._factory()
+                with self._lock:
+                    published = [
+                        (name, list(recs))
+                        for name, recs in self._published.items()
+                    ]
+                for name, recs in published:
+                    for rec in recs:
+                        engine.register(
+                            name, rec["model"], methods=rec["methods"],
+                            version=rec["version"],
+                            serve_dtype=rec.get("serve_dtype",
+                                                "float32"),
+                        )
+                r.engine = engine
+                r.failures = 0
+                r.generation += 1
+                # bind the replica label BEFORE re-entering rotation:
+                # once alive flips, a concurrent router thread can
+                # resolve bound stats handles, and handles built in the
+                # gap would permanently miss the replica dimension
+                _bind_replica_label(r)
+                r.alive = True
         faults.record("replica_respawns")
         self._event("respawn", r.index, generation=r.generation)
         return r
@@ -383,6 +399,19 @@ class ReplicaSet:
                 ent["engine"] = None
             per.append(ent)
         out["replicas"] = per
+        # fleet-level per-model (name@version) rollup: sum the
+        # replicas' by_model splits — the per-tenant view a router
+        # dashboard reads without walking every replica itself
+        by_model = {}
+        for ent in per:
+            eng = ent.get("engine") or {}
+            for spec, cell in (eng.get("by_model") or {}).items():
+                agg = by_model.setdefault(
+                    spec, {"requests": 0, "completed": 0}
+                )
+                agg["requests"] += cell.get("requests", 0)
+                agg["completed"] += cell.get("completed", 0)
+        out["by_model"] = by_model
         return out
 
     def replica(self, index):
@@ -448,6 +477,11 @@ class ReplicaSet:
                             DeadlineExceeded)):
             return False
         faults.record("replica_failovers")
+        obs_trace.instant(
+            "replica_failover",
+            {"replica": int(r.index), "error": type(exc).__name__}
+            if obs_trace.enabled() else None,
+        )
         respawn = False
         with self._lock:
             if isinstance(exc, Overloaded):
@@ -475,6 +509,16 @@ class ReplicaSet:
                 fault_kind=faults.classify(exc),
             )
         return True
+
+
+def _bind_replica_label(replica):
+    """Stamp the replica's fleet index onto its engine's stats so the
+    registry-side serving counters carry a ``replica`` label dimension
+    (tolerates factory-injected engines without ServingStats)."""
+    stats = getattr(replica.engine, "_stats", None)
+    bind = getattr(stats, "set_label", None)
+    if callable(bind):
+        bind(replica=str(replica.index))
 
 
 def _set_exc(future, exc):

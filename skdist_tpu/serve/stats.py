@@ -1,52 +1,78 @@
 """
 Serving metrics: the observability half of the online runtime.
 
-Everything the batcher and engine record lands here, thread-safe, and
-comes back out of :meth:`ServingStats.snapshot` as one plain dict —
-printed by ``benchmarks/bench_serving.py`` and asserted on by
-``build_tools/serving_smoke.py``:
+Everything the batcher and engine record lands in TWO places with one
+call: a per-engine rolling view (bounded latency rings, gauges — what
+:meth:`ServingStats.snapshot` returns, printed by
+``benchmarks/bench_serving.py`` and asserted on by
+``build_tools/serving_smoke.py``) and the process-wide telemetry
+registry (``skdist_tpu.obs.metrics``), where the same signals carry
+``engine`` / ``replica`` / ``model`` (``name@version``) /
+``serve_dtype`` label dimensions for the Prometheus/JSON exporters
+(``obs.export.fleet_text``) — the per-tenant groundwork of ROADMAP's
+multi-tenant serving item:
 
 - rolling request latency percentiles (p50/p95/p99) over a bounded
   ring, so a long-lived server's stats track current behaviour rather
-  than its cold start;
+  than its cold start — split by serve_dtype AND by ``name@version``;
 - queue depth (gauge, updated by the batcher on every enqueue/flush);
 - batch-fill ratio: rows actually served / bucket capacity dispatched
   — how much of each padded flush was real work;
 - bucket-hit histogram: which shape buckets traffic lands in (the
   input for re-tuning the bucket set);
-- ``compiles_after_warmup``: movement of the process-wide compile
-  counters (``parallel.compile_cache``) since :meth:`mark_warm` — the
-  steady-state invariant of an AOT-prewarmed server. The registry
-  prewarms every (model, bucket) program, marks warm, and from then on
-  this MUST stay 0: any compile in steady state is a shape that
-  escaped the bucket set. Process-global by construction — concurrent
-  non-serving work in the same process moves it too, which a server
-  process does not have.
+- ``compiles_after_warmup``: compile-shaped misses ATTRIBUTED TO THIS
+  ENGINE (``obs.metrics.compile_scope`` — the engine tags its
+  registration prewarm and every dispatch thread) since
+  :meth:`mark_warm`. The registry prewarms every (model, bucket)
+  program, marks warm, and from then on this MUST stay 0: any compile
+  in steady state is a shape that escaped the bucket set. Scoped, not
+  process-global: concurrent non-serving work in the same process —
+  and other replicas of a fleet respawning warm — moves the global
+  compile counters but NOT this engine's scope, so the
+  steady-state-0 gate cannot false-trip.
 """
 
+import itertools
 import threading
 from collections import deque
 
+from ..obs import metrics as obs_metrics
 from ..parallel import compile_cache
 
 __all__ = ["ServingStats"]
 
-#: compile_cache counters whose movement after warmup means "a request
-#: paid a compile": closure builds, jit traces, and AOT lower+compiles
-_COMPILE_COUNTERS = ("kernel_misses", "jit_misses", "aot_misses")
+#: engine-scope tags are process-unique ordinals; the scope string is
+#: also the ``engine`` label on the registry-side serving counters
+_SCOPE_IDS = itertools.count()
 
 
 class ServingStats:
     """Thread-safe rolling serving metrics (see module docstring)."""
 
-    def __init__(self, window=4096):
+    def __init__(self, window=4096, scope=None):
         self._lock = threading.Lock()
         self._lat = deque(maxlen=window)
         self._window = window
+        #: the compile-attribution tag (obs.metrics.compile_scope) and
+        #: the ``engine`` label of this engine's registry counters
+        self.scope = (
+            scope if scope is not None else f"serve-{next(_SCOPE_IDS)}"
+        )
+        #: extra registry labels (the ReplicaSet stamps replica=<index>)
+        self._labels = {}
+        #: (family, model, serve_dtype, extra) -> bound registry child:
+        #: the label resolution (dict build + sort) happens once per
+        #: distinct route, so the per-request registry leg is one lock +
+        #: one dict op per family — measured necessary: unbound label
+        #: resolution per request cost ~10% of serving throughput
+        self._bound = {}
         #: per-serve_dtype split: requests / completions / latency ring
         #: per precision tier, so a mixed f32+int8 deployment can
         #: attribute its latency (and its wins) to the right kernels
         self._by_dtype = {}
+        #: per-model (name@version) split: same shape as the dtype
+        #: split — the first rung of per-tenant stats
+        self._by_model = {}
         self._bucket_hits = {}
         self._rows_served = 0
         self._capacity_served = 0
@@ -58,34 +84,98 @@ class ServingStats:
         self._rejected_circuit = 0
         self._dispatch_errors = 0
         self._queue_depths = {}  # per-batcher gauges; snapshot sums
-        self._warm_snap = None
+        self._warm_scoped = None
+
+    # ------------------------------------------------------------------
+    # registry leg
+    # ------------------------------------------------------------------
+    def set_label(self, **labels):
+        """Attach registry label dimensions (e.g. ``replica="1"``) to
+        every subsequent record call. The ReplicaSet stamps each
+        engine's fleet index here so the exporters can split by
+        replica."""
+        with self._lock:
+            self._labels.update({k: str(v) for k, v in labels.items()})
+            self._bound.clear()  # bound handles baked the old labels
+
+    def _reg_labels(self, **extra):
+        labels = {"engine": self.scope}
+        labels.update(self._labels)
+        labels.update({k: v for k, v in extra.items() if v is not None})
+        return labels
+
+    def _bound_child(self, family, metric_kind="counter", **extra):
+        """Memoised bound registry handle for (family, extra labels)."""
+        key = (family,) + tuple(sorted(extra.items()))
+        b = self._bound.get(key)
+        if b is None:
+            if metric_kind == "histogram":
+                fam = obs_metrics.histogram(family)
+            elif metric_kind == "gauge":
+                fam = obs_metrics.gauge(family)
+            else:
+                fam = obs_metrics.counter(family)
+            b = fam.child(**self._reg_labels(**extra))
+            with self._lock:
+                b = self._bound.setdefault(key, b)
+        return b
 
     # ------------------------------------------------------------------
     # recording (batcher/engine side)
     # ------------------------------------------------------------------
-    def _dtype_cell(self, serve_dtype):
-        cell = self._by_dtype.get(serve_dtype)
+    def _cell(self, table, key):
+        cell = table.get(key)
         if cell is None:
-            cell = self._by_dtype[serve_dtype] = {
+            cell = table[key] = {
                 "requests": 0, "completed": 0,
                 "lat": deque(maxlen=max(256, self._window // 4)),
             }
         return cell
 
-    def record_submitted(self, serve_dtype=None):
+    def _route(self, model, serve_dtype):
+        """One dict hit on the request hot path: the (model, dtype)
+        route's three bound registry handles, resolved once."""
+        key = (model, serve_dtype)
+        r = self._bound.get(key)
+        if r is None:
+            r = (
+                self._bound_child("serve.requests", model=model,
+                                  serve_dtype=serve_dtype),
+                self._bound_child("serve.completed", model=model,
+                                  serve_dtype=serve_dtype),
+                self._bound_child("serve.latency_s",
+                                  metric_kind="histogram", model=model,
+                                  serve_dtype=serve_dtype),
+            )
+            with self._lock:
+                r = self._bound.setdefault(key, r)
+        return r
+
+    def record_submitted(self, serve_dtype=None, model=None):
         with self._lock:
             self._requests += 1
             if serve_dtype is not None:
-                self._dtype_cell(serve_dtype)["requests"] += 1
+                self._cell(self._by_dtype, serve_dtype)["requests"] += 1
+            if model is not None:
+                self._cell(self._by_model, model)["requests"] += 1
+        self._route(model, serve_dtype)[0].inc()
 
-    def record_completed(self, latency_s, serve_dtype=None):
+    def record_completed(self, latency_s, serve_dtype=None, model=None):
+        latency_s = float(latency_s)
         with self._lock:
             self._completed += 1
-            self._lat.append(float(latency_s))
+            self._lat.append(latency_s)
             if serve_dtype is not None:
-                cell = self._dtype_cell(serve_dtype)
+                cell = self._cell(self._by_dtype, serve_dtype)
                 cell["completed"] += 1
-                cell["lat"].append(float(latency_s))
+                cell["lat"].append(latency_s)
+            if model is not None:
+                cell = self._cell(self._by_model, model)
+                cell["completed"] += 1
+                cell["lat"].append(latency_s)
+        _req, comp, lat = self._route(model, serve_dtype)
+        comp.inc()
+        lat.observe(latency_s)
 
     def record_rejection(self, kind):
         with self._lock:
@@ -100,6 +190,7 @@ class ServingStats:
                 self._rejected_circuit += 1
             else:
                 self._dispatch_errors += 1
+        self._bound_child("serve.rejections", kind=str(kind)).inc()
 
     def record_flush(self, rows, bucket):
         with self._lock:
@@ -109,14 +200,23 @@ class ServingStats:
             self._bucket_hits[int(bucket)] = (
                 self._bucket_hits.get(int(bucket), 0) + 1
             )
+        self._bound_child("serve.flushes").inc()
+        self._bound_child("serve.rows_served").inc(int(rows))
+        self._bound_child("serve.capacity_served").inc(int(bucket))
 
     def set_queue_depth(self, depth, key=None):
         """Per-batcher gauge (``key`` = the batcher's name): a
         multi-model engine shares one stats object, and a single
         last-writer-wins gauge would report whichever batcher moved
         most recently instead of the engine total."""
+        # resolve the handle BEFORE the lock (its miss path takes the
+        # same lock), then set the gauge INSIDE it — computing the
+        # total under the lock but setting outside would let a stale
+        # total overwrite a newer one on an idle engine
+        g = self._bound_child("serve.queue_depth", metric_kind="gauge")
         with self._lock:
             self._queue_depths[key] = int(depth)
+            g.set(sum(self._queue_depths.values()))
 
     def total_queue_depth(self):
         """Sum of the per-batcher gauges — the engine's admission
@@ -125,24 +225,23 @@ class ServingStats:
             return sum(self._queue_depths.values())
 
     def mark_warm(self):
-        """Snapshot the compile counters; ``compiles_after_warmup``
-        counts movement from here on. Called by the registry after the
-        last prewarm compile."""
+        """Snapshot this engine's scoped compile-miss counter;
+        ``compiles_after_warmup`` counts movement from here on. Called
+        by the engine after the last prewarm compile."""
         with self._lock:
-            self._warm_snap = compile_cache.snapshot()
+            self._warm_scoped = compile_cache.scoped_misses(self.scope)
 
     # ------------------------------------------------------------------
     # reading
     # ------------------------------------------------------------------
     def compiles_after_warmup(self):
-        """Compile-shaped counter movement since :meth:`mark_warm`;
-        None before any warm mark."""
+        """Compile-shaped misses attributed to THIS engine's scope
+        since :meth:`mark_warm`; None before any warm mark."""
         with self._lock:
-            warm = self._warm_snap
+            warm = self._warm_scoped
         if warm is None:
             return None
-        now = compile_cache.snapshot()
-        return int(sum(now[k] - warm[k] for k in _COMPILE_COUNTERS))
+        return int(compile_cache.scoped_misses(self.scope) - warm)
 
     @staticmethod
     def _percentile(sorted_vals, q):
@@ -151,6 +250,19 @@ class ServingStats:
         idx = min(len(sorted_vals) - 1,
                   max(0, int(round(q * (len(sorted_vals) - 1)))))
         return sorted_vals[idx]
+
+    @classmethod
+    def _split_view(cls, table):
+        split = {}
+        for key, cell in sorted(table.items()):
+            ent = {"requests": cell["requests"],
+                   "completed": cell["completed"]}
+            lat = sorted(cell["lat"])
+            for name, q in (("p50_ms", 0.50), ("p99_ms", 0.99)):
+                v = cls._percentile(lat, q)
+                ent[name] = round(v * 1e3, 3) if v is not None else None
+            split[key] = ent
+        return split
 
     def snapshot(self):
         """Current metrics as a plain dict (latency in milliseconds)."""
@@ -173,26 +285,24 @@ class ServingStats:
                 "bucket_hits": dict(sorted(self._bucket_hits.items())),
             }
             by_dtype = {
-                dt: {
-                    "requests": cell["requests"],
-                    "completed": cell["completed"],
-                    "lat": sorted(cell["lat"]),
-                }
-                for dt, cell in self._by_dtype.items()
+                dt: {"requests": c["requests"],
+                     "completed": c["completed"],
+                     "lat": sorted(c["lat"])}
+                for dt, c in self._by_dtype.items()
+            }
+            by_model = {
+                m: {"requests": c["requests"],
+                    "completed": c["completed"],
+                    "lat": sorted(c["lat"])}
+                for m, c in self._by_model.items()
             }
         for name, q in (("p50_ms", 0.50), ("p95_ms", 0.95),
                         ("p99_ms", 0.99)):
             v = self._percentile(lat, q)
             out[name] = round(v * 1e3, 3) if v is not None else None
         if by_dtype:
-            split = {}
-            for dt, cell in sorted(by_dtype.items()):
-                ent = {"requests": cell["requests"],
-                       "completed": cell["completed"]}
-                for name, q in (("p50_ms", 0.50), ("p99_ms", 0.99)):
-                    v = self._percentile(cell["lat"], q)
-                    ent[name] = round(v * 1e3, 3) if v is not None else None
-                split[dt] = ent
-            out["by_serve_dtype"] = split
+            out["by_serve_dtype"] = self._split_view(by_dtype)
+        if by_model:
+            out["by_model"] = self._split_view(by_model)
         out["compiles_after_warmup"] = self.compiles_after_warmup()
         return out

@@ -1,0 +1,561 @@
+"""
+Unified telemetry plane tests (``skdist_tpu.obs``):
+
+- registry: thread-safety under concurrent labeled increments, family
+  kind stickiness, histogram percentile correctness vs numpy;
+- trace: span nesting/ordering, Chrome trace-event schema validity of
+  the export, ring-buffer bounding, and the SKDIST_TRACE=0 contract —
+  the disabled hot path records nothing and allocates nothing;
+- views: faults/compile_cache snapshot() read the registry, scoped
+  compile attribution separates one engine's misses from concurrent
+  work, and every dispatch path's ``last_round_stats`` carries the
+  converged RoundStats key set (regression-pinned per path).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from skdist_tpu.obs import export as obs_export
+from skdist_tpu.obs import metrics as obs_metrics
+from skdist_tpu.obs import trace as obs_trace
+from skdist_tpu.obs.metrics import (
+    ROUND_STATS_REQUIRED,
+    MetricsRegistry,
+    new_round_stats,
+)
+
+
+@pytest.fixture
+def tracing():
+    """Tracing ON with a fresh ring; restores the disabled default."""
+    obs_trace.clear()
+    prev = obs_trace.set_enabled(True)
+    yield
+    obs_trace.set_enabled(False)
+    obs_trace.clear()
+    assert prev is True  # set_enabled returned the NEW state
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_basics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x.count")
+        c.inc()
+        c.inc(4, model="m@1")
+        assert c.get() == 1
+        assert c.get(model="m@1") == 4
+        assert c.total() == 5
+        g = reg.gauge("x.depth")
+        g.set(7, q="a")
+        g.set(3, q="b")
+        assert g.get(q="a") == 7
+        g.inc(2, q="a")
+        assert g.get(q="a") == 9
+
+    def test_kind_stickiness(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_thread_safety_concurrent_increments(self):
+        """N threads x M increments over shared label children land
+        exactly N*M — the lost-update test a bare dict += fails."""
+        reg = MetricsRegistry()
+        c = reg.counter("t.events")
+        h = reg.histogram("t.lat", buckets=(0.5, 1.0))
+        n_threads, n_inc = 8, 2000
+
+        def worker(i):
+            for k in range(n_inc):
+                c.inc(1, kind="shared")
+                c.inc(1, kind=f"own-{i}")
+                h.observe(0.25)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.get(kind="shared") == n_threads * n_inc
+        for i in range(n_threads):
+            assert c.get(kind=f"own-{i}") == n_inc
+        count, total = h.get()
+        assert count == n_threads * n_inc
+        assert total == pytest.approx(0.25 * count)
+
+    def test_histogram_percentiles_match_numpy(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", window=8192)
+        rng = np.random.RandomState(7)
+        samples = rng.lognormal(-3, 1.2, size=3000)
+        for s in samples:
+            h.observe(float(s))
+        for q in (0, 10, 50, 90, 99, 100):
+            np.testing.assert_allclose(
+                h.percentile(q), np.percentile(samples, q), rtol=1e-12
+            )
+
+    def test_histogram_window_rolls(self):
+        """Percentiles read the bounded ring (recent behaviour), while
+        bucket counts/sum stay cumulative."""
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", window=100)
+        for _ in range(500):
+            h.observe(1.0)
+        for _ in range(100):
+            h.observe(5.0)
+        assert h.percentile(50) == 5.0  # ring holds only the tail
+        count, total = h.get()
+        assert count == 600 and total == pytest.approx(1000.0)
+
+    def test_histogram_bucket_semantics(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 0.5, 7.0):
+            h.observe(v)
+        child = h.children()[()]
+        assert child["counts"] == [1, 2, 1]  # <=0.1, <=1.0, +Inf
+
+    def test_reset_prefix(self):
+        reg = MetricsRegistry()
+        reg.counter("a.x").inc(3)
+        reg.counter("b.x").inc(5)
+        reg.reset("a.")
+        assert reg.counter("a.x").get() == 0
+        assert reg.counter("b.x").get() == 5
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+_PROM_SAMPLE = (
+    r'^[a-zA-Z_][a-zA-Z0-9_]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? -?[0-9.e+-]+(inf)?$'
+)
+
+
+def test_prometheus_exposition_parses():
+    import re
+
+    reg = MetricsRegistry()
+    reg.counter("compile.events").inc(3, kind="aot_misses")
+    reg.gauge("serve.queue_depth").set(2, engine="serve-0")
+    h = reg.histogram("serve.latency_s", buckets=(0.001, 0.01))
+    h.observe(0.002, model="m@1")
+    text = obs_export.prometheus_text(reg)
+    assert text.endswith("\n")
+    sample_re = re.compile(_PROM_SAMPLE)
+    n_samples = 0
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[3] in ("counter", "gauge", "histogram")
+            continue
+        assert sample_re.match(line), f"bad exposition line: {line!r}"
+        n_samples += 1
+    # counter sample + gauge sample + 3 buckets + sum + count
+    assert n_samples == 1 + 1 + 3 + 1 + 1
+    # histogram le buckets are cumulative and end at +Inf == count
+    assert 'le="+Inf"' in text
+
+
+def test_json_snapshot_roundtrips(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("a.b").inc(2, k="v")
+    path = tmp_path / "snap.json"
+    snap = obs_export.json_snapshot(reg, path=str(path))
+    loaded = json.loads(path.read_text())
+    assert loaded == snap
+    assert loaded["a.b"]["kind"] == "counter"
+    assert loaded["a.b"]["values"] == {"k=v": 2}
+
+
+# ---------------------------------------------------------------------------
+# trace
+# ---------------------------------------------------------------------------
+
+class TestTrace:
+    def test_span_nesting_and_ordering(self, tracing):
+        with obs_trace.span("outer"):
+            with obs_trace.span("inner_a"):
+                pass
+            with obs_trace.span("inner_b"):
+                pass
+        evs = {e[0]: e for e in obs_trace.events()}
+        assert set(evs) == {"outer", "inner_a", "inner_b"}
+        # children exit first (ring order), and each child's
+        # [start, start+dur] interval nests inside the parent's
+        names = [e[0] for e in obs_trace.events()]
+        assert names == ["inner_a", "inner_b", "outer"]
+        out_t0, out_dur = evs["outer"][2], evs["outer"][3]
+        for child in ("inner_a", "inner_b"):
+            t0, dur = evs[child][2], evs[child][3]
+            assert out_t0 <= t0
+            assert t0 + dur <= out_t0 + out_dur + 1e-9
+        a, b = evs["inner_a"], evs["inner_b"]
+        assert a[2] + a[3] <= b[2] + 1e-9  # a finished before b began
+
+    def test_chrome_trace_schema(self, tracing, tmp_path):
+        with obs_trace.span("round_dispatch", {"round": 0}):
+            pass
+        obs_trace.instant("lane_retire", {"n": 3})
+        path = tmp_path / "trace.json"
+        doc = obs_trace.export_chrome_trace(str(path))
+        loaded = json.loads(path.read_text())
+        assert loaded == doc
+        assert isinstance(doc["traceEvents"], list)
+        assert doc["displayTimeUnit"] in ("ms", "ns")
+        phases = set()
+        for ev in doc["traceEvents"]:
+            # required keys of the trace-event format
+            for key in ("name", "ph", "ts", "pid", "tid"):
+                assert key in ev, f"missing {key} in {ev}"
+            assert isinstance(ev["name"], str)
+            assert ev["ph"] in ("X", "i", "B", "E", "M")
+            assert isinstance(ev["ts"], (int, float))
+            phases.add(ev["ph"])
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+            if ev["ph"] == "i":
+                assert ev.get("s") in ("t", "p", "g")
+        assert phases == {"X", "i"}
+        by_name = {e["name"]: e for e in doc["traceEvents"]}
+        assert by_name["round_dispatch"]["args"] == {"round": 0}
+        assert by_name["lane_retire"]["args"] == {"n": 3}
+
+    def test_ring_bounding(self, tracing):
+        obs_trace.set_ring_size(16)
+        try:
+            for i in range(100):
+                with obs_trace.span("s"):
+                    pass
+            evs = obs_trace.events()
+            assert len(evs) == 16
+        finally:
+            obs_trace.set_ring_size(65536)
+
+    def test_disabled_records_nothing(self):
+        obs_trace.set_enabled(False)
+        obs_trace.clear()
+        with obs_trace.span("x", {"k": 1}):
+            pass
+        obs_trace.instant("y")
+        assert obs_trace.events() == []
+
+    def test_disabled_span_is_shared_noop(self):
+        """The off path hands back ONE module-level singleton — no
+        object construction per call."""
+        obs_trace.set_enabled(False)
+        a = obs_trace.span("a")
+        b = obs_trace.span("b", {"k": "v"})
+        assert a is b is obs_trace._NOOP
+
+    def test_disabled_hot_path_zero_allocation(self):
+        """SKDIST_TRACE=0 contract: a tight span loop neither touches
+        the ring (spy) nor grows the allocated-block count (alloc
+        spy) — the instrumented round loop must cost nothing when
+        tracing is off."""
+        import sys
+
+        obs_trace.set_enabled(False)
+        appended = []
+        real_ring = obs_trace._RING
+
+        class _SpyRing:
+            def append(self, ev):  # pragma: no cover - must not run
+                appended.append(ev)
+
+        obs_trace._RING = _SpyRing()
+        try:
+            def loop(n):
+                for _ in range(n):
+                    with obs_trace.span("hot"):
+                        pass
+                    obs_trace.instant("hot")
+
+            loop(64)  # warm up freelists/bytecode caches
+            import gc
+
+            gc.collect()
+            before = sys.getallocatedblocks()
+            loop(4096)
+            gc.collect()
+            delta = sys.getallocatedblocks() - before
+        finally:
+            obs_trace._RING = real_ring
+        assert appended == []
+        # allow a handful of blocks of interpreter noise, but nothing
+        # scaling with the 4096 iterations (enabled tracing would
+        # allocate >= 2 objects per iteration)
+        assert delta < 64, f"disabled span loop allocated {delta} blocks"
+
+    def test_set_enabled_env_reread(self, monkeypatch):
+        monkeypatch.setenv("SKDIST_TRACE", "1")
+        assert obs_trace.set_enabled(None) is True
+        monkeypatch.setenv("SKDIST_TRACE", "0")
+        assert obs_trace.set_enabled(None) is False
+
+
+# ---------------------------------------------------------------------------
+# views over the registry (faults / compile_cache / scoped attribution)
+# ---------------------------------------------------------------------------
+
+class TestRegistryViews:
+    def test_faults_snapshot_is_registry_view(self):
+        from skdist_tpu.parallel import faults
+
+        faults.reset_stats()
+        faults.record("rounds_retried", 2)
+        snap = faults.snapshot()
+        assert snap["rounds_retried"] == 2
+        assert set(snap) == set(faults.FAULT_COUNTERS)
+        assert obs_metrics.counter("faults.events").get(
+            kind="rounds_retried"
+        ) == 2
+        faults.reset_stats()
+        assert faults.snapshot()["rounds_retried"] == 0
+
+    def test_faults_unknown_counter_raises(self):
+        from skdist_tpu.parallel import faults
+
+        with pytest.raises(KeyError):
+            faults.record("not_a_counter")
+
+    def test_compile_snapshot_is_registry_view(self):
+        from skdist_tpu.parallel import compile_cache
+
+        before = compile_cache.snapshot()
+        compile_cache.kernel_memo(("obs-test", 1), lambda: object())
+        after = compile_cache.snapshot()
+        assert after["kernel_misses"] == before["kernel_misses"] + 1
+        compile_cache.kernel_memo(("obs-test", 1), lambda: object())
+        assert compile_cache.snapshot()["kernel_hits"] == \
+            after["kernel_hits"] + 1
+
+    def test_scoped_compile_attribution(self):
+        from skdist_tpu.parallel import compile_cache
+
+        base_a = compile_cache.scoped_misses("obs-eng-a")
+        base_b = compile_cache.scoped_misses("obs-eng-b")
+        with obs_metrics.compile_scope("obs-eng-a"):
+            compile_cache.kernel_memo(("obs-scope", 1), lambda: object())
+        # unscoped concurrent work moves the global counter only
+        compile_cache.kernel_memo(("obs-scope", 2), lambda: object())
+        assert compile_cache.scoped_misses("obs-eng-a") == base_a + 1
+        assert compile_cache.scoped_misses("obs-eng-b") == base_b
+        # hits never bill the scope
+        with obs_metrics.compile_scope("obs-eng-a"):
+            compile_cache.kernel_memo(("obs-scope", 1), lambda: object())
+        assert compile_cache.scoped_misses("obs-eng-a") == base_a + 1
+
+    def test_compile_scope_nests_and_restores(self):
+        assert obs_metrics.current_scope() is None
+        with obs_metrics.compile_scope("outer"):
+            assert obs_metrics.current_scope() == "outer"
+            with obs_metrics.compile_scope("inner"):
+                assert obs_metrics.current_scope() == "inner"
+            assert obs_metrics.current_scope() == "outer"
+        assert obs_metrics.current_scope() is None
+
+
+# ---------------------------------------------------------------------------
+# RoundStats: the converged last_round_stats schema, pinned per path
+# ---------------------------------------------------------------------------
+
+def _assert_round_schema(stats, mode=None):
+    assert isinstance(stats, dict)
+    missing = [k for k in ROUND_STATS_REQUIRED if k not in stats]
+    assert not missing, f"missing RoundStats keys: {missing}"
+    if mode is not None:
+        assert stats["mode"] == mode
+
+
+class TestRoundStatsSchema:
+    def test_new_round_stats_prefills(self):
+        st = new_round_stats("streamed", stream_mode="serial")
+        _assert_round_schema(st, "streamed")
+        assert st["kernel_mode"] is None
+        assert st["retired_rung"] == 0
+        assert st["stream_mode"] == "serial"
+
+    def test_classic_local_path(self):
+        from skdist_tpu.parallel import LocalBackend
+
+        bk = LocalBackend()
+        bk.batched_map(
+            lambda sh, t: {"y": t["x"] * sh["s"]},
+            {"x": np.arange(8, dtype=np.float32)},
+            {"s": np.float32(2)}, round_size=4,
+        )
+        _assert_round_schema(bk.last_round_stats)
+        assert bk.last_round_stats["mode"] in ("pipelined",
+                                               "synchronous")
+        assert bk.last_round_stats["tasks"] == 8
+        assert bk.last_round_stats["rounds"] == 2
+
+    def test_classic_mesh_path(self, tpu_backend):
+        tpu_backend.batched_map(
+            lambda sh, t: {"y": t["x"] + sh["s"]},
+            {"x": np.arange(16, dtype=np.float32)},
+            {"s": np.float32(1)},
+        )
+        _assert_round_schema(tpu_backend.last_round_stats)
+        assert tpu_backend.last_round_stats["tasks"] == 16
+        assert tpu_backend.last_round_stats["shared_bytes"] > 0
+
+    def test_compacted_path(self):
+        """A toy countdown carry drives the compacted slice loop."""
+        from skdist_tpu.parallel import (
+            IterativeKernelSpec,
+            LocalBackend,
+        )
+
+        def init(shared, task):
+            left = task["n"].astype(np.int32)
+            return {"left": left, "done": left <= 0}
+
+        def step(shared, task, carry):
+            left = carry["left"] - 1
+            return {"left": left, "done": left <= 0}
+
+        def fin(shared, task, carry):
+            return {"left": carry["left"]}
+
+        spec = IterativeKernelSpec(
+            init, step, fin, ("left",),
+            fallback=lambda sh, t: {
+                "left": np.zeros((), np.int32) * t["n"].astype(np.int32)
+            },
+        )
+        bk = LocalBackend()
+        tasks = {"n": np.arange(30, dtype=np.float32) % 4}
+        out = bk.batched_map_iterative(spec, tasks, {}, round_size=8)
+        assert (np.asarray(out["left"]) <= 0).all()
+        st = bk.last_round_stats
+        _assert_round_schema(st, "compacted")
+        assert st["tasks"] == 30
+        assert st["retired_convergence"] == 30
+        assert st["retired_rung"] == 0
+
+    def test_streamed_path(self):
+        from skdist_tpu.data import ChunkedDataset
+        from skdist_tpu.models import LogisticRegression
+        from skdist_tpu.models.streaming import stream_fit_estimator
+        from skdist_tpu.parallel import LocalBackend
+
+        rng = np.random.RandomState(0)
+        X = rng.normal(size=(256, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=64)
+        bk = LocalBackend()
+        stream_fit_estimator(
+            LogisticRegression(max_iter=15, engine="xla"), ds,
+            backend=bk,
+        )
+        st = bk.last_round_stats
+        _assert_round_schema(st, "streamed")
+        assert st["streamed_bytes"] > 0
+        assert st["tasks"] == 1
+
+    def test_publish_is_delta_idempotent(self):
+        """Re-publishing a RoundStats after further accumulation folds
+        only the delta (the streamed scoring pass extends the fit's
+        dict; the compacted fallback publishes before downgrading) —
+        and never double-counts the dispatch."""
+        from skdist_tpu.obs.metrics import publish_round_stats
+
+        st = new_round_stats("deltatest")
+        st["streamed_bytes"] = 100
+        sb = obs_metrics.counter("rounds.streamed_bytes")
+        disp = obs_metrics.counter("rounds.dispatches")
+        b0, d0 = sb.get(path="deltatest"), disp.get(path="deltatest")
+        publish_round_stats(st)
+        publish_round_stats(st)  # unchanged: no movement
+        assert sb.get(path="deltatest") == b0 + 100
+        st["streamed_bytes"] += 50
+        publish_round_stats(st)
+        assert sb.get(path="deltatest") == b0 + 150
+        assert disp.get(path="deltatest") == d0 + 1
+
+    def test_publish_folds_into_registry(self):
+        from skdist_tpu.parallel import LocalBackend
+
+        c = obs_metrics.counter("rounds.dispatches")
+        before = c.get(path="pipelined")
+        bk = LocalBackend()
+        bk.batched_map(
+            lambda sh, t: {"y": t["x"]},
+            {"x": np.arange(4, dtype=np.float32)}, {},
+        )
+        assert c.get(path="pipelined") == before + 1
+        rt = obs_metrics.counter("rounds.tasks")
+        assert rt.get(path="pipelined") >= 4
+
+
+# ---------------------------------------------------------------------------
+# serving split + fleet labels
+# ---------------------------------------------------------------------------
+
+class TestServingStatsView:
+    def test_by_model_split(self):
+        from skdist_tpu.serve.stats import ServingStats
+
+        st = ServingStats()
+        st.record_submitted(serve_dtype="float32", model="m@1")
+        st.record_completed(0.002, serve_dtype="float32", model="m@1")
+        st.record_submitted(serve_dtype="int8", model="n@2")
+        snap = st.snapshot()
+        assert snap["by_model"]["m@1"]["requests"] == 1
+        assert snap["by_model"]["m@1"]["completed"] == 1
+        assert snap["by_model"]["m@1"]["p50_ms"] == pytest.approx(
+            2.0, abs=0.5
+        )
+        assert snap["by_model"]["n@2"]["requests"] == 1
+        assert snap["by_serve_dtype"]["int8"]["requests"] == 1
+
+    def test_registry_leg_carries_labels(self):
+        from skdist_tpu.serve.stats import ServingStats
+
+        st = ServingStats()
+        st.set_label(replica="3")
+        st.record_submitted(model="m@1")
+        got = obs_metrics.counter("serve.requests").get(
+            engine=st.scope, replica="3", model="m@1"
+        )
+        assert got == 1
+
+    def test_scoped_warm_mark_ignores_other_work(self):
+        """A warm-marked engine's compiles_after_warmup stays 0 while
+        OTHER scopes (another engine, unscoped background work)
+        compile — the fleet-respawn false-trip regression."""
+        from skdist_tpu.parallel import compile_cache
+        from skdist_tpu.serve.stats import ServingStats
+
+        st = ServingStats()
+        with obs_metrics.compile_scope(st.scope):
+            compile_cache.kernel_memo(("warmtest", st.scope),
+                                      lambda: object())
+        st.mark_warm()
+        assert st.compiles_after_warmup() == 0
+        # background / other-engine compiles do not move it
+        compile_cache.kernel_memo(("warmtest", "bg"), lambda: object())
+        other = ServingStats()
+        with obs_metrics.compile_scope(other.scope):
+            compile_cache.kernel_memo(("warmtest", other.scope),
+                                      lambda: object())
+        assert st.compiles_after_warmup() == 0
+        # ... but this engine's own steady-state compile trips it
+        with obs_metrics.compile_scope(st.scope):
+            compile_cache.kernel_memo(("warmtest", st.scope, 2),
+                                      lambda: object())
+        assert st.compiles_after_warmup() == 1
