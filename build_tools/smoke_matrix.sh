@@ -70,6 +70,22 @@
 #                             drained+respawned warm (0 compiles),
 #                             respawned replica serves, p99 bounded
 #                             (elastic mesh + replica fleet PR).
+#   procfleet_smoke.py      — process fault domains: a 3-replica
+#                             ProcessReplicaSet (replicas = supervised
+#                             OS child processes behind unix-socket
+#                             front doors, shared disk AOT tier) under
+#                             6x40 threaded load with replica 1's
+#                             PROCESS SIGKILLed at request 60 ->
+#                             240/240 served, exactly 1 supervised
+#                             respawn, respawned process serves with 0
+#                             post-warmup compiles, p99 reported; plus
+#                             a 2-process gloo elastic leg: mid-search
+#                             participant death -> epoch agreement
+#                             (KV-store prefix/roster), mesh shrinks
+#                             to the survivor, search resumes with
+#                             bitwise cv parity and >=50% of tasks
+#                             salvaged instead of failing loud
+#                             (process-fault-domain PR).
 #   gbdt_smoke.py           — native histogram GBDT: batched
 #                             candidate x fold grid >= 2x warm wall
 #                             over sequential per-task fits, adaptive
@@ -96,6 +112,7 @@ python build_tools/asha_smoke.py
 python build_tools/fault_smoke.py
 python build_tools/streaming_smoke.py
 python build_tools/elastic_smoke.py
+python build_tools/procfleet_smoke.py
 python build_tools/kernels_smoke.py
 python build_tools/gbdt_smoke.py
 python build_tools/obs_smoke.py

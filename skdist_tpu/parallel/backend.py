@@ -860,6 +860,18 @@ class TPUBackend(TaskBackend):
         self._adopt_mesh(mesh)
         return True
 
+    def _coordinated_resume(self, local_prefix):
+        """Multi-process PREEMPTED: run the epoch agreement
+        (``ElasticMeshManager.coordinated_resume``), adopt the
+        survivor mesh, and return the agreed resume prefix. Device
+        state is presumed lost either way, so cached broadcasts drop
+        before the caller's fresh placement pass."""
+        _BCAST_CACHE.clear()
+        agreed, mesh = self.elastic.coordinated_resume(local_prefix)
+        if mesh is not None:
+            self._adopt_mesh(mesh)
+        return agreed
+
     @property
     def n_devices(self):
         """Task-axis extent: the number of task slots per round."""
@@ -1208,6 +1220,10 @@ class TPUBackend(TaskBackend):
         offset = 0
         salvage_mark = 0  # tasks already credited to elastic salvage
         while offset < n_tasks:
+            if self.elastic is not None:
+                # production heartbeat probes read these stamps; a
+                # manager without a heartbeat sink no-ops
+                self.elastic.beat()
             degraded = self.elastic is not None and self.elastic.degraded
             if degraded and self.elastic_regrow_check():
                 # capacity returned at a round boundary: re-grow —
@@ -1246,7 +1262,7 @@ class TPUBackend(TaskBackend):
                     exec_fn, sub, shared_placed, span, chunk,
                     put=put, timings=timings, concat=False,
                     pipeline=not self.sync_rounds, stats=stats,
-                    on_round=cb,
+                    on_round=cb, drain_on_fault=not multiprocess,
                 ))
                 offset += span
                 continue
@@ -1277,6 +1293,62 @@ class TPUBackend(TaskBackend):
                 )
             except _RoundFault as rf:
                 if multiprocess:
+                    if (rf.kind == faults.PREEMPTED
+                            and self.elastic is not None
+                            and getattr(self.elastic, "can_coordinate",
+                                        False)):
+                        # Coordinated elastic resume: the survivors
+                        # agree on (epoch, gathered-task-prefix,
+                        # survivor roster) through the jax.distributed
+                        # KV store, the mesh re-forms over the
+                        # survivors, and the round loop resumes from
+                        # the AGREED prefix — every surviving process
+                        # runs this branch symmetrically, so the
+                        # re-formed collective stays in lockstep.
+                        rounds_out.extend(rf.completed)
+                        offset += rf.consumed
+                        retry.admit(rf, offset)
+                        try:
+                            agreed = self._coordinated_resume(offset)
+                        except Exception as agree_exc:
+                            raise RuntimeError(
+                                f"batched_map hit a {rf.kind} fault in "
+                                "a multi-process run and the "
+                                "coordinated elastic resume itself "
+                                f"failed ({agree_exc}); restart the "
+                                "job to retry the search (durable "
+                                "checkpoints resume past completed "
+                                "tasks; see SKDIST_CHECKPOINT_DIR)."
+                            ) from rf.cause
+                        if agreed < offset:
+                            # a peer gathered less: back up to the
+                            # agreed prefix (re-running a gathered
+                            # round is correct; dispatching rounds a
+                            # peer never gathered would desynchronise
+                            # the re-formed collective)
+                            rounds_out, offset = _truncate_rounds(
+                                rounds_out, agreed
+                            )
+                        faults.record("elastic_tasks_salvaged",
+                                      offset - salvage_mark)
+                        salvage_mark = offset
+                        d = self.n_devices
+                        chunk = int(math.ceil(chunk / d) * d)
+                        plan = self.prepare_batched(
+                            kernel, shared_args, static_args,
+                            shared_specs, cache_key,
+                        )
+                        fn, shared_placed, put = (
+                            plan.fn, plan.shared, plan.put
+                        )
+                        exec_fn, chunk = _aot_exec_fn(
+                            fn, shared_placed, task_args, chunk, d, None
+                        )
+                        faults.record("shared_replacements")
+                        multiprocess = self._spans_processes()
+                        if multiprocess:
+                            chunk = self._mesh_min_int(chunk)
+                        continue
                     # Same collective reality as the OOM branch: retry
                     # is single-process only. The message carries no
                     # process-local state (offsets, salvage counts), so
@@ -1812,6 +1884,29 @@ def _concat_rounds(outs):
     return jax.tree_util.tree_map(lambda *xs: np.concatenate(xs, axis=0), *outs)
 
 
+def _truncate_rounds(rounds_out, keep):
+    """Trim a list of gathered round outputs to the first ``keep``
+    tasks (coordinated resume: a peer's agreed prefix was shorter than
+    this process gathered). Returns ``(rounds, kept)``."""
+    import jax
+
+    out, have = [], 0
+    for r in rounds_out:
+        n = _leading_dim(r)
+        if have + n <= keep:
+            out.append(r)
+            have += n
+            if have == keep:
+                break
+            continue
+        take = keep - have
+        if take > 0:
+            out.append(jax.tree_util.tree_map(lambda a: a[:take], r))
+            have += take
+        break
+    return out, have
+
+
 #: at most this many rounds' args/outputs device-resident at once (one
 #: executing + one queued behind it keeps dispatch/compute overlap)
 _MAX_ROUNDS_IN_FLIGHT = 2
@@ -1841,7 +1936,7 @@ def _start_host_copy(dev_out):
 
 def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
                    timings=None, concat=True, pipeline=True, stats=None,
-                   on_round=None):
+                   on_round=None, drain_on_fault=True):
     """Shared round loop: slice task axis, pad the tail round to the
     fixed chunk shape (padding duplicates the last task; its outputs are
     sliced off), run, gather to host numpy, concatenate (or return the
@@ -1983,13 +2078,21 @@ def _run_in_rounds(fn, task_args, shared_args, n_tasks, chunk, put=None,
         # .completed is consumed by the retry/resume loops as a
         # CONTIGUOUS task prefix (offset += consumed), so what may be
         # salvaged depends on where the failure surfaced:
-        if in_gather:
+        if in_gather or not drain_on_fault:
             # inside _gather_oldest (the normal case under async
             # dispatch): the failed round was already popped, so every
             # round still pending comes AFTER the gap — gathering it
             # into outs would silently misalign later outputs to
             # earlier tasks (round-3 advisor, high). Drop them; the
             # resume re-runs from the first missing task.
+            # drain_on_fault=False is the MULTI-PROCESS dispatch-fault
+            # contract: on an SPMD mesh the gather of an in-flight
+            # round is a collective, and after a fault (a preempted
+            # peer being the canonical case) entering a fresh
+            # collective can wedge this process forever against a
+            # peer that will never join it — the salvage must stop at
+            # what is ALREADY on host, and the coordinated-resume
+            # prefix agreement accounts for the dropped rounds.
             pending.clear()
         else:
             # at dispatch: everything pending precedes the failed

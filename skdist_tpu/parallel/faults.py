@@ -265,6 +265,12 @@ FAULT_COUNTERS = (
                                # shrink (journaled/gathered prefix)
     "replica_failovers",    # requests re-routed off a sick replica
     "replica_respawns",     # serving replicas drained + respawned
+    "replica_proc_restarts",  # replica CHILD PROCESSES respawned by
+                              # the procfleet supervisor
+    "heartbeat_misses",     # supervisor heartbeats a replica missed
+    "crash_loop_parks",     # replicas parked after N deaths in window
+    "elastic_epoch_agreements",  # coordinated multi-process resumes
+                                 # agreed (epoch, prefix, roster)
 )
 
 

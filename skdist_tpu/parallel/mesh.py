@@ -17,7 +17,9 @@ SparkContext. Here the cluster is a ``jax.sharding.Mesh``:
 """
 
 import itertools
+import json
 import logging
+import os
 import time
 
 import numpy as np
@@ -30,6 +32,10 @@ __all__ = [
     "task_data_mesh",
     "multihost_task_mesh",
     "ElasticMeshManager",
+    "HeartbeatFileProbe",
+    "KVStoreHeartbeatProbe",
+    "MaintenanceEventProbe",
+    "combine_probes",
 ]
 
 logger = logging.getLogger("skdist_tpu.mesh")
@@ -39,9 +45,17 @@ _MESH_IDS = itertools.count()
 
 
 def initialize_cluster(coordinator_address=None, num_processes=None,
-                       process_id=None):
+                       process_id=None, **jax_kwargs):
     """Join this host to a multi-host JAX cluster (no-op if already
-    initialised or single-host). Wrapper over jax.distributed."""
+    initialised or single-host). Wrapper over jax.distributed.
+
+    ``jax_kwargs`` pass through to ``jax.distributed.initialize`` —
+    on ELASTIC fleets raise ``service_max_missing_heartbeats`` (and
+    the client twin) well above the default: the coordination
+    service's fail-fast otherwise ABORTS every surviving process
+    ~100s after a peer dies, while the elastic layer's epoch
+    agreement is the membership authority that actually handles the
+    loss."""
     import jax
 
     if num_processes in (None, 0, 1):
@@ -56,11 +70,31 @@ def initialize_cluster(coordinator_address=None, num_processes=None,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:  # pragma: no cover - jaxlib without the knob/gloo
         pass
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **jax_kwargs,
+        )
+    except TypeError:
+        # jax's PUBLIC wrapper lags the internal surface: the heartbeat
+        # tolerance knobs live on global_state.initialize (which the
+        # wrapper forwards to verbatim after a backends-uninitialized
+        # check we replicate here)
+        from jax._src import distributed as _dist
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            raise RuntimeError(
+                "initialize_cluster must run before any JAX computation"
+            ) from None
+        _dist.global_state.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **jax_kwargs,
+        )
 
 
 def task_data_mesh(devices=None, data_axis_size=1):
@@ -192,7 +226,9 @@ class ElasticMeshManager:
     """
 
     def __init__(self, devices=None, axis_name="tasks", data_axis_size=1,
-                 group_size=None, probe=None, cluster=None):
+                 group_size=None, probe=None, cluster=None,
+                 coordinate=None, agree_timeout_s=10.0,
+                 kv_namespace="skdist-elastic", heartbeat=None):
         import jax
 
         if devices is None:
@@ -211,7 +247,8 @@ class ElasticMeshManager:
         # participant partition: by process on multi-process rosters,
         # else group_size blocks (default 1 device = 1 participant)
         n_proc = len({d.process_index for d in self.full_devices})
-        if group_size is None and n_proc > 1:
+        self._by_process = group_size is None and n_proc > 1
+        if self._by_process:
             self._pid_of = {
                 id(d): d.process_index for d in self.full_devices
             }
@@ -222,6 +259,19 @@ class ElasticMeshManager:
             }
         self.participant_ids = sorted(set(self._pid_of.values()))
         self.current_extent = self.full_extent
+        #: epoch agreement (multi-process coordinated resume): on by
+        #: default exactly when participants ARE processes — the only
+        #: roster whose loss tears a jax.distributed collective
+        self.coordinate = (self._by_process if coordinate is None
+                           else bool(coordinate))
+        self.agree_timeout_s = float(agree_timeout_s)
+        self.kv_namespace = str(kv_namespace)
+        self._epoch = 0
+        #: participants an epoch agreement declared lost: they stay
+        #: lost (no regrow into a dead process) until an operator
+        #: ``probe=`` positively reports them back
+        self._coordinated_lost = set()
+        self._heartbeat = heartbeat
         #: shrink/regrow log: dicts with kind, lost, extents, wall time
         self.events = []
         #: the `mesh` label of this manager's registry gauge — two
@@ -235,14 +285,41 @@ class ElasticMeshManager:
         return self.current_extent < self.full_extent
 
     def _probe_lost(self):
-        """Currently-lost participant ids (a frozenset)."""
+        """Currently-lost participant ids (a frozenset). An operator
+        ``probe=`` is authoritative — a participant it stops reporting
+        is considered BACK, including one an epoch agreement declared
+        lost. Without a probe, agreement verdicts persist (a dead
+        process cannot rejoin a collective on its own) and the default
+        consults the installed fault injector."""
         if self._probe is not None:
-            return frozenset(self._probe())
+            lost = frozenset(self._probe())
+            self._coordinated_lost &= set(lost)
+            return lost
         inj = faults.active_injector()
-        lost = getattr(inj, "lost_participants", None)
-        if callable(lost):
-            return frozenset(lost())
-        return frozenset()
+        probe = getattr(inj, "lost_participants", None)
+        lost = frozenset(probe()) if callable(probe) else frozenset()
+        return lost | frozenset(self._coordinated_lost)
+
+    def beat(self):
+        """Stamp this process's participant heartbeat(s) (``heartbeat=``
+        — typically the same :class:`HeartbeatFileProbe` /
+        :class:`KVStoreHeartbeatProbe` instance other participants
+        probe). Called by the elastic backend at dispatch boundaries;
+        a no-op without a heartbeat sink."""
+        hb = self._heartbeat
+        if hb is None:
+            return
+        import jax
+
+        try:
+            if self._by_process:
+                hb.beat(int(jax.process_index()))
+            else:
+                for p in self.participant_ids:
+                    hb.beat(p)
+        except Exception as exc:  # a flaky beat must not fail a round
+            faults.log_suppressed("ElasticMeshManager.beat", exc,
+                                  level=logging.DEBUG)
 
     def _survivors(self, lost):
         return [d for d in self.full_devices
@@ -315,6 +392,114 @@ class ElasticMeshManager:
         re-places shared state either way)."""
         return self._resize("shrink", self._probe_lost())
 
+    @property
+    def can_coordinate(self):
+        """Whether :meth:`coordinated_resume` is available: opted in,
+        process-partitioned roster, and a live jax.distributed KV
+        client to agree through."""
+        return (self.coordinate and self._by_process
+                and _kv_client() is not None)
+
+    def coordinated_resume(self, local_prefix):
+        """Epoch agreement for a PREEMPTED multi-process round: the
+        survivors agree on **(epoch, gathered-task-prefix, survivor
+        roster)** through the jax.distributed KV store, then the mesh
+        re-forms over the survivors' devices — so a multi-process
+        search resumes mid-round instead of failing loud to a durable
+        checkpoint restart.
+
+        Protocol (every surviving process runs it symmetrically):
+
+        1. bump the per-manager epoch (survivors see the same fault
+           sequence, so epochs advance in lockstep) and publish this
+           process's contiguous gathered prefix under
+           ``{ns}/e{epoch}/p{pid}``;
+        2. blocking-get every other participant's key with the
+           ``agree_timeout_s`` budget — a process that never publishes
+           within it is DECLARED LOST (the KV silence doubles as the
+           preemption probe; a configured ``probe=`` / injector signal
+           merges in);
+        3. the agreed resume prefix is the MIN over the survivors'
+           prefixes (SPMD lockstep makes them equal in practice; min
+           is the safe direction — re-running a gathered task is
+           correct, skipping an ungathered one is not);
+        4. the mesh rebuilds over the survivors at the
+           largest-divisor task extent (the ordinary shrink
+           geometry). New collectives then compile against the
+           survivor mesh — the collective "re-forms" lazily through
+           the same structural-cache path every elastic resize uses.
+
+        Returns ``(agreed_prefix, mesh_or_None)`` (None: extent
+        unchanged — a transient where everyone responded; the caller
+        still re-places shared state).
+
+        Caveats, documented honestly: the agreement rides the
+        EXISTING distributed service, so it requires the coordinator
+        process to survive (coordinator loss raises, and the caller
+        falls back to the fail-loud checkpoint remedy); and a
+        participant publishing within epsilon of a peer's timeout
+        expiry can be declared lost by one survivor and seen by
+        another — the timeout is the roster authority, size it well
+        above the fleet's straggler spread. Lost participants stay
+        lost (no regrow) until an operator ``probe=`` reports them
+        back; re-admitting a RESTARTED process goes through the
+        ``cluster=`` re-``initialize_cluster`` seam
+        (:meth:`rebuild_cluster`) at regrow time."""
+        import jax
+
+        client = _kv_client()
+        if client is None:
+            raise RuntimeError(
+                "coordinated elastic resume needs the jax.distributed "
+                "KV store; initialize_cluster was never called (or the "
+                "coordinator is gone)"
+            )
+        self._epoch += 1
+        epoch = self._epoch
+        me = int(jax.process_index())
+        ns = f"{self.kv_namespace}/e{epoch}"
+        client.key_value_set(
+            f"{ns}/p{me}", json.dumps({"prefix": int(local_prefix)}),
+            allow_overwrite=True,
+        )
+        prefixes = {me: int(local_prefix)}
+        lost = set()
+        timeout_ms = max(1, int(self.agree_timeout_s * 1e3))
+        for pid in self.participant_ids:
+            if pid == me:
+                continue
+            try:
+                raw = client.blocking_key_value_get(
+                    f"{ns}/p{pid}", timeout_ms
+                )
+                prefixes[pid] = int(json.loads(raw)["prefix"])
+            except Exception:
+                lost.add(pid)
+        lost |= set(self._probe_lost())
+        lost.discard(me)
+        self._coordinated_lost |= lost
+        survivors = sorted(set(prefixes) - lost)
+        agreed = min(prefixes[pid] for pid in survivors)
+        faults.record("elastic_epoch_agreements")
+        self.events.append({
+            "kind": "epoch_agreement", "epoch": epoch,
+            "prefix": int(agreed), "survivors": survivors,
+            "lost": sorted(lost), "t": time.time(),
+        })
+        logger.warning(
+            "elastic epoch %d agreement: survivors=%s lost=%s -> resume "
+            "from task prefix %d", epoch, survivors, sorted(lost), agreed,
+        )
+        obs_trace.instant(
+            "elastic_epoch_agreement",
+            {"epoch": epoch, "prefix": int(agreed),
+             "survivors": len(survivors), "lost": len(lost)}
+            if obs_trace.enabled() else None,
+        )
+        mesh = self._resize("shrink", frozenset(self._coordinated_lost)) \
+            if lost else None
+        return int(agreed), mesh
+
     def maybe_regrow(self):
         """Round-boundary check while degraded: when the probe reports
         capacity back, rebuild the larger mesh (re-joining the cluster
@@ -345,3 +530,147 @@ class ElasticMeshManager:
             initialize_cluster(**self.cluster)
         except Exception as exc:
             faults.log_suppressed("ElasticMeshManager.reinit", exc)
+
+
+def _kv_client():
+    """The jax.distributed KV-store client, or None when the cluster
+    was never initialized (single-controller runs)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax without the module
+        return None
+
+
+# ---------------------------------------------------------------------------
+# production preemption probes (the manager's `probe=` seam)
+# ---------------------------------------------------------------------------
+
+class HeartbeatFileProbe:
+    """Heartbeat-file liveness for process participants: every
+    participant :meth:`beat`\\ s its file (an mtime touch on shared
+    storage) at dispatch boundaries, and the probe reports any
+    participant whose file is missing or staler than ``stale_s`` as
+    LOST. The plainest production probe — no coordinator dependency,
+    so it keeps working through the exact failures it detects. Pass
+    the same instance as both ``heartbeat=`` (this process beats) and
+    ``probe=`` (this process judges the others) of an
+    :class:`ElasticMeshManager`. Beat once at startup: a participant
+    that never wrote its file reads as lost, which is the right
+    default for a worker that never came up."""
+
+    def __init__(self, directory, participants, stale_s=30.0,
+                 clock=time.time):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.participants = sorted(int(p) for p in participants)
+        self.stale_s = float(stale_s)
+        self._clock = clock
+
+    def path(self, participant):
+        return os.path.join(self.directory,
+                            f"participant-{int(participant)}.hb")
+
+    def beat(self, participant):
+        p = self.path(participant)
+        with open(p, "a", encoding="utf-8"):
+            pass
+        now = self._clock()
+        os.utime(p, (now, now))
+
+    def __call__(self):
+        now = self._clock()
+        lost = set()
+        for p in self.participants:
+            try:
+                mtime = os.stat(self.path(p)).st_mtime
+            except OSError:
+                lost.add(p)
+                continue
+            if now - mtime > self.stale_s:
+                lost.add(p)
+        return lost
+
+
+class KVStoreHeartbeatProbe:
+    """Heartbeats through the jax.distributed KV store: each process
+    :meth:`beat`\\ s a wall-clock stamp under its participant key;
+    the probe reports missing/stale stamps as lost. The zero-extra-
+    infrastructure variant of :class:`HeartbeatFileProbe` for fleets
+    already running a coordinator — with the same caveat the epoch
+    agreement carries: it shares fate with the coordinator process."""
+
+    def __init__(self, participants, stale_s=30.0,
+                 namespace="skdist-hb", clock=time.time):
+        self.participants = sorted(int(p) for p in participants)
+        self.stale_s = float(stale_s)
+        self.namespace = str(namespace)
+        self._clock = clock
+
+    def _key(self, participant):
+        return f"{self.namespace}/p{int(participant)}"
+
+    def beat(self, participant):
+        client = _kv_client()
+        if client is None:
+            raise RuntimeError(
+                "KVStoreHeartbeatProbe needs an initialized "
+                "jax.distributed cluster"
+            )
+        client.key_value_set(self._key(participant),
+                             repr(float(self._clock())),
+                             allow_overwrite=True)
+
+    def __call__(self):
+        client = _kv_client()
+        if client is None:
+            return set(self.participants)
+        now = self._clock()
+        lost = set()
+        for p in self.participants:
+            try:
+                raw = client.blocking_key_value_get(self._key(p), 50)
+                if now - float(raw) > self.stale_s:
+                    lost.add(p)
+            except Exception:
+                lost.add(p)
+        return lost
+
+
+class MaintenanceEventProbe:
+    """Pluggable maintenance-event hook: ``hook()`` returns the
+    participant ids a platform notice says are being (or about to be)
+    preempted — e.g. a poll of the cloud metadata maintenance-event
+    endpoint, or a callback queue an operator daemon feeds. Each
+    report is HELD for ``hold_s`` so a one-shot notice outlives the
+    round that happens to read it; after the hold the participant is
+    presumed back (pair with a heartbeat probe via
+    :func:`combine_probes` when "gone" must be observed, not
+    presumed)."""
+
+    def __init__(self, hook, hold_s=120.0, clock=time.time):
+        self.hook = hook
+        self.hold_s = float(hold_s)
+        self._clock = clock
+        self._until = {}
+
+    def __call__(self):
+        now = self._clock()
+        for p in (self.hook() or ()):
+            self._until[int(p)] = now + self.hold_s
+        return {p for p, t in self._until.items() if t > now}
+
+
+def combine_probes(*probes):
+    """One probe from many: the union of every probe's lost set (a
+    participant is lost if ANY signal says so — heartbeat silence OR a
+    maintenance notice)."""
+
+    def combined():
+        lost = set()
+        for probe in probes:
+            lost |= set(probe())
+        return lost
+
+    return combined

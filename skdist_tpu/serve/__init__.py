@@ -35,6 +35,12 @@ over the same compiled block-inference programs the offline
   prewarm-before-publish rollouts, and a shared on-disk AOT artifact
   tier so a respawned (or fresh-process) replica serves its first
   request with zero compiles.
+- :class:`ProcessReplicaSet` — the same fleet with PROCESS fault
+  domains: replicas are supervised OS child processes
+  (``serve.procworker``) behind unix-domain-socket front doors —
+  heartbeat liveness, process-group SIGKILL of wedged workers,
+  bounded-backoff respawn with crash-loop parking, graceful SIGTERM
+  drain, and zero-downtime ``rolling_restart()``.
 
 Quickstart::
 
@@ -58,6 +64,7 @@ from .batcher import (
     shape_buckets,
 )
 from .engine import ServingEngine
+from .procfleet import ProcessReplicaSet
 from .quantize import SERVE_DTYPES
 from .registry import ModelEntry, ModelRegistry
 from .replicaset import AllReplicasUnhealthy, ReplicaSet
@@ -67,6 +74,7 @@ __all__ = [
     "SERVE_DTYPES",
     "ServingEngine",
     "ReplicaSet",
+    "ProcessReplicaSet",
     "AllReplicasUnhealthy",
     "ModelRegistry",
     "ModelEntry",

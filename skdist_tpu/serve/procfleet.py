@@ -1,0 +1,1048 @@
+"""
+ProcessReplicaSet: serving replicas as supervised OS child processes —
+real fault domains behind a unix-domain-socket front door.
+
+:class:`~skdist_tpu.serve.replicaset.ReplicaSet` (PR 8) heals engines
+*inside one process*: a segfault in a kernel, an unkillable wedged
+device op (the reason ``utils/childproc.py`` exists), or an OOM-kill
+still takes down every replica at once, because they share a process.
+The reference world never had this problem — Spark gave sk-dist
+executor JVMs as fault domains, with the driver surviving any worker
+death — and Clipper (Crankshaw et al., NSDI'17) isolates model
+containers behind an RPC front door for exactly this reason. This
+module is that layer natively:
+
+- **replicas are child processes**: each replica is a full
+  :class:`~skdist_tpu.serve.engine.ServingEngine` running in its own
+  OS process (``serve.procworker``), listening on a unix-domain
+  socket. The parent holds a thin client pool per replica; requests
+  are length-prefixed pickled frames (:func:`send_frame` /
+  :func:`recv_frame`). A replica death is a process death — it cannot
+  corrupt the router or its siblings.
+
+- **the supervisor owns liveness**: a background thread heartbeats
+  every replica (a ``ping`` frame with a reply deadline).
+  ``miss_threshold`` consecutive missed beats declare the replica
+  dead — a wedged or SIGSTOPped child that still *owns* its socket is
+  treated exactly like one that crashed — and the whole process GROUP
+  is SIGKILLed (the ``childproc.py`` containment recipe: the child is
+  spawned ``start_new_session`` so grandchildren die with it).
+
+- **bounded-backoff respawn + crash-loop parking**: a dead replica is
+  respawned after an exponential backoff (``respawn_backoff_s``
+  doubling per consecutive death). ``crash_loop_threshold`` deaths
+  inside ``crash_loop_window_s`` PARK the replica instead — a replica
+  that cannot hold a process up must not burn the host spawning it in
+  a loop. :class:`AllReplicasUnhealthy` surfaces only when the whole
+  fleet is parked (or nothing comes back within the bounded
+  unhealthy wait); a fleet with any respawn still pending briefly
+  queues instead.
+
+- **graceful drain**: ``close()`` / :meth:`stop_replica` SIGTERM the
+  worker, which stops admissions, drains its queued flushes, and
+  exits 0; only a worker that overstays ``drain_timeout_s`` is
+  SIGKILLed. :meth:`rolling_restart` drains+respawns one replica at a
+  time so the fleet serves throughout — the operational rendition of
+  "config rollout without downtime".
+
+- **0-compile respawns**: replicas share ``artifact_dir`` — the PR-1
+  on-disk ``jax.export`` AOT tier — so a respawned process's
+  re-registration (the parent replays every published
+  ``name@version``, numbering preserved) prewarms from disk instead
+  of XLA and serves its first request with zero compiles.
+
+Routing, failover semantics, and stats mirror ``ReplicaSet``: least
+loaded (parent-side in-flight + child queue depth from the last
+heartbeat), request-owned verdicts (``ValueError`` / ``TypeError`` /
+``KeyError`` / :class:`DeadlineExceeded`) surface, everything else
+re-routes and feeds the health bookkeeping. Deterministic injection:
+``FaultInjector.kill_replica_proc(i, at_request=k)`` and
+``stall_replica_proc`` (SIGSTOP — heartbeat-stall) are consulted on
+every routed request ordinal, so "replica 1 is SIGKILLed at request
+60 under load" is an exact, replayable sentence
+(``build_tools/procfleet_smoke.py``).
+
+The wire protocol is pickle over a parent-owned unix socket: a
+same-host, same-user trust boundary (the socket lives in a
+``mkdtemp`` directory), not a network protocol.
+"""
+
+import json
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
+
+from ..obs import trace as obs_trace
+from ..parallel import faults
+from ..utils.childproc import _kill_group
+from .batcher import (
+    CircuitOpen,
+    DeadlineExceeded,
+    Overloaded,
+    ServingError,
+)
+from .replicaset import AllReplicasUnhealthy, fleet_by_model
+
+__all__ = [
+    "ProcessReplicaSet",
+    "ReplicaError",
+    "ReplicaConnectionError",
+    "WireError",
+    "FrameTooLarge",
+    "send_frame",
+    "recv_frame",
+]
+
+# ---------------------------------------------------------------------------
+# wire protocol: length-prefixed pickled frames
+# ---------------------------------------------------------------------------
+
+_FRAME_HEADER = struct.Struct(">I")
+#: upper bound on one frame — far above any sane request, far below a
+#: length that would make a corrupted header allocate the host away
+MAX_FRAME_BYTES = 1 << 30
+
+
+class WireError(ServingError):
+    """Framing/transport violation on the front-door socket: truncated
+    header, oversized length, undecodable payload, or a peer closing
+    mid-frame. The stream cannot be resynchronised past it — the
+    connection is abandoned (the replica itself keeps serving its
+    other connections)."""
+
+
+class FrameTooLarge(ValueError):
+    """A LOCALLY-built frame exceeds the wire bound. Deliberately a
+    ``ValueError``, NOT a :class:`WireError`: nothing touched the
+    socket, so this is a request-owned verdict that must surface to
+    the caller — conflating it with transport death would get every
+    healthy replica serially declared dead over one oversized
+    request."""
+
+
+class ReplicaError(ServingError):
+    """A replica-side failure with no local exception type — always
+    failover-worthy (the verdict is about the replica, not the
+    request)."""
+
+
+class ReplicaConnectionError(ReplicaError):
+    """The replica's socket died mid-conversation — the strongest
+    process-death signal the router sees before the supervisor's
+    heartbeat confirms it."""
+
+
+def send_frame(sock, obj):
+    """Write one length-prefixed pickled frame. An over-bound payload
+    raises :class:`FrameTooLarge` BEFORE touching the socket."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameTooLarge(
+            f"frame of {len(payload)} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte bound; bulk payloads belong on "
+            "distribute.batch_predict, not the online front door"
+        )
+    sock.sendall(_FRAME_HEADER.pack(len(payload)) + payload)
+
+
+def recv_frame(sock):
+    """Read one frame; raises :class:`WireError` on EOF mid-frame, an
+    oversized length prefix, or an undecodable payload."""
+    (n,) = _FRAME_HEADER.unpack(_recv_exact(sock, _FRAME_HEADER.size))
+    if n > MAX_FRAME_BYTES:
+        raise WireError(
+            f"frame length {n} exceeds the {MAX_FRAME_BYTES}-byte bound "
+            "(corrupted header?)"
+        )
+    payload = _recv_exact(sock, n)
+    try:
+        return pickle.loads(payload)
+    except Exception as exc:
+        raise WireError(f"undecodable frame: {exc!r}") from exc
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise WireError("socket closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+#: replica-side exception types reconstructed BY NAME in the parent so
+#: failover semantics survive the process boundary (anything else
+#: becomes a failover-worthy ReplicaError)
+_TYPED_ERRORS = {
+    cls.__name__: cls
+    for cls in (
+        ValueError, TypeError, KeyError, RuntimeError,
+        ServingError, Overloaded, DeadlineExceeded, CircuitOpen,
+        faults.WatchdogTimeout, FrameTooLarge,
+    )
+}
+
+
+def encode_error(exc):
+    """Worker-side: one exception as a reply frame."""
+    return {"ok": False, "etype": type(exc).__name__, "msg": str(exc)}
+
+
+def decode_error(reply):
+    """Parent-side: rebuild the typed exception (or a
+    :class:`ReplicaError` for unknown types)."""
+    cls = _TYPED_ERRORS.get(reply.get("etype"))
+    msg = reply.get("msg", "")
+    if cls is None:
+        return ReplicaError(f"{reply.get('etype')}: {msg}")
+    return cls(msg)
+
+
+# ---------------------------------------------------------------------------
+# client pool
+# ---------------------------------------------------------------------------
+
+class _ClientPool:
+    """Per-replica connection pool: one RPC owns one connection for its
+    round trip (frames never interleave); idle connections are reused.
+    Any socket/framing error abandons the connection and surfaces as
+    :class:`ReplicaConnectionError` — the router's process-death
+    signal."""
+
+    def __init__(self, path, connect_timeout_s=5.0):
+        self.path = path
+        self.connect_timeout_s = connect_timeout_s
+        self._lock = threading.Lock()
+        self._idle = []
+        self._closed = False
+
+    def _get(self):
+        with self._lock:
+            if self._closed:
+                raise ReplicaConnectionError("client pool is closed")
+            if self._idle:
+                return self._idle.pop()
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            s.settimeout(self.connect_timeout_s)
+            s.connect(self.path)
+        except OSError as exc:
+            s.close()
+            raise ReplicaConnectionError(
+                f"cannot connect to replica socket {self.path}: {exc}"
+            ) from exc
+        return s
+
+    def _put(self, conn):
+        with self._lock:
+            if not self._closed:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def request(self, op, payload, timeout_s):
+        """One RPC round trip. Returns the reply value or raises the
+        decoded typed exception; transport failures raise
+        :class:`ReplicaConnectionError`."""
+        conn = self._get()
+        try:
+            conn.settimeout(timeout_s)
+            send_frame(conn, (op, payload))
+            reply = recv_frame(conn)
+        except (OSError, WireError, EOFError) as exc:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ReplicaConnectionError(
+                f"replica RPC {op!r} failed: {exc}"
+            ) from exc
+        self._put(conn)
+        if not isinstance(reply, dict):
+            raise ReplicaConnectionError(
+                f"replica RPC {op!r} returned a non-reply frame"
+            )
+        if reply.get("ok"):
+            return reply.get("value")
+        raise decode_error(reply)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for c in idle:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+# ---------------------------------------------------------------------------
+
+class _ProcReplica:
+    """One fleet member: the child process plus the supervisor's view."""
+
+    __slots__ = (
+        "index", "generation", "proc", "socket_path", "log_path", "pool",
+        "alive", "parked", "draining", "misses", "failures", "routed",
+        "in_flight", "queue_depth", "deaths", "consecutive_deaths",
+        "respawn_due_at", "death_reason", "intentional_stop",
+    )
+
+    def __init__(self, index):
+        self.index = index
+        self.generation = 0
+        self.proc = None
+        self.socket_path = None
+        self.log_path = None
+        self.pool = None
+        self.alive = False
+        self.parked = False
+        self.draining = False
+        self.misses = 0
+        self.failures = 0      # consecutive failover-worthy failures
+        self.routed = 0
+        self.in_flight = 0
+        self.queue_depth = 0   # from the last heartbeat reply
+        self.deaths = deque()  # wall times, crash-loop accounting
+        self.consecutive_deaths = 0
+        self.respawn_due_at = None
+        self.death_reason = None
+        self.intentional_stop = False
+
+    @property
+    def pid(self):
+        return self.proc.pid if self.proc is not None else None
+
+
+class ProcessReplicaSet:
+    """Supervised multi-process serving fleet (module docstring).
+
+    ``engine_kwargs`` (JSON-able) configure each worker's
+    ``ServingEngine``; ``backend_spec`` its backend (``None`` →
+    ``{"kind": "tpu"}`` — a ``TPUBackend`` over the worker's visible
+    devices; ``{"kind": "tpu", "kwargs": {...}}`` passes constructor
+    kwargs, e.g. per-replica device subsets via env in
+    ``worker_env``). ``artifact_dir`` points every worker at one
+    shared on-disk AOT artifact tier so respawns compile nothing.
+    ``worker_argv`` is the spawn seam: a callable ``(index,
+    socket_path, config_json) -> argv`` replacing the default
+    ``python -m skdist_tpu.serve.procworker`` line (deployments wrap
+    it in numactl/env shims; tests substitute crashing workers).
+    """
+
+    def __init__(self, n_replicas=2, artifact_dir=None, engine_kwargs=None,
+                 backend_spec=None, worker_argv=None, worker_env=None,
+                 heartbeat_interval_s=0.5, heartbeat_timeout_s=2.0,
+                 miss_threshold=3, sick_threshold=3,
+                 respawn_backoff_s=0.25, max_respawn_backoff_s=10.0,
+                 crash_loop_window_s=30.0, crash_loop_threshold=3,
+                 spawn_timeout_s=120.0, drain_timeout_s=15.0,
+                 request_timeout_s=60.0, unhealthy_wait_s=30.0):
+        if int(n_replicas) < 1:
+            raise ValueError(f"n_replicas must be >= 1; got {n_replicas}")
+        self.artifact_dir = str(artifact_dir) if artifact_dir else None
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.backend_spec = backend_spec
+        self._worker_argv = worker_argv
+        self.worker_env = dict(worker_env or {})
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.miss_threshold = max(1, int(miss_threshold))
+        self.sick_threshold = max(1, int(sick_threshold))
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.max_respawn_backoff_s = float(max_respawn_backoff_s)
+        self.crash_loop_window_s = float(crash_loop_window_s)
+        self.crash_loop_threshold = max(1, int(crash_loop_threshold))
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.request_timeout_s = request_timeout_s
+        self.unhealthy_wait_s = float(unhealthy_wait_s)
+
+        self._dir = tempfile.mkdtemp(prefix="skpf-")
+        self._lock = threading.Lock()
+        self._respawn_lock = threading.Lock()
+        self._closed = False
+        self._requests = 0
+        self._rr = 0
+        #: rollout spec store, same contract as ReplicaSet._published:
+        #: versions as the PARENT assigned them, replayed verbatim into
+        #: every respawned generation
+        self._published = {}
+        self.events = []
+        self._replicas = [_ProcReplica(i) for i in range(int(n_replicas))]
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(4, 4 * int(n_replicas)),
+            thread_name_prefix="skdist-procfleet",
+        )
+        #: respawns run on their OWN thread — never on the request
+        #: executor, whose workers may all be parked in the
+        #: "waiting for a respawn" loop (healing must not queue
+        #: behind the traffic that is waiting on it), and never on
+        #: the heartbeat thread (a slow spawn must not blind
+        #: liveness detection for the other replicas)
+        self._respawn_exec = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="skdist-procfleet-respawn",
+        )
+        for r in self._replicas:
+            try:
+                self._spawn(r)
+                r.alive = True
+            except Exception as exc:
+                # construction tolerates a failed spawn (incl. a Popen
+                # OSError from a broken worker_argv): the supervisor
+                # retries on backoff and crash-loop parking bounds it —
+                # a fleet is built to outlive its members
+                self._record_death(r, f"spawn: {exc}")
+        self._stop_evt = threading.Event()
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True,
+            name="skdist-procfleet-supervisor",
+        )
+        self._supervisor.start()
+
+    # ------------------------------------------------------------------
+    # spawning
+    # ------------------------------------------------------------------
+    def _argv_for(self, r, sock_path):
+        cfg = json.dumps({
+            "engine": self.engine_kwargs,
+            "backend": self.backend_spec,
+            "artifact_dir": self.artifact_dir,
+            "replica": r.index,
+        })
+        if self._worker_argv is not None:
+            return list(self._worker_argv(r.index, sock_path, cfg))
+        return [sys.executable, "-m", "skdist_tpu.serve.procworker",
+                "--socket", sock_path, "--config", cfg]
+
+    def _spawn(self, r):
+        """Start one worker process and wait for its front door to
+        answer a ping. Raises :class:`ServingError` on spawn failure
+        (the caller records the death for crash-loop accounting)."""
+        r.generation += 1
+        sock_path = os.path.join(
+            self._dir, f"r{r.index}g{r.generation}.sock"
+        )
+        r.log_path = os.path.join(self._dir, f"r{r.index}.log")
+        env = dict(os.environ)
+        # the worker must resolve skdist_tpu the way the parent did
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(self.worker_env)
+        argv = self._argv_for(r, sock_path)
+        with open(r.log_path, "ab") as log:
+            # start_new_session: the worker owns a fresh process group,
+            # so the supervisor's SIGKILL reaches its grandchildren too
+            # (the childproc.py containment recipe)
+            proc = subprocess.Popen(
+                argv, start_new_session=True, env=env,
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+        r.proc = proc
+        r.socket_path = sock_path
+        r.pool = _ClientPool(sock_path)
+        deadline = time.monotonic() + self.spawn_timeout_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise ServingError(
+                    f"replica {r.index} worker exited rc={proc.returncode} "
+                    f"before serving (log: {r.log_path})"
+                )
+            if os.path.exists(sock_path):
+                try:
+                    r.pool.request("ping", {}, 5.0)
+                    r.misses = 0
+                    return
+                except ReplicaError:
+                    pass
+            time.sleep(0.05)
+        _kill_group(proc)
+        raise ServingError(
+            f"replica {r.index} worker did not answer within "
+            f"{self.spawn_timeout_s}s (log: {r.log_path})"
+        )
+
+    # ------------------------------------------------------------------
+    # rollout
+    # ------------------------------------------------------------------
+    def rollout(self, name, model, methods=("predict",), version=None,
+                serve_dtype="float32"):
+        """Fleet-wide prewarm-before-publish: register (and prewarm)
+        on EVERY routable replica, then publish. The PARENT assigns
+        the version number and passes it explicitly, so every replica
+        — and every future respawned generation — registers the same
+        ``name@version``. Raises without publishing if any replica's
+        registration fails."""
+        if self._closed:
+            raise ServingError("replica set is closed")
+        methods = (methods,) if isinstance(methods, str) else tuple(methods)
+        with self._lock:
+            if version is None:
+                have = [rec["version"]
+                        for rec in self._published.get(name, ())]
+                version = (max(have) + 1) if have else 1
+            version = int(version)
+        rec = {"name": name, "model": model, "methods": methods,
+               "version": version, "serve_dtype": serve_dtype}
+        # serialize against respawns: a replica respawning inside the
+        # register->publish window would replay _published WITHOUT this
+        # model yet re-enter rotation, and then serve KeyError — a
+        # request-owned verdict failover will not absorb
+        with self._respawn_lock:
+            live = [r for r in self._replicas
+                    if r.alive and not r.draining]
+            if not live:
+                raise AllReplicasUnhealthy(
+                    "no live replica to roll out onto; wait for the "
+                    "supervisor's respawns (or unpark)"
+                )
+            done = []
+            try:
+                for r in live:
+                    self._register_on(r, rec)
+                    done.append(r)
+            except Exception:
+                # roll the orphans back: a version registered on SOME
+                # replicas but never published would make every retry
+                # of this rollout fail "already registered" (versions
+                # are immutable worker-side). Best-effort — a replica
+                # that dies mid-rollback respawns consistent from
+                # _published anyway.
+                for r in done:
+                    try:
+                        r.pool.request(
+                            "unregister",
+                            {"name": name, "version": version},
+                            self.heartbeat_timeout_s * 4,
+                        )
+                    except Exception as exc:
+                        faults.log_suppressed(
+                            "ProcessReplicaSet.rollout.rollback", exc
+                        )
+                raise
+            with self._lock:
+                self._published.setdefault(name, []).append(rec)
+        self._event("rollout", None, name=name, version=version,
+                    serve_dtype=serve_dtype)
+        return version
+
+    register = rollout
+
+    def _register_on(self, r, rec):
+        # registration compiles (or loads AOT artifacts) — give it the
+        # spawn budget, not the request budget
+        return r.pool.request("register", dict(rec), self.spawn_timeout_s)
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+    def submit(self, X, model=None, method="predict", timeout_s=None):
+        """Route one request; returns a Future (resolved on a fleet
+        dispatch thread). Failover semantics mirror ``ReplicaSet``."""
+        if self._closed:
+            raise ServingError("replica set is closed")
+        self._tick()
+        return self._executor.submit(
+            self._routed_request, X, model, method, timeout_s
+        )
+
+    def predict(self, X, model=None, method="predict", timeout_s=None):
+        fut = self.submit(X, model=model, method=method,
+                          timeout_s=timeout_s)
+        wait = None if timeout_s is None else timeout_s + max(
+            2.0, 2 * len(self._replicas) * 0.5
+        )
+        try:
+            return fut.result(timeout=wait)
+        except _FutureTimeout:
+            raise DeadlineExceeded(
+                f"no result within {timeout_s}s (+fleet grace)"
+            ) from None
+
+    def predict_proba(self, X, model=None, timeout_s=None):
+        return self.predict(X, model=model, method="predict_proba",
+                            timeout_s=timeout_s)
+
+    def decision_function(self, X, model=None, timeout_s=None):
+        return self.predict(X, model=model, method="decision_function",
+                            timeout_s=timeout_s)
+
+    def _routed_request(self, X, model, method, timeout_s):
+        tried = set()
+        last = None
+        give_up_at = time.monotonic() + self.unhealthy_wait_s
+        while True:
+            r = self._pick(tried)
+            if r is None:
+                with self._lock:
+                    all_parked = all(p.parked for p in self._replicas)
+                if all_parked or time.monotonic() >= give_up_at:
+                    exc = AllReplicasUnhealthy(
+                        f"all {len(self._replicas)} replica processes "
+                        "refused the request"
+                        + (" (whole fleet parked after crash loops)"
+                           if all_parked else "")
+                    )
+                    exc.__cause__ = last
+                    raise exc
+                # replicas are down but respawns are pending: wait a
+                # beat for the supervisor rather than failing a request
+                # into a healing fleet
+                time.sleep(min(0.1, self.heartbeat_interval_s))
+                tried.clear()
+                continue
+            tried.add(r.index)
+            rpc_timeout = (self.request_timeout_s if timeout_s is None
+                           else timeout_s + max(2.0, self.heartbeat_timeout_s))
+            with self._lock:
+                r.routed += 1
+                r.in_flight += 1
+            try:
+                out = r.pool.request(
+                    "request",
+                    {"X": X, "model": model, "method": method,
+                     "timeout_s": timeout_s},
+                    rpc_timeout,
+                )
+                with self._lock:
+                    r.failures = 0
+                return out
+            except Exception as exc:
+                last = exc
+                if not self._failover_worthy(r, exc):
+                    raise
+            finally:
+                with self._lock:
+                    r.in_flight -= 1
+
+    def _pick(self, exclude=()):
+        """Least-loaded live replica not yet tried: parent-side
+        in-flight plus the child's queue depth from its last
+        heartbeat, ties round-robin."""
+        with self._lock:
+            live = [r for r in self._replicas
+                    if r.alive and not r.draining
+                    and r.index not in exclude]
+            self._rr += 1
+            rr = self._rr
+            if not live:
+                return None
+            return min(
+                live,
+                key=lambda r: (r.in_flight + r.queue_depth,
+                               (r.index - rr) % len(self._replicas)),
+            )
+
+    def _failover_worthy(self, r, exc):
+        """Mirror of ``ReplicaSet._failover_worthy`` across the process
+        boundary: request-owned verdicts surface; transport deaths
+        declare the process dead immediately; everything else strikes
+        toward a supervised restart."""
+        if isinstance(exc, (ValueError, TypeError, KeyError,
+                            DeadlineExceeded)):
+            return False
+        faults.record("replica_failovers")
+        obs_trace.instant(
+            "replica_failover",
+            {"replica": int(r.index), "error": type(exc).__name__}
+            if obs_trace.enabled() else None,
+        )
+        if isinstance(exc, Overloaded):
+            return True  # load, not sickness: re-route without a strike
+        if isinstance(exc, ReplicaConnectionError):
+            self._declare_dead(r, f"connection: {exc}")
+            return True
+        with self._lock:
+            r.failures += 1
+            sick = (
+                isinstance(exc, (CircuitOpen, faults.WatchdogTimeout))
+                or r.failures >= self.sick_threshold
+            )
+        if sick:
+            self._declare_dead(r, f"sick: {type(exc).__name__}")
+        return True
+
+    # ------------------------------------------------------------------
+    # supervisor
+    # ------------------------------------------------------------------
+    def _supervise(self):
+        while not self._closed:
+            self._stop_evt.wait(self.heartbeat_interval_s)
+            if self._closed:
+                return
+            for r in list(self._replicas):
+                if self._closed:
+                    return
+                try:
+                    self._supervise_one(r)
+                except Exception as exc:
+                    # the supervisor thread is the fleet's liveness —
+                    # a surprise from one replica's bookkeeping must
+                    # not kill heartbeats for every other replica
+                    faults.log_suppressed(
+                        "ProcessReplicaSet._supervise", exc
+                    )
+
+    def _supervise_one(self, r):
+        if r.parked:
+            return
+        if not r.alive:
+            due = r.respawn_due_at
+            if due is not None and time.monotonic() >= due:
+                with self._lock:
+                    r.respawn_due_at = None  # one submission per due
+                self._respawn_exec.submit(self._respawn, r)
+            return
+        if r.proc is not None and r.proc.poll() is not None:
+            self._declare_dead(
+                r, f"exited rc={r.proc.returncode}", kill=False
+            )
+            return
+        try:
+            pong = r.pool.request(
+                "ping", {}, self.heartbeat_timeout_s
+            )
+            r.misses = 0
+            r.queue_depth = int(pong.get("queue_depth", 0))
+            if pong.get("draining") and not r.draining:
+                # external SIGTERM: route away now; the exit
+                # lands in the poll() branch and respawns
+                r.draining = True
+                self._event("draining", r.index)
+        except Exception:
+            r.misses += 1
+            faults.record("heartbeat_misses")
+            obs_trace.instant(
+                "replica_heartbeat_miss",
+                {"replica": int(r.index), "misses": int(r.misses)}
+                if obs_trace.enabled() else None,
+            )
+            if r.misses >= self.miss_threshold:
+                self._declare_dead(
+                    r, f"heartbeat: {r.misses} consecutive misses"
+                )
+
+    def _declare_dead(self, r, reason, kill=True):
+        """Take a replica out of rotation NOW: SIGKILL its process
+        group (unless it already exited) and schedule a respawn."""
+        with self._lock:
+            if not r.alive:
+                return
+            r.alive = False
+        if kill and r.proc is not None:
+            _kill_group(r.proc)
+        if r.proc is not None:
+            try:
+                r.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable: abandoned, never inherited as a hang
+        if r.pool is not None:
+            r.pool.close()
+        self._event("dead", r.index, reason=reason,
+                    generation=r.generation)
+        self._record_death(r, reason)
+
+    def _record_death(self, r, reason):
+        """Crash-loop accounting + respawn scheduling (also the landing
+        path for failed spawns)."""
+        now = time.monotonic()
+        with self._lock:
+            r.alive = False
+            r.draining = False
+            r.death_reason = reason
+            if r.intentional_stop:
+                # operator-driven drain/stop: not a crash, no backoff
+                r.intentional_stop = False
+                r.respawn_due_at = None
+                return
+            r.deaths.append(now)
+            while r.deaths and now - r.deaths[0] > self.crash_loop_window_s:
+                r.deaths.popleft()
+            r.consecutive_deaths += 1
+            if len(r.deaths) >= self.crash_loop_threshold:
+                r.parked = True
+                r.respawn_due_at = None
+            else:
+                backoff = min(
+                    self.respawn_backoff_s
+                    * (2.0 ** (r.consecutive_deaths - 1)),
+                    self.max_respawn_backoff_s,
+                )
+                r.respawn_due_at = now + backoff
+        if r.parked:
+            faults.record("crash_loop_parks")
+            self._event(
+                "parked", r.index, reason=reason,
+                deaths_in_window=len(r.deaths),
+            )
+
+    def _respawn(self, r, reason=None):
+        """Respawn one dead replica: fresh process, wait ready,
+        re-register every published model under its original version,
+        return it to rotation."""
+        with self._respawn_lock:
+            if r.alive or r.parked or self._closed:
+                return False
+            reason = reason or r.death_reason
+            with self._lock:
+                # an explicit revive attempt ends any "intentional
+                # stop" era NOW: if THIS spawn fails, that failure is
+                # a real death (backoff + crash-loop accounting), not
+                # a stop to be shrugged off
+                r.intentional_stop = False
+            with obs_trace.span(
+                "replica_respawn",
+                {"replica": int(r.index), "pid": r.pid,
+                 "reason": str(reason)}
+                if obs_trace.enabled() else None,
+            ):
+                old_pool = r.pool
+                if old_pool is not None:
+                    old_pool.close()
+                try:
+                    self._spawn(r)
+                    with self._lock:
+                        published = [
+                            dict(rec) for recs in self._published.values()
+                            for rec in recs
+                        ]
+                    for rec in published:
+                        self._register_on(r, rec)
+                except Exception as exc:
+                    # ANY failure — spawn OSError, a decoded
+                    # registration ValueError, transport death — is a
+                    # failed respawn feeding the crash-loop accounting,
+                    # never an escape that kills the supervisor thread
+                    if r.proc is not None:
+                        _kill_group(r.proc)
+                    self._record_death(r, f"respawn: {exc}")
+                    return False
+                with self._lock:
+                    r.failures = 0
+                    r.misses = 0
+                    r.queue_depth = 0
+                    r.consecutive_deaths = 0
+                    r.respawn_due_at = None
+                    r.alive = True
+        faults.record("replica_proc_restarts")
+        self._event("respawn", r.index, generation=r.generation,
+                    pid=r.pid, reason=str(reason))
+        return True
+
+    def heal(self):
+        """Respawn every dead (non-parked) replica NOW, ignoring
+        backoff — deterministic tests and drain-then-upgrade ops."""
+        n = 0
+        for r in self._replicas:
+            if not r.alive and not r.parked:
+                if self._respawn(r, reason="heal"):
+                    n += 1
+        return n
+
+    def unpark(self, index):
+        """Clear a parked replica's crash-loop verdict and respawn it
+        (operator API — after fixing whatever crashed the worker)."""
+        r = self._replicas[int(index)]
+        with self._lock:
+            r.parked = False
+            r.deaths.clear()
+            r.consecutive_deaths = 0
+        self._event("unpark", r.index)
+        return self._respawn(r, reason="unpark")
+
+    # ------------------------------------------------------------------
+    # lifecycle ops
+    # ------------------------------------------------------------------
+    def kill_replica(self, index, sig=signal.SIGKILL):
+        """Send ``sig`` to replica ``index``'s process group NOW —
+        abrupt death (the supervisor's poll/heartbeat notices and
+        respawns). Operational API and the target of
+        ``FaultInjector.kill_replica_proc``."""
+        r = self._replicas[int(index)]
+        self._event("kill", r.index, sig=int(sig))
+        if r.proc is not None:
+            _kill_group(r.proc, sig)
+        return r
+
+    def stall_replica(self, index, resume_after_s=None):
+        """SIGSTOP replica ``index``'s process group — the
+        heartbeat-stall scenario: the process is alive but
+        unresponsive, which the supervisor must treat as death.
+        ``resume_after_s`` schedules a SIGCONT (a stopped process dies
+        to the supervisor's SIGKILL either way)."""
+        r = self._replicas[int(index)]
+        self._event("stall", r.index, resume_after_s=resume_after_s)
+        if r.proc is not None:
+            _kill_group(r.proc, signal.SIGSTOP)
+            if resume_after_s is not None:
+                proc = r.proc
+                timer = threading.Timer(
+                    float(resume_after_s),
+                    lambda: _kill_group(proc, signal.SIGCONT),
+                )
+                timer.daemon = True
+                timer.start()
+        return r
+
+    def stop_replica(self, index, drain=True, timeout=None):
+        """Graceful stop: SIGTERM (the worker drains and exits 0);
+        SIGKILL the group only past ``timeout`` (default
+        ``drain_timeout_s``). The stop is intentional — no crash-loop
+        strike, no automatic respawn."""
+        r = self._replicas[int(index)]
+        if timeout is None:
+            timeout = self.drain_timeout_s
+        with self._lock:
+            r.intentional_stop = True
+            r.alive = False
+            r.draining = False
+        self._event("stop", r.index, drain=bool(drain))
+        proc = r.proc
+        if proc is not None and proc.poll() is None:
+            _kill_group(proc, signal.SIGTERM if drain else signal.SIGKILL)
+            try:
+                proc.wait(timeout=timeout if drain else 5.0)
+            except subprocess.TimeoutExpired:
+                _kill_group(proc)
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable: abandon (childproc contract)
+        if r.pool is not None:
+            r.pool.close()
+        return r
+
+    def rolling_restart(self):
+        """Drain + respawn one replica at a time: the fleet serves
+        throughout, every replica comes back a fresh process (fresh
+        generation) fully re-registered — zero-downtime worker
+        upgrade. Parked replicas are skipped. Returns the number
+        restarted."""
+        n = 0
+        for r in self._replicas:
+            if r.parked:
+                continue
+            self.stop_replica(r.index, drain=True)
+            if self._respawn(r, reason="rolling_restart"):
+                n += 1
+        self._event("rolling_restart", None, restarted=n)
+        return n
+
+    def close(self, drain=True, timeout=None):
+        """Stop the supervisor, gracefully stop every worker (SIGTERM
+        drain by default; SIGKILL past ``drain_timeout_s``)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop_evt.set()
+        self._supervisor.join(timeout=5.0)
+        for r in self._replicas:
+            if r.proc is not None:
+                try:
+                    self.stop_replica(r.index, drain=drain,
+                                      timeout=timeout)
+                except Exception as exc:
+                    faults.log_suppressed("ProcessReplicaSet.close", exc)
+        self._executor.shutdown(wait=False)
+        self._respawn_exec.shutdown(wait=False)
+        import shutil
+
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Fleet snapshot, schema-matched to ``ReplicaSet.stats()``:
+        router gauges, per-replica entries with the child engine's own
+        stats (fetched over the wire), and the fleet ``by_model``
+        rollup — plus the supervisor's process-level view (pid,
+        parked, queue depth)."""
+        with self._lock:
+            replicas = list(self._replicas)
+            out = {
+                "n_replicas": len(replicas),
+                "requests": self._requests,
+                "published": sorted(self._published),
+                "pending_respawn": [r.index for r in replicas
+                                    if not r.alive and not r.parked],
+                "parked": [r.index for r in replicas if r.parked],
+                "events": [dict(e) for e in self.events],
+            }
+        per = []
+        for r in replicas:
+            ent = {
+                "index": r.index, "alive": r.alive,
+                "generation": r.generation, "routed": r.routed,
+                "pid": r.pid, "parked": r.parked,
+                "queue_depth": r.queue_depth,
+            }
+            ent["engine"] = None
+            if r.alive and r.pool is not None:
+                try:
+                    ent["engine"] = r.pool.request(
+                        "stats", {}, self.heartbeat_timeout_s * 4
+                    )
+                except Exception as exc:
+                    faults.log_suppressed("ProcessReplicaSet.stats", exc)
+            per.append(ent)
+        out["replicas"] = per
+        out["by_model"] = fleet_by_model(per)
+        return out
+
+    def replica(self, index):
+        return self._replicas[int(index)]
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _event(self, kind, index, **extra):
+        with self._lock:
+            self.events.append(
+                dict(kind=kind, replica=index, t=time.time(), **extra)
+            )
+
+    def _tick(self):
+        """Per-request housekeeping: deterministic request ordinal +
+        the injector's process-level plans (kills/stalls due at this
+        ordinal fire BEFORE the request routes, mirroring
+        ``ReplicaSet._tick``)."""
+        with self._lock:
+            ordinal = self._requests
+            self._requests += 1
+        inj = faults.active_injector()
+        kills = getattr(inj, "replica_proc_kills_due", None)
+        if callable(kills):
+            for idx, sig in kills(ordinal):
+                self.kill_replica(idx, sig=sig)
+        stalls = getattr(inj, "replica_proc_stalls_due", None)
+        if callable(stalls):
+            for idx, resume_after_s in stalls(ordinal):
+                self.stall_replica(idx, resume_after_s=resume_after_s)
+        return ordinal
+
+

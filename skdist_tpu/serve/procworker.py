@@ -1,0 +1,191 @@
+"""
+The ProcessReplicaSet worker: one full :class:`ServingEngine` behind a
+unix-domain-socket front door, run as ``python -m
+skdist_tpu.serve.procworker --socket PATH --config JSON``.
+
+The worker is deliberately dumb: it owns no fleet logic. It builds its
+backend and engine from the config, binds the socket, answers frames
+(:mod:`~skdist_tpu.serve.procfleet` wire protocol), heartbeats by
+replying to ``ping``, and dies cleanly on SIGTERM — admissions stop,
+queued flushes drain, exit 0 (the supervisor's graceful-drain
+contract; anything less graceful is the supervisor's SIGKILL).
+Everything interesting — liveness verdicts, respawns, crash-loop
+parking, routing — lives in the parent, which survives this process
+no matter how it dies.
+
+Ops:
+
+- ``ping`` → ``{pid, draining, queue_depth}`` — heartbeat + the load
+  gauge the router's least-loaded pick reads.
+- ``register`` → engine.register with the PARENT-assigned version
+  (fleet-wide ``name@version`` numbering must not depend on which
+  generation of this process is answering).
+- ``request`` → synchronous ``engine.predict(...)``; concurrent
+  connections dispatch concurrently, so the engine's micro-batcher
+  still coalesces across callers inside this process.
+- ``stats`` → ``engine.stats()`` (the parent's fleet rollup input).
+- ``drain`` → ack, then the SIGTERM path (remote graceful stop).
+
+A framing violation (fuzzed/truncated/oversized frame) abandons that
+one connection; the listener and every other connection keep serving.
+"""
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+
+
+def _build_backend(spec):
+    from skdist_tpu.parallel import TPUBackend, resolve_backend
+
+    if spec is None:
+        spec = {"kind": "tpu"}
+    if isinstance(spec, str):
+        spec = {"kind": spec}
+    kind = spec.get("kind", "tpu")
+    if kind == "tpu":
+        return TPUBackend(**(spec.get("kwargs") or {}))
+    if kind == "local":
+        return resolve_backend("local")
+    raise ValueError(f"unknown worker backend kind {kind!r}")
+
+
+def _dispatch(engine, state, op, payload):
+    if op == "ping":
+        return {
+            "pid": os.getpid(),
+            "draining": state["draining"].is_set(),
+            "queue_depth": engine.queue_depth(),
+        }
+    if op == "register":
+        entry = engine.register(
+            payload["name"], payload["model"],
+            methods=tuple(payload.get("methods") or ("predict",)),
+            version=payload.get("version"),
+            serve_dtype=payload.get("serve_dtype", "float32"),
+        )
+        return {"version": entry.version, "spec": entry.spec}
+    if op == "unregister":
+        removed = engine.unregister(
+            payload["name"], version=payload.get("version"),
+        )
+        return {"removed": [e.spec for e in removed]}
+    if op == "request":
+        if state["draining"].is_set():
+            from .batcher import ServingError
+
+            raise ServingError("worker is draining (engine closed soon)")
+        return engine.predict(
+            payload["X"], model=payload.get("model"),
+            method=payload.get("method", "predict"),
+            timeout_s=payload.get("timeout_s"),
+        )
+    if op == "stats":
+        return engine.stats()
+    if op == "drain":
+        state["shutdown"]()
+        return {"draining": True}
+    raise ValueError(f"unknown op {op!r}")
+
+
+def _serve_conn(engine, state, conn):
+    from .procfleet import (
+        FrameTooLarge, WireError, encode_error, recv_frame, send_frame,
+    )
+
+    with conn:
+        while True:
+            try:
+                frame = recv_frame(conn)
+            except WireError:
+                return  # fuzzed/closed stream: abandon this connection
+            try:
+                if (not isinstance(frame, tuple) or len(frame) != 2
+                        or not isinstance(frame[0], str)):
+                    raise ValueError("malformed frame: want (op, payload)")
+                op, payload = frame
+                reply = {"ok": True,
+                         "value": _dispatch(engine, state, op, payload)}
+            except Exception as exc:  # noqa: BLE001 - crosses the wire
+                reply = encode_error(exc)
+            try:
+                send_frame(conn, reply)
+            except FrameTooLarge as exc:
+                # the RESULT outgrew the wire bound: tell the caller
+                # (a small typed error frame) instead of abandoning
+                # the connection and reading as a dead replica
+                try:
+                    send_frame(conn, encode_error(exc))
+                except (OSError, WireError):
+                    return
+            except (OSError, WireError):
+                return
+
+
+def serve_forever(engine, sock_path):
+    """Bind the front door and serve until SIGTERM / ``drain``; then
+    stop admissions, drain the engine, exit 0."""
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+    listener.bind(sock_path)
+    listener.listen(64)
+    draining = threading.Event()
+
+    def shutdown():
+        draining.set()
+        try:
+            # closing the listener unblocks accept(); in-flight
+            # connections finish their current frames
+            listener.close()
+        except OSError:
+            pass
+
+    state = {"draining": draining, "shutdown": shutdown}
+    signal.signal(signal.SIGTERM, lambda signum, frame: shutdown())
+    while not draining.is_set():
+        try:
+            conn, _addr = listener.accept()
+        except OSError:
+            break
+        threading.Thread(
+            target=_serve_conn, args=(engine, state, conn),
+            daemon=True, name="skdist-procworker-conn",
+        ).start()
+    engine.close(drain=True)
+    try:
+        os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="skdist_tpu.serve.procworker")
+    parser.add_argument("--socket", required=True)
+    parser.add_argument("--config", default="{}")
+    args = parser.parse_args(argv)
+    cfg = json.loads(args.config or "{}")
+    if cfg.get("artifact_dir"):
+        from skdist_tpu.parallel.compile_cache import enable_disk_cache
+
+        enable_disk_cache(cfg["artifact_dir"])
+    backend = _build_backend(cfg.get("backend"))
+    from skdist_tpu.serve.engine import ServingEngine
+
+    engine = ServingEngine(backend=backend, **(cfg.get("engine") or {}))
+    if cfg.get("replica") is not None:
+        # the fleet index rides the worker's OWN telemetry registry, so
+        # its Prometheus exposition splits by replica like ReplicaSet's
+        engine._stats.set_label(replica=str(cfg["replica"]))
+    return serve_forever(engine, args.socket)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -399,19 +399,7 @@ class ReplicaSet:
                 ent["engine"] = None
             per.append(ent)
         out["replicas"] = per
-        # fleet-level per-model (name@version) rollup: sum the
-        # replicas' by_model splits — the per-tenant view a router
-        # dashboard reads without walking every replica itself
-        by_model = {}
-        for ent in per:
-            eng = ent.get("engine") or {}
-            for spec, cell in (eng.get("by_model") or {}).items():
-                agg = by_model.setdefault(
-                    spec, {"requests": 0, "completed": 0}
-                )
-                agg["requests"] += cell.get("requests", 0)
-                agg["completed"] += cell.get("completed", 0)
-        out["by_model"] = by_model
+        out["by_model"] = fleet_by_model(per)
         return out
 
     def replica(self, index):
@@ -509,6 +497,25 @@ class ReplicaSet:
                 fault_kind=faults.classify(exc),
             )
         return True
+
+
+def fleet_by_model(per_replica_entries):
+    """Fleet-level per-model (``name@version``) rollup: sum the
+    replicas' ``by_model`` splits — the per-tenant view a router
+    dashboard reads without walking every replica itself. Shared by
+    :class:`ReplicaSet` and the process fleet
+    (``serve.procfleet.ProcessReplicaSet``), whose ``stats()`` schemas
+    must stay interchangeable."""
+    by_model = {}
+    for ent in per_replica_entries:
+        eng = ent.get("engine") or {}
+        for spec, cell in (eng.get("by_model") or {}).items():
+            agg = by_model.setdefault(
+                spec, {"requests": 0, "completed": 0}
+            )
+            agg["requests"] += cell.get("requests", 0)
+            agg["completed"] += cell.get("completed", 0)
+    return by_model
 
 
 def _bind_replica_label(replica):

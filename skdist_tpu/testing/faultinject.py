@@ -94,6 +94,13 @@ class FaultInjector:
         # replica scenarios: request ordinal -> [replica indices] the
         # router must kill BEFORE dispatching that request
         self._replica_kills = {}
+        # process-fleet scenarios (ProcessReplicaSet): request ordinal
+        # -> [(replica, sig)] killed / [(replica, resume_after_s)]
+        # SIGSTOPped before that request routes
+        self._replica_proc_kills = {}
+        self._replica_proc_stalls = {}
+        # optional heartbeat probe driving lost_participants()
+        self._hb_probe = None
 
     # ------------------------------------------------------------------
     # plan construction
@@ -151,6 +158,42 @@ class FaultInjector:
         self._replica_kills.setdefault(int(at_request), []).append(
             int(replica)
         )
+        return self
+
+    def kill_replica_proc(self, replica, at_request, sig=signal.SIGKILL):
+        """Kill a SPECIFIC serving replica PROCESS: when a
+        ``ProcessReplicaSet`` router dispatches its ``at_request``-th
+        request (0-based), replica ``replica``'s process group gets
+        ``sig`` (default SIGKILL — the abrupt-death scenario a
+        supervised fleet must absorb: queued futures on that replica
+        fail, failover re-routes, the supervisor respawns). The
+        process-boundary rendition of :meth:`kill_replica`."""
+        self._replica_proc_kills.setdefault(int(at_request), []).append(
+            (int(replica), int(sig))
+        )
+        return self
+
+    def stall_replica_proc(self, replica, at_request,
+                           resume_after_s=None):
+        """SIGSTOP a replica process at request ordinal ``at_request``
+        — the heartbeat-stall scenario: the process exists but answers
+        nothing, so the supervisor must declare it dead on missed
+        beats and SIGKILL+respawn it. ``resume_after_s`` schedules a
+        SIGCONT (a stopped process dies to SIGKILL regardless)."""
+        self._replica_proc_stalls.setdefault(int(at_request), []).append(
+            (int(replica),
+             None if resume_after_s is None else float(resume_after_s))
+        )
+        return self
+
+    def with_heartbeat_probe(self, probe):
+        """Drive :meth:`lost_participants` from a heartbeat probe (e.g.
+        :class:`~skdist_tpu.parallel.mesh.HeartbeatFileProbe`): the
+        probe's stale participants report lost IN ADDITION to any
+        :meth:`on_host` plan — so elastic tests can express participant
+        loss purely as "its heartbeat file went stale", the same signal
+        production probes read."""
+        self._hb_probe = probe
         return self
 
     # ------------------------------------------------------------------
@@ -234,10 +277,14 @@ class FaultInjector:
         are the clock, so "capacity returns after N more rounds" is
         exact and replayable."""
         with self._lock:
-            return {
+            lost = {
                 p for p, restore_at in self._lost.items()
                 if restore_at is None or self._count < restore_at
             }
+            probe = self._hb_probe
+        if probe is not None:
+            lost = lost | set(probe())
+        return lost
 
     def replica_kills_due(self, request_ordinal):
         """Replica indices the router must kill before dispatching its
@@ -249,6 +296,30 @@ class FaultInjector:
             for i in due:
                 self.fired.append(
                     (int(request_ordinal), f"kill_replica:{i}")
+                )
+            return due
+
+    def replica_proc_kills_due(self, request_ordinal):
+        """``(replica, sig)`` pairs the ``ProcessReplicaSet`` router
+        must signal before dispatching its ``request_ordinal``-th
+        request (consumed; fired as ``kill_replica_proc:<i>``)."""
+        with self._lock:
+            due = self._replica_proc_kills.pop(int(request_ordinal), [])
+            for i, _sig in due:
+                self.fired.append(
+                    (int(request_ordinal), f"kill_replica_proc:{i}")
+                )
+            return due
+
+    def replica_proc_stalls_due(self, request_ordinal):
+        """``(replica, resume_after_s)`` pairs to SIGSTOP before
+        dispatching that request (consumed; fired as
+        ``stall_replica_proc:<i>``)."""
+        with self._lock:
+            due = self._replica_proc_stalls.pop(int(request_ordinal), [])
+            for i, _resume in due:
+                self.fired.append(
+                    (int(request_ordinal), f"stall_replica_proc:{i}")
                 )
             return due
 

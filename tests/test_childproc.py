@@ -52,3 +52,33 @@ def test_no_capture_mode():
         _py("pass"), timeout=30, capture=False
     )
     assert status == "ok" and out is None
+
+
+def test_stderr_captured_with_stdout():
+    """A crashing child's traceback (stderr) must survive containment
+    — capture merges stderr into the stdout pipe (the round-13
+    satellite: tracebacks used to vanish)."""
+    status, rc, out = run_child_with_deadline(
+        _py("import sys; print('out-line'); "
+            "sys.stderr.write('err-line\\n'); "
+            "raise RuntimeError('child exploded')"),
+        timeout=30,
+    )
+    assert status == "error" and rc == 1
+    assert "out-line" in out
+    assert "err-line" in out
+    assert "child exploded" in out  # the traceback itself
+
+
+def test_timeout_returncode_contract():
+    """A killed-within-bounds child reports its signal returncode; the
+    docstring pins the abandoned-unkillable case to an EXPLICIT None
+    (no stale value)."""
+    status, rc, out = run_child_with_deadline(
+        _py("import time; print('alive', flush=True); time.sleep(60)"),
+        timeout=3, kill_wait=10,
+    )
+    assert status == "timeout"
+    # killed and reaped inside kill_wait: the SIGKILL returncode
+    assert rc is not None and rc < 0
+    assert "alive" in out

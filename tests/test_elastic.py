@@ -632,3 +632,218 @@ class TestReplicaSet:
                 assert out.shape == (2,)
             assert faults.snapshot()["replica_respawns"] >= 1
             assert rs.replica(0).generation >= 1
+
+
+# ---------------------------------------------------------------------------
+# production preemption probes + epoch agreement (PR 12)
+# ---------------------------------------------------------------------------
+
+from skdist_tpu.parallel.mesh import (  # noqa: E402 - grouped with its tests
+    HeartbeatFileProbe,
+    KVStoreHeartbeatProbe,
+    MaintenanceEventProbe,
+    combine_probes,
+)
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestProbes:
+    def test_heartbeat_file_probe_beat_and_stale(self, tmp_path):
+        clock = _FakeClock()
+        probe = HeartbeatFileProbe(tmp_path / "hb", participants=[0, 1],
+                                   stale_s=10.0, clock=clock)
+        # nothing ever beat: both lost (a worker that never came up)
+        assert probe() == {0, 1}
+        probe.beat(0)
+        probe.beat(1)
+        assert probe() == set()
+        clock.t += 11.0
+        probe.beat(1)  # participant 1 keeps beating, 0 goes silent
+        assert probe() == {0}
+
+    def test_kv_probe_without_cluster_reports_all_lost(self):
+        probe = KVStoreHeartbeatProbe(participants=[0, 1], stale_s=5.0)
+        # no jax.distributed cluster in the test process: no liveness
+        # signal exists, so everyone reads as lost (fail-safe)
+        assert probe() == {0, 1}
+
+    def test_maintenance_event_probe_holds_reports(self):
+        clock = _FakeClock()
+        notices = []
+        probe = MaintenanceEventProbe(lambda: notices, hold_s=30.0,
+                                      clock=clock)
+        assert probe() == set()
+        notices.append(1)
+        assert probe() == {1}
+        notices.clear()
+        clock.t += 15.0
+        assert probe() == {1}  # held past the one-shot notice
+        clock.t += 20.0
+        assert probe() == set()  # hold expired: presumed back
+
+    def test_combine_probes_unions(self, tmp_path):
+        clock = _FakeClock()
+        hb = HeartbeatFileProbe(tmp_path / "hb", participants=[0, 1],
+                                stale_s=10.0, clock=clock)
+        hb.beat(0)
+        hb.beat(1)
+        maint = MaintenanceEventProbe(lambda: [1], hold_s=60.0,
+                                      clock=clock)
+        combined = combine_probes(hb, maint)
+        assert combined() == {1}
+        clock.t += 11.0
+        assert combined() == {0, 1}
+
+    def test_injector_heartbeat_probe_leg(self, tmp_path):
+        """FaultInjector.with_heartbeat_probe: lost_participants()
+        reports the probe's stale participants next to the on_host
+        plan — heartbeat-driven loss is expressible without raises."""
+        clock = _FakeClock()
+        hb = HeartbeatFileProbe(tmp_path / "hb", participants=[0, 1],
+                                stale_s=10.0, clock=clock)
+        hb.beat(0)
+        hb.beat(1)
+        inj = FaultInjector().with_heartbeat_probe(hb)
+        assert inj.lost_participants() == set()
+        clock.t += 11.0
+        hb.beat(0)
+        assert inj.lost_participants() == {1}
+
+    def test_manager_shrinks_on_heartbeat_probe(self, tmp_path):
+        """An ElasticMeshManager wired to a HeartbeatFileProbe shrinks
+        around the participant whose file went stale — the production
+        probe driving the same geometry the injector scenarios pin."""
+        clock = _FakeClock()
+        gs = _half_groups()
+        probe = HeartbeatFileProbe(tmp_path / "hb", participants=[0, 1],
+                                   stale_s=10.0, clock=clock)
+        probe.beat(0)
+        probe.beat(1)
+        mgr = ElasticMeshManager(group_size=gs, probe=probe,
+                                 heartbeat=probe)
+        assert mgr.on_preempted() is None  # everyone beating: no change
+        clock.t += 11.0
+        probe.beat(1)  # participant 0 went silent
+        mesh = mgr.on_preempted()
+        assert mesh is not None and mgr.degraded
+        assert all(d.id >= gs for d in mesh.devices.flat)
+        probe.beat(0)  # capacity back: next boundary regrows
+        assert mgr.maybe_regrow() is not None
+        assert not mgr.degraded
+
+
+class _FakeKVClient:
+    """Dict-backed stand-in for the jax.distributed KV client."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key not in self.store:
+            raise RuntimeError(f"DEADLINE_EXCEEDED: {key}")
+        return self.store[key]
+
+
+class TestEpochAgreement:
+    def _process_manager(self, monkeypatch, kv):
+        """A manager whose roster is FORCED to look process-partitioned
+        (participants {0, 1}, this process = 0 owning every device) so
+        the agreement protocol is unit-testable in one process."""
+        from skdist_tpu.parallel import mesh as mesh_mod
+
+        monkeypatch.setattr(mesh_mod, "_kv_client", lambda: kv)
+        mgr = ElasticMeshManager(group_size=len(jax.devices()),
+                                 coordinate=True, agree_timeout_s=0.05)
+        mgr._by_process = True
+        mgr._pid_of = {id(d): 0 for d in mgr.full_devices}
+        mgr.participant_ids = [0, 1]
+        return mgr
+
+    def test_silent_peer_declared_lost_and_prefix_kept(self,
+                                                       monkeypatch):
+        kv = _FakeKVClient()
+        mgr = self._process_manager(monkeypatch, kv)
+        assert mgr.can_coordinate
+        agreed, mesh = mgr.coordinated_resume(16)
+        assert agreed == 16
+        # peer 1 never published: declared lost; survivors keep the
+        # full extent (participant 1 owned no devices in this forced
+        # roster, so the mesh itself is unchanged)
+        ev = [e for e in mgr.events if e["kind"] == "epoch_agreement"]
+        assert len(ev) == 1
+        assert ev[0]["survivors"] == [0] and ev[0]["lost"] == [1]
+        assert ev[0]["epoch"] == 1
+        assert mesh is None
+        assert faults.snapshot()["elastic_epoch_agreements"] == 1
+        # this process's prefix landed in the store for peers to read
+        key = [k for k in kv.store if k.endswith("/p0")][0]
+        assert "16" in kv.store[key]
+
+    def test_responding_peer_min_prefix_no_loss(self, monkeypatch):
+        import json as json_mod
+
+        kv = _FakeKVClient()
+        # peer 1 already published a SHORTER prefix for epoch 1
+        kv.store["skdist-elastic/e1/p1"] = json_mod.dumps({"prefix": 8})
+        mgr = self._process_manager(monkeypatch, kv)
+        agreed, mesh = mgr.coordinated_resume(16)
+        # everyone responded: nobody lost, resume from the MIN prefix
+        assert agreed == 8
+        assert mesh is None
+        ev = [e for e in mgr.events if e["kind"] == "epoch_agreement"]
+        assert ev[0]["survivors"] == [0, 1] and ev[0]["lost"] == []
+
+    def test_epochs_advance_per_agreement(self, monkeypatch):
+        kv = _FakeKVClient()
+        mgr = self._process_manager(monkeypatch, kv)
+        mgr.coordinated_resume(8)
+        mgr.coordinated_resume(24)
+        eps = [e["epoch"] for e in mgr.events
+               if e["kind"] == "epoch_agreement"]
+        assert eps == [1, 2]
+        # distinct epochs namespace distinct keys — a stale epoch-1
+        # prefix can never satisfy an epoch-2 read
+        assert {k for k in kv.store} == {
+            "skdist-elastic/e1/p0", "skdist-elastic/e2/p0",
+        }
+
+    def test_coordinated_lost_blocks_regrow_without_probe(self,
+                                                          monkeypatch):
+        """A process an agreement declared lost stays lost (no regrow
+        into a dead collective) until an operator probe reports it
+        back."""
+        kv = _FakeKVClient()
+        mgr = self._process_manager(monkeypatch, kv)
+        mgr.coordinated_resume(16)
+        assert mgr._probe_lost() == {1}
+        # an operator probe is authoritative: it reports 1 back
+        mgr._probe = lambda: set()
+        assert mgr._probe_lost() == set()
+
+    def test_can_coordinate_requires_process_roster(self):
+        mgr = ElasticMeshManager(group_size=_half_groups())
+        assert not mgr.can_coordinate  # single-controller roster
+
+
+def test_truncate_rounds_prefix():
+    from skdist_tpu.parallel.backend import _truncate_rounds
+
+    rounds = [{"s": np.arange(8)}, {"s": np.arange(8, 16)}]
+    out, kept = _truncate_rounds(rounds, 12)
+    assert kept == 12
+    got = np.concatenate([r["s"] for r in out])
+    np.testing.assert_array_equal(got, np.arange(12))
+    out, kept = _truncate_rounds(rounds, 8)
+    assert kept == 8 and len(out) == 1
+    out, kept = _truncate_rounds(rounds, 0)
+    assert kept == 0 and out == []

@@ -1,0 +1,429 @@
+"""Process fault domains: the supervised multi-process serving fleet
+(serve.procfleet) and the coordinated multi-process elastic resume.
+
+The heavy legs (worker processes import jax) are consolidated into few
+tests so the suite pays the interpreter+jax cold start a bounded
+number of times; the wire protocol, error mapping, crash-loop
+parking, and injector plans are unit-tested with cheap fake workers.
+"""
+
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from skdist_tpu.parallel import faults
+from skdist_tpu.serve import AllReplicasUnhealthy, ProcessReplicaSet
+from skdist_tpu.serve.batcher import DeadlineExceeded, Overloaded
+from skdist_tpu.serve.procfleet import (
+    ReplicaConnectionError,
+    ReplicaError,
+    WireError,
+    decode_error,
+    encode_error,
+    recv_frame,
+    send_frame,
+)
+from skdist_tpu.testing.faultinject import FaultInjector
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+SMOKE = os.path.join(REPO, "build_tools", "procfleet_smoke.py")
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+def test_wire_frame_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        payload = {"op": "x", "arr": np.arange(6).reshape(2, 3)}
+        send_frame(a, payload)
+        got = recv_frame(b)
+        assert got["op"] == "x"
+        np.testing.assert_array_equal(got["arr"], payload["arr"])
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_eof_mid_frame_raises():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">I", 100) + b"short")
+        a.close()
+        with pytest.raises(WireError, match="closed mid-frame"):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_wire_oversized_length_rejected():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">I", (1 << 30) + 1))
+        with pytest.raises(WireError, match="exceeds"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_garbage_payload_raises():
+    a, b = socket.socketpair()
+    try:
+        junk = b"\x00\xff\xde\xad\xbe\xef garbage"
+        a.sendall(struct.pack(">I", len(junk)) + junk)
+        with pytest.raises(WireError, match="undecodable"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversized_send_is_request_owned(monkeypatch):
+    """A locally-built over-bound frame is a ValueError (request-owned
+    — surfaces to the caller), NOT a WireError (transport death — one
+    oversized request must not get healthy replicas serially
+    killed)."""
+    from skdist_tpu.serve import procfleet
+    from skdist_tpu.serve.procfleet import FrameTooLarge
+
+    monkeypatch.setattr(procfleet, "MAX_FRAME_BYTES", 64)
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(FrameTooLarge, match="batch_predict"):
+            procfleet.send_frame(a, {"X": np.zeros(1024)})
+        assert issubclass(FrameTooLarge, ValueError)
+        assert not issubclass(FrameTooLarge, WireError)
+        # and it decodes typed across the wire (a worker-side raise)
+        back = decode_error(encode_error(FrameTooLarge("too big")))
+        assert isinstance(back, FrameTooLarge)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_error_mapping_typed_and_unknown():
+    for exc in (ValueError("bad width"), TypeError("nope"),
+                Overloaded("queue full"), DeadlineExceeded("late"),
+                faults.WatchdogTimeout("budget")):
+        back = decode_error(encode_error(exc))
+        assert type(back) is type(exc)
+        assert str(exc) in str(back)
+    # an exception type the parent does not know becomes a
+    # failover-worthy ReplicaError carrying the name
+    class Weird(Exception):
+        pass
+
+    back = decode_error(encode_error(Weird("boom")))
+    assert isinstance(back, ReplicaError)
+    assert "Weird" in str(back) and "boom" in str(back)
+
+
+def test_injector_proc_plans_pop_once_and_record():
+    inj = FaultInjector().kill_replica_proc(1, at_request=5)
+    inj.stall_replica_proc(0, at_request=7, resume_after_s=1.5)
+    assert inj.replica_proc_kills_due(4) == []
+    assert inj.replica_proc_kills_due(5) == [(1, int(signal.SIGKILL))]
+    assert inj.replica_proc_kills_due(5) == []  # consumed
+    assert inj.replica_proc_stalls_due(7) == [(0, 1.5)]
+    assert (5, "kill_replica_proc:1") in inj.fired
+    assert (7, "stall_replica_proc:0") in inj.fired
+
+
+# ---------------------------------------------------------------------------
+# crash-loop parking (cheap: the worker is a plain `exit 3` child)
+# ---------------------------------------------------------------------------
+
+def _crashing_argv(index, sock_path, cfg):
+    return [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+
+def test_crash_loop_parks_and_whole_fleet_unhealthy():
+    faults.reset_stats()
+    fleet = ProcessReplicaSet(
+        n_replicas=1, worker_argv=_crashing_argv,
+        spawn_timeout_s=10.0, respawn_backoff_s=0.01,
+        crash_loop_threshold=2, crash_loop_window_s=60.0,
+        heartbeat_interval_s=0.05, unhealthy_wait_s=0.2,
+    )
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if fleet.replica(0).parked:
+                break
+            time.sleep(0.05)
+        assert fleet.replica(0).parked, fleet.events
+        snap = faults.snapshot()
+        assert snap["crash_loop_parks"] >= 1
+        st = fleet.stats()
+        assert st["parked"] == [0]
+        assert any(e["kind"] == "parked" for e in st["events"])
+        with pytest.raises(AllReplicasUnhealthy, match="parked"):
+            fleet.predict(np.zeros((1, 4), np.float32), model="m")
+    finally:
+        fleet.close()
+
+
+def test_spawn_failure_logged_with_log_path():
+    """A worker that dies at startup leaves a dead-event naming the
+    reason; its stdout+stderr land in the per-replica log file."""
+    def argv(index, sock_path, cfg):
+        return [sys.executable, "-c",
+                "import sys; print('exploding'); "
+                "sys.stderr.write('BOOM\\n'); sys.exit(7)"]
+
+    fleet = ProcessReplicaSet(
+        n_replicas=1, worker_argv=argv, spawn_timeout_s=10.0,
+        respawn_backoff_s=5.0, crash_loop_threshold=99,
+        heartbeat_interval_s=0.05,
+    )
+    try:
+        r = fleet.replica(0)
+        assert not r.alive
+        assert "rc=7" in (r.death_reason or "")
+        with open(r.log_path) as fh:
+            log = fh.read()
+        assert "exploding" in log and "BOOM" in log
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# the real fleet (worker processes run full ServingEngines)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    from skdist_tpu.models import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.6, size=(60, 6)) for c in (-1.5, 1.5)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1], 60)
+    return LogisticRegression(max_iter=20, engine="xla").fit(X, y), X
+
+
+def test_fleet_kill_failover_respawn_and_drain(fitted_model, tmp_path):
+    """The consolidated process-fleet integration: SIGKILL a replica
+    PROCESS mid-traffic -> zero failed requests; the supervisor
+    respawns it (fresh generation, re-registered, serves); a fuzzed
+    front-door connection cannot hurt the worker; stats() matches the
+    ReplicaSet fleet schema; close(drain=True) exits workers 0."""
+    model, X = fitted_model
+    faults.reset_stats()
+    with ProcessReplicaSet(
+        n_replicas=2,
+        artifact_dir=str(tmp_path / "aot"),
+        engine_kwargs={"max_batch_rows": 32, "max_delay_ms": 1.0},
+        heartbeat_interval_s=0.2, respawn_backoff_s=0.05,
+    ) as fleet:
+        version = fleet.rollout("clf", model, methods=("predict",))
+        assert version == 1
+
+        errors = []
+        ok = [0]
+        lock = threading.Lock()
+
+        def worker(tid):
+            rng = np.random.RandomState(tid)
+            for _ in range(15):
+                x = rng.normal(size=(2, X.shape[1])).astype(np.float32)
+                try:
+                    out = fleet.predict(x, model="clf", timeout_s=30.0)
+                    assert out.shape[0] == 2
+                    with lock:
+                        ok[0] += 1
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(exc))
+
+        inj = FaultInjector().kill_replica_proc(1, at_request=10)
+        with inj:
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert (10, "kill_replica_proc:1") in inj.fired
+        assert not errors and ok[0] == 60, errors[:3]
+
+        # the supervisor respawns the killed process (bounded wait)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if fleet.replica(1).alive:
+                break
+            time.sleep(0.1)
+        r1 = fleet.replica(1)
+        assert r1.alive and r1.generation >= 2
+        assert faults.snapshot()["replica_proc_restarts"] >= 1
+        assert any(e["kind"] == "respawn" and e["replica"] == 1
+                   for e in fleet.events)
+
+        # request-owned verdicts surface (same exception type as the
+        # in-process fleet): wrong width -> ValueError, no failover
+        with pytest.raises(ValueError):
+            fleet.predict(np.zeros((1, X.shape[1] + 3), np.float32),
+                          model="clf", timeout_s=20.0)
+
+        # framing fuzz against a LIVE worker's front door: garbage
+        # bytes abandon that connection, the worker keeps serving
+        sock_path = r1.socket_path
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(sock_path)
+        s.sendall(b"\xff\xff\xff\xff total garbage not a frame")
+        s.close()
+        out = fleet.predict(X[:3], model="clf", timeout_s=30.0)
+        assert out.shape == (3,)
+
+        # respawned replica provably serves: route until it completes
+        deadline = time.monotonic() + 20.0
+        served = 0
+        while time.monotonic() < deadline and served == 0:
+            fleet.predict(X[:2], model="clf", timeout_s=30.0)
+            ent = fleet.stats()["replicas"][1]
+            served = (ent["engine"] or {}).get("completed", 0)
+        assert served > 0
+
+        # fleet schema parity with ReplicaSet.stats()
+        st = fleet.stats()
+        for key in ("n_replicas", "requests", "published",
+                    "pending_respawn", "events", "replicas", "by_model"):
+            assert key in st
+        assert st["published"] == ["clf"]
+        assert "clf@1" in st["by_model"]
+        assert st["by_model"]["clf@1"]["completed"] > 0
+        for ent in st["replicas"]:
+            assert {"index", "alive", "generation", "routed",
+                    "engine"} <= set(ent)
+        procs = [fleet.replica(i).proc for i in range(2)]
+    # context exit = close(drain=True): SIGTERM drain, workers exit 0
+    for p in procs:
+        assert p.poll() == 0, f"worker rc={p.poll()}"
+
+
+def test_heartbeat_stall_declares_dead_and_respawns(fitted_model,
+                                                    tmp_path):
+    """SIGSTOP (heartbeat stall) via the injector: the process exists
+    but answers nothing — the supervisor must count misses, declare
+    it dead, SIGKILL the group, and respawn. The replica serves again
+    afterwards."""
+    model, X = fitted_model
+    faults.reset_stats()
+    with ProcessReplicaSet(
+        n_replicas=1,
+        engine_kwargs={"max_batch_rows": 32, "max_delay_ms": 1.0},
+        heartbeat_interval_s=0.2, heartbeat_timeout_s=0.5,
+        miss_threshold=2, respawn_backoff_s=0.05,
+        unhealthy_wait_s=45.0,
+    ) as fleet:
+        fleet.rollout("clf", model, methods=("predict",))
+        gen0 = fleet.replica(0).generation
+        inj = FaultInjector().stall_replica_proc(0, at_request=1)
+        with inj:
+            fleet.predict(X[:2], model="clf", timeout_s=30.0)  # req 0
+            # request 1 triggers the stall BEFORE routing; the routed
+            # request then rides failover/unhealthy-wait until the
+            # supervisor has respawned the worker
+            out = fleet.predict(X[:2], model="clf", timeout_s=40.0)
+            assert out.shape == (2,)
+        assert (1, "stall_replica_proc:0") in inj.fired
+        snap = faults.snapshot()
+        assert snap["heartbeat_misses"] >= 2
+        assert snap["replica_proc_restarts"] >= 1
+        r = fleet.replica(0)
+        assert r.alive and r.generation > gen0
+        assert any(e["kind"] == "dead" and "heartbeat" in e["reason"]
+                   for e in fleet.events)
+
+
+def test_rolling_restart_under_load(fitted_model, tmp_path):
+    """rolling_restart(): one replica at a time drains and comes back
+    a fresh generation while the fleet keeps serving — zero failed
+    requests throughout."""
+    model, X = fitted_model
+    with ProcessReplicaSet(
+        n_replicas=2,
+        artifact_dir=str(tmp_path / "aot"),
+        engine_kwargs={"max_batch_rows": 32, "max_delay_ms": 1.0},
+        heartbeat_interval_s=0.2,
+    ) as fleet:
+        fleet.rollout("clf", model, methods=("predict",))
+        gens = [fleet.replica(i).generation for i in range(2)]
+        errors = []
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    fleet.predict(X[:2], model="clf", timeout_s=30.0)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+
+        t = threading.Thread(target=load)
+        t.start()
+        try:
+            restarted = fleet.rolling_restart()
+        finally:
+            stop.set()
+            t.join()
+        assert restarted == 2
+        assert not errors, errors[:3]
+        for i in range(2):
+            r = fleet.replica(i)
+            assert r.alive and r.generation == gens[i] + 1
+        # restarted workers are re-registered and serve
+        out = fleet.predict(X[:4], model="clf", timeout_s=30.0)
+        assert out.shape == (4,)
+        # regression (review finding): a REAL crash right after a
+        # rolling restart must still respawn — the intentional-stop
+        # flag from the restart must not linger and absorb it
+        fleet.kill_replica(0)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if fleet.replica(0).alive and \
+                    fleet.replica(0).generation == gens[0] + 2:
+                break
+            time.sleep(0.1)
+        assert fleet.replica(0).alive
+        assert fleet.replica(0).generation == gens[0] + 2
+
+
+# ---------------------------------------------------------------------------
+# 2-process gloo elastic resume (epoch agreement)
+# ---------------------------------------------------------------------------
+
+def test_two_process_elastic_epoch_agreement():
+    """Mid-search participant loss on a 2-process gloo mesh resumes
+    via epoch agreement — cv parity bitwise vs un-preempted, >=50%
+    of tasks salvaged, no full restart (the procfleet smoke's elastic
+    leg, run as the gate)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # children pin their own device count
+    proc = subprocess.run(
+        [sys.executable, SMOKE, "--elastic-only"],
+        env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, (
+        proc.stdout[-3000:] + proc.stderr[-1000:]
+    )
+    assert "PASS" in proc.stdout
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("REPORT ")][-1]
+    report = json.loads(line[len("REPORT "):])
+    el = report["elastic_2proc"]
+    assert el["cv_parity_bitwise"] is True
+    assert el["epoch_agreements"] == 1
+    assert el["shrinks"] == 1
+    assert el["salvaged"] >= 16
