@@ -1815,6 +1815,48 @@ def _gbdt_main(quick=False):
     return payload
 
 
+def multitenant_aux(quick=False):
+    """Measured readout of multi-tenant banked serving: a ≥1000-tenant
+    (200 under ``quick``) single-bank catalog's aggregate throughput
+    vs per-model dispatch, paced equal-QPS p99 vs single-model
+    serving, byte parity, registration rate, bank occupancy/residency,
+    and the compile invariant — the evidence behind the multitenant
+    smoke's gates. Best-effort: a dict with "error" on any failure."""
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+        ))
+        from bench_multitenant import run_multitenant_bench
+
+        return run_multitenant_bench(
+            n_models=200 if quick else 1000,
+            requests_per_client=80 if quick else 150,
+        )
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _multitenant_main(quick=False):
+    """Standalone capture of the multi-tenant banked-serving readout →
+    ``BENCH_multitenant_r14.json`` (banked vs per-model aggregate
+    throughput, paced p99 ratio, tenants-per-flush histogram, bank
+    occupancy/residency, parity + compile invariants)."""
+    import jax
+
+    payload = {
+        "metric": "multitenant_banked_serving",
+        "aux": multitenant_aux(quick=quick),
+        "platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(payload, indent=1), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_multitenant_r14.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
 def _obs_main(quick=True):
     """Standalone capture of the telemetry-plane readout →
     ``BENCH_obs_r13.json`` (tracing off/on warm walls + overhead
@@ -1854,5 +1896,7 @@ if __name__ == "__main__":
         _streaming_main(quick="--quick" in sys.argv)
     elif "--kernels" in sys.argv:
         _kernels_main(quick="--quick" in sys.argv)
+    elif "--multitenant" in sys.argv:
+        _multitenant_main(quick="--quick" in sys.argv)
     else:
         main(quick="--quick" in sys.argv)

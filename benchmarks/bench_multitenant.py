@@ -1,0 +1,337 @@
+"""
+Multi-tenant banked-serving benchmark: a ≥1000-model catalog on one
+mesh vs per-model dispatch.
+
+The workload models the production shape of "millions of users": not
+one model at high QPS but a huge catalog of small same-family models
+(per-country / per-category / per-experiment) sharing one device mesh.
+Four legs:
+
+- **banked**: one ``ServingEngine(bank_models=True)`` holding the full
+  catalog (default 1000 tenants, one parameter bank); N client threads
+  fire async windows of single-digit-row requests at uniformly random
+  tenants. Aggregate requests/s is the headline.
+- **per-model baseline**: the same engine WITHOUT banking, over a
+  subset of the catalog (default 64 tenants — per-model dispatch pays
+  two threads and a private flush per tenant, so the full 1000 would
+  drown the host in dispatch threads; the subset baseline is therefore
+  GENEROUS to per-model dispatch). Same client count, same request
+  shapes, same async window.
+- **single-model reference**: one tenant, same load pattern — the p99
+  yardstick ("within 2x of single-model serving").
+- **parity**: a sample of tenants scored through both engines;
+  outputs must match byte-for-byte.
+
+Output: one JSON dict with both throughputs, the multiple, p99s,
+tenants-per-flush evidence, bank occupancy, registration wall, and
+``compiles_after_warmup`` (must be 0 after the banked load).
+
+Usage:
+    python benchmarks/bench_multitenant.py [--models 1000] [--clients 8]
+                                           [--requests 250] [--window 32]
+"""
+
+import argparse
+import copy
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def make_catalog(n_models, n_features=16, seed=7):
+    """One fitted template + ``n_models`` perturbed tenants (distinct
+    coefficients, identical shapes/meta — one bank group)."""
+    from skdist_tpu.models import LogisticRegression
+
+    rng = np.random.RandomState(seed)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.8, size=(120, n_features))
+        for c in (-1.2, 1.2)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1], 120)
+    base = LogisticRegression(max_iter=30).fit(X, y)
+    w = np.asarray(base._params["W"])
+    tenants = []
+    for i in range(n_models):
+        m = copy.deepcopy(base)
+        m._params = dict(m._params)
+        m._params["W"] = (w * (1.0 + 0.001 * (i % 997))).astype(w.dtype)
+        tenants.append(m)
+    return base, tenants, X
+
+
+def _async_load(engine, Xs, model_names, clients, requests_per_client,
+                window, seed=1000, method="predict_proba"):
+    """Closed-window async load: each client keeps ``window`` requests
+    in flight (submit, then harvest the window) so throughput measures
+    the engine's batching capacity, not the client's round-trip clock.
+    Returns (wall_s, latencies, errors)."""
+    lat = []
+    errors = []
+    lock = threading.Lock()
+
+    def client(cid):
+        r = np.random.RandomState(seed + cid)
+        my_lat = []
+        pending = []
+        fired = 0
+        while fired < requests_per_client:
+            while len(pending) < window and fired < requests_per_client:
+                name = model_names[int(r.randint(0, len(model_names)))]
+                n = int(r.randint(1, 4))
+                i = int(r.randint(0, Xs.shape[0] - n))
+                t0 = time.perf_counter()
+                try:
+                    fut = engine.submit(Xs[i:i + n], model=name,
+                                        method=method, timeout_s=60)
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(exc))
+                    fired += 1
+                    continue
+                pending.append((t0, fut))
+                fired += 1
+            t0, fut = pending.pop(0)
+            try:
+                fut.result(timeout=60)
+                my_lat.append(time.perf_counter() - t0)
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(exc))
+        for t0, fut in pending:
+            try:
+                fut.result(timeout=60)
+                my_lat.append(time.perf_counter() - t0)
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(exc))
+        with lock:
+            lat.extend(my_lat)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, lat, errors
+
+
+def _paced_load(engine, Xs, model_names, clients, requests_per_client,
+                rate_per_client, seed=5000, method="predict_proba"):
+    """Open-loop PACED load: each client offers ``rate_per_client``
+    requests/s regardless of completions (latency measured with the
+    arrival process fixed — the "equal aggregate QPS" leg of the p99
+    comparison; closed-loop load would let the slower engine shed its
+    own queueing and hide the difference)."""
+    lat = []
+    errors = []
+    lock = threading.Lock()
+    period = 1.0 / float(rate_per_client)
+
+    def _on_done(t0):
+        # completion time stamps on the DONE callback (scatter-thread
+        # side): harvesting later from the client thread would read
+        # submission-loop progress, not serving latency
+        def cb(fut):
+            t1 = time.perf_counter()
+            exc = None if fut.cancelled() else fut.exception()
+            with lock:
+                if exc is None and not fut.cancelled():
+                    lat.append(t1 - t0)
+                else:
+                    errors.append(repr(exc))
+
+        return cb
+
+    def client(cid):
+        r = np.random.RandomState(seed + cid)
+        futs = []
+        start = time.perf_counter()
+        for k in range(requests_per_client):
+            target = start + k * period
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            name = model_names[int(r.randint(0, len(model_names)))]
+            n = int(r.randint(1, 4))
+            i = int(r.randint(0, Xs.shape[0] - n))
+            t0 = time.perf_counter()
+            try:
+                fut = engine.submit(Xs[i:i + n], model=name,
+                                    method=method, timeout_s=60)
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(exc))
+                continue
+            fut.add_done_callback(_on_done(t0))
+            futs.append(fut)
+        for fut in futs:
+            try:
+                fut.result(timeout=60)
+            except Exception:  # noqa: BLE001 - already recorded
+                pass
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lat, errors
+
+
+def _p99_ms(lat):
+    if not lat:
+        return None
+    return round(float(np.percentile(lat, 99)) * 1e3, 3)
+
+
+def run_multitenant_bench(n_models=1000, clients=8,
+                          requests_per_client=250, window=32,
+                          baseline_models=64,
+                          baseline_requests_per_client=None,
+                          max_delay_ms=2.0, parity_samples=8):
+    from skdist_tpu.parallel import TPUBackend
+    from skdist_tpu.serve import ServingEngine
+
+    base, tenants, Xs = make_catalog(n_models)
+    backend = TPUBackend()
+
+    # ---- banked catalog ---------------------------------------------
+    banked = ServingEngine(backend=backend, max_batch_rows=256,
+                           max_delay_ms=max_delay_ms,
+                           max_queue_depth=8192, bank_models=True)
+    t0 = time.perf_counter()
+    for i, m in enumerate(tenants):
+        banked.register(f"m{i}", m, methods=("predict_proba",))
+    register_s = time.perf_counter() - t0
+    names = [f"m{i}" for i in range(n_models)]
+
+    # warm lap (touch a spread of tenants + flush shapes), then measure
+    _async_load(banked, Xs, names, clients, 4 * clients, window)
+    wall, lat, errors = _async_load(
+        banked, Xs, names, clients, requests_per_client, window,
+    )
+    banked_rps = clients * requests_per_client / wall
+    banked_stats = banked.stats()
+
+    # ---- per-model-dispatch baseline (generous subset) --------------
+    plain = ServingEngine(backend=backend, max_batch_rows=256,
+                          max_delay_ms=max_delay_ms,
+                          max_queue_depth=8192, bank_models=False)
+    for i in range(baseline_models):
+        plain.register(f"m{i}", tenants[i], methods=("predict_proba",))
+    base_names = [f"m{i}" for i in range(baseline_models)]
+    base_req = baseline_requests_per_client or max(
+        16, requests_per_client // 4
+    )
+    _async_load(plain, Xs, base_names, clients, 2 * clients, window)
+    base_wall, base_lat, base_errors = _async_load(
+        plain, Xs, base_names, clients, base_req, window,
+    )
+    base_rps = clients * base_req / base_wall
+
+    # ---- p99 at EQUAL aggregate QPS: banked catalog vs one model ----
+    # offered rate well under both capacities, so the percentile
+    # measures dispatch latency (flush window + compute), not queueing
+    pace_total = max(clients * 50, min(800, clients * requests_per_client))
+    pace_per_client = pace_total // clients
+    pace_rate = max(25.0, min(250.0, banked_rps / (4.0 * clients)))
+    single = ServingEngine(backend=backend, max_batch_rows=256,
+                           max_delay_ms=max_delay_ms,
+                           max_queue_depth=8192, bank_models=False)
+    single.register("solo", tenants[0], methods=("predict_proba",))
+    _async_load(single, Xs, ["solo"], clients, 2 * clients, window)
+    single_lat, single_errors = _paced_load(
+        single, Xs, ["solo"], clients, pace_per_client, pace_rate,
+    )
+    paced_lat, paced_errors = _paced_load(
+        banked, Xs, names, clients, pace_per_client, pace_rate,
+    )
+    single_errors = single_errors + paced_errors
+
+    # ---- per-tenant byte parity: banked vs per-model dispatch -------
+    parity_fail = []
+    step = max(1, baseline_models // max(1, parity_samples))
+    for i in range(0, baseline_models, step):
+        for n in (1, 3):
+            got = banked.predict_proba(Xs[:n], model=f"m{i}",
+                                       timeout_s=30)
+            ref = plain.predict_proba(Xs[:n], model=f"m{i}",
+                                      timeout_s=30)
+            if not np.array_equal(np.asarray(got), np.asarray(ref)):
+                parity_fail.append((i, n))
+
+    bank_info = (banked_stats.get("banks") or [{}])[0]
+    out = {
+        "bench": "multitenant: banked catalog vs per-model dispatch",
+        "n_models": n_models,
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "window": window,
+        "register_wall_s": round(register_s, 2),
+        "register_models_per_s": round(n_models / register_s, 1),
+        "banked_requests_per_s": round(banked_rps, 1),
+        "baseline_models": baseline_models,
+        "baseline_requests_per_s": round(base_rps, 1),
+        "throughput_multiple": round(banked_rps / base_rps, 2),
+        "banked_p99_ms": _p99_ms(lat),
+        "baseline_p99_ms": _p99_ms(base_lat),
+        "paced_rate_per_s": round(pace_rate * clients, 1),
+        "banked_paced_p99_ms": _p99_ms(paced_lat),
+        "single_model_p99_ms": _p99_ms(single_lat),
+        "p99_vs_single_model": (
+            round(_p99_ms(paced_lat) / _p99_ms(single_lat), 2)
+            if paced_lat and single_lat else None
+        ),
+        "n_errors": len(errors) + len(base_errors) + len(single_errors),
+        "errors": (errors + base_errors + single_errors)[:5],
+        "parity_failures": parity_fail,
+        "compiles_after_warmup": banked_stats["compiles_after_warmup"],
+        "flushes": banked_stats["flushes"],
+        "tenants_per_flush": banked_stats.get("tenants_per_flush"),
+        "bank": {
+            "members": bank_info.get("members"),
+            "capacity": bank_info.get("capacity"),
+            "occupancy": bank_info.get("occupancy"),
+            "resident_bytes": bank_info.get("resident_bytes"),
+            "generation": bank_info.get("generation"),
+        },
+        "device_params_nbytes": banked.registry.device_params_nbytes(),
+        "platform": __import__("jax").devices()[0].platform,
+    }
+    banked.close()
+    plain.close()
+    single.close()
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", type=int, default=1000)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=250)
+    ap.add_argument("--window", type=int, default=32)
+    ap.add_argument("--baseline-models", type=int, default=64)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    args = ap.parse_args()
+    out = run_multitenant_bench(
+        n_models=args.models, clients=args.clients,
+        requests_per_client=args.requests, window=args.window,
+        baseline_models=args.baseline_models,
+        max_delay_ms=args.max_delay_ms,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

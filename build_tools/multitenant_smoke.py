@@ -1,0 +1,209 @@
+"""Multi-tenant banked-serving smoke: the thousands-of-models gate.
+
+Runs the full multi-tenant acceptance on the 8-vdev CPU mesh (the same
+harness every other smoke uses):
+
+1. a ≥1000-tenant banked catalog (one ServingEngine, one parameter
+   bank) under mixed-tenant threaded load reaches >= RATIO x the
+   aggregate throughput of per-model dispatch (measured on a GENEROUS
+   64-tenant subset — full-catalog per-model dispatch would drown in
+   its own batcher threads, which is the point);
+2. paced equal-QPS p99 within P99_RATIO x of single-model serving;
+3. per-tenant outputs byte-identical to unbanked dispatch;
+4. 0 post-warmup compiles on the banked engine;
+5. 0 dropped/failed requests across every leg;
+6. fleet leg: a 2-replica banked ReplicaSet serves a 64-tenant catalog
+   under threaded load with a mid-load version rollover (re-bank +
+   atomic generation swap) — zero failed requests, every replica 0
+   post-warmup compiles, per-replica bank occupancy visible;
+7. unload leg: unregistering >half the fleet's tenants compacts the
+   bank and releases device bytes.
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/multitenant_smoke.py [--models 1000]
+        [--ratio 5.0] [--p99-ratio 2.0] [--quick]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import numpy as np  # noqa: E402
+
+
+def fleet_leg(failures, n_tenants=64, clients=6, requests=25):
+    """Banked ReplicaSet under load with a mid-load re-bank rollout."""
+    from bench_multitenant import make_catalog
+
+    from skdist_tpu.serve import ReplicaSet
+
+    base, tenants, Xs = make_catalog(n_tenants + 1)
+    fleet = ReplicaSet(
+        n_replicas=2, max_batch_rows=128, max_delay_ms=1.0,
+        max_queue_depth=4096, bank_models=True,
+    )
+    for i in range(n_tenants):
+        fleet.rollout(f"f{i}", tenants[i], methods=("predict",))
+    expected = {i: tenants[i].predict(Xs) for i in range(n_tenants)}
+    errors = []
+    lock = threading.Lock()
+
+    def client(cid):
+        r = np.random.RandomState(300 + cid)
+        for _ in range(requests):
+            t = int(r.randint(0, n_tenants))
+            n = int(r.randint(1, 4))
+            i = int(r.randint(0, Xs.shape[0] - n))
+            try:
+                out = fleet.predict(Xs[i:i + n], model=f"f{t}@1",
+                                    timeout_s=30)
+                if not (np.asarray(out) == expected[t][i:i + n]).all():
+                    with lock:
+                        errors.append(("mismatch", t))
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(("error", repr(exc)))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for th in threads:
+        th.start()
+    # a rollover mid-load: fresh bank generation on every replica,
+    # co-tenants never pause
+    rollover = tenants[n_tenants]
+    fleet.rollout("f0", rollover, methods=("predict",))
+    for th in threads:
+        th.join()
+    if errors:
+        failures.append(
+            f"fleet leg: {len(errors)} failed/mismatched requests "
+            f"(first: {errors[:2]})"
+        )
+    out = fleet.predict(Xs[:4], model="f0", timeout_s=30)
+    if not (np.asarray(out) == rollover.predict(Xs[:4])).all():
+        failures.append("fleet leg: rollover did not route to v2")
+    st = fleet.stats()
+    for ent in st["replicas"]:
+        eng = ent["engine"] or {}
+        if eng.get("compiles_after_warmup") != 0:
+            failures.append(
+                f"fleet leg: replica {ent['index']} compiles_after_"
+                f"warmup={eng.get('compiles_after_warmup')}"
+            )
+        banks = eng.get("banks") or []
+        if not banks or banks[0]["members"] != n_tenants + 1:
+            failures.append(
+                f"fleet leg: replica {ent['index']} bank missing/"
+                f"wrong membership ({banks})"
+            )
+
+    # unload leg: dropping >half the tenants compacts + releases bytes
+    r0 = fleet.replica(0).engine.registry
+    before = r0.device_params_nbytes()
+    for i in range(1, n_tenants, 2):
+        fleet.unregister(f"f{i}")
+    for i in range(2, n_tenants, 4):
+        fleet.unregister(f"f{i}")
+    after = r0.device_params_nbytes()
+    if not (0 < after < before):
+        failures.append(
+            f"fleet leg: unregister released no bytes ({before} -> "
+            f"{after})"
+        )
+    fleet.close()
+    return {"replicas": 2, "tenants": n_tenants + 1,
+            "bytes_before_unload": before, "bytes_after_unload": after}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", type=int, default=1000)
+    ap.add_argument("--ratio", type=float, default=5.0,
+                    help="min banked/per-model throughput multiple")
+    ap.add_argument("--p99-ratio", type=float, default=2.0,
+                    help="max banked/single-model paced p99 ratio")
+    ap.add_argument("--requests", type=int, default=150,
+                    help="per client on the banked leg")
+    ap.add_argument("--quick", action="store_true",
+                    help="200-model variant for iteration")
+    args = ap.parse_args()
+    if args.quick:
+        args.models = min(args.models, 200)
+        args.requests = min(args.requests, 80)
+
+    from bench_multitenant import run_multitenant_bench
+
+    failures = []
+    out = run_multitenant_bench(
+        n_models=args.models, requests_per_client=args.requests,
+    )
+    out["fleet_leg"] = fleet_leg(failures)
+    print(json.dumps(out))
+
+    if out["bank"]["members"] < args.models:
+        failures.append(
+            f"only {out['bank']['members']} tenants banked "
+            f"(wanted >= {args.models})"
+        )
+    if out["n_errors"]:
+        failures.append(
+            f"{out['n_errors']} failed requests (first: {out['errors'][:2]})"
+        )
+    if out["parity_failures"]:
+        failures.append(
+            f"banked outputs diverged from unbanked dispatch for "
+            f"{out['parity_failures']}"
+        )
+    if out["compiles_after_warmup"] != 0:
+        failures.append(
+            f"compiles_after_warmup = {out['compiles_after_warmup']} "
+            "(a banked flush shape escaped the prewarmed ladder)"
+        )
+    ratio = out["throughput_multiple"]
+    if ratio < args.ratio:
+        failures.append(
+            f"banked/per-model throughput {ratio}x below the "
+            f"{args.ratio}x acceptance floor"
+        )
+    p99r = out["p99_vs_single_model"]
+    if p99r is None or p99r > args.p99_ratio:
+        failures.append(
+            f"paced p99 ratio {p99r} vs single-model exceeds "
+            f"{args.p99_ratio}x"
+        )
+    tpf = out.get("tenants_per_flush") or {}
+    if not any(int(k) >= 2 for k in tpf):
+        failures.append("no flush ever interleaved >= 2 tenants")
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"multitenant smoke OK: {out['bank']['members']} tenants in one "
+        f"bank, {ratio}x over per-model dispatch, paced p99 {p99r}x "
+        f"single-model, byte parity, 0 post-warmup compiles, fleet "
+        f"rollover + compaction clean"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    t0 = time.perf_counter()
+    rc = main()
+    print(f"[multitenant_smoke] wall {time.perf_counter() - t0:.1f}s")
+    sys.exit(rc)

@@ -94,6 +94,19 @@
 #                             <= 0.02, per-task score parity vs the
 #                             sequential leg, kernel_mode stamped,
 #                             0 post-warmup compiles (GBDT fan-out PR).
+#   multitenant_smoke.py    — multi-tenant banked serving: >=1000
+#                             same-family tenants stacked into one
+#                             parameter bank on the 8-vdev CPU mesh,
+#                             mixed-tenant threaded load >= 5x the
+#                             per-model-dispatch aggregate throughput,
+#                             paced equal-QPS p99 within 2x of
+#                             single-model serving, per-tenant outputs
+#                             byte-identical to unbanked dispatch, 0
+#                             post-warmup compiles; 2-replica banked
+#                             ReplicaSet leg with a mid-load re-bank
+#                             rollover (0 failed requests) and an
+#                             unload leg (bank compaction releases
+#                             device bytes) (multi-tenant banking PR).
 #   obs_smoke.py            — telemetry plane: tracing-off overhead
 #                             bound <= 1% and tracing-on <= 5% warm
 #                             wall on the compacted ASHA grid,
@@ -116,3 +129,4 @@ python build_tools/procfleet_smoke.py
 python build_tools/kernels_smoke.py
 python build_tools/gbdt_smoke.py
 python build_tools/obs_smoke.py
+python build_tools/multitenant_smoke.py

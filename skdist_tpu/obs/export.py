@@ -19,8 +19,15 @@ side a scrape endpoint or a dump-to-disk debug path serves:
   views: the registry's serve.* families already carry ``replica`` and
   ``model`` (``name@version``) label dimensions (recorded by
   ``serve/stats.py``), so per-tenant dashboards are a label filter,
-  not a new collection path — the groundwork ROADMAP item 2's
-  per-tenant stats/breakers build on.
+  not a new collection path. The multi-tenant bank families ride the
+  same prefix: ``serve.bank_rebuilds`` / ``serve.bank_occupancy`` /
+  ``serve.bank_members`` / ``serve.bank_capacity`` /
+  ``serve.bank_resident_bytes`` (labeled per bank) and the
+  ``serve.tenants_per_flush`` count histogram. At 1000+ tenants the
+  per-model dimension is the exposition's cardinality risk — engines
+  running ``fleet_rollup_only`` (``serve/stats.py``) never bind it, so
+  a scrape stays O(pages) with the per-bank gauges carrying the
+  catalog-level story.
 """
 
 import json

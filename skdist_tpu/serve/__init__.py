@@ -27,6 +27,18 @@ over the same compiled block-inference programs the offline
   core: flush on size or deadline, pad to power-of-two row buckets
   (floored at the mesh task-slot count, capped by the backend's HBM
   round estimate).
+- **Multi-tenant banks** (``serve.bank``, on via ``bank_models=True``
+  or ``SKDIST_SERVE_BANKED=1``): same-family/same-shape/same-dtype
+  registered models stack into parameter banks — one extra leading
+  bank axis on every param leaf — and one flush scores interleaved
+  requests for N tenants in a single (task x batch) program
+  (:class:`~skdist_tpu.serve.batcher.BankedBatcher`'s per-model-id
+  scatter/gather). Thousands of small models serve from one mesh with
+  per-tenant breakers, per-tenant admission
+  (``max_queue_depth_per_tenant``), capped per-tenant stats
+  (``fleet_rollup_only`` for O(pages) exposition), and incremental
+  re-bank rollouts: publishing version k+1 of one tenant swaps a fresh
+  bank generation atomically without pausing its co-tenants.
 - :class:`ServingStats` — rolling latency percentiles, queue depth,
   batch-fill ratio, bucket-hit histogram, compiles-after-warmup.
 - :class:`ReplicaSet` — the self-healing fleet: N engines behind
@@ -55,7 +67,9 @@ Quickstart::
     engine.close()                         # graceful drain
 """
 
+from .bank import ParameterBank
 from .batcher import (
+    BankedBatcher,
     CircuitOpen,
     DeadlineExceeded,
     MicroBatcher,
@@ -78,7 +92,9 @@ __all__ = [
     "AllReplicasUnhealthy",
     "ModelRegistry",
     "ModelEntry",
+    "ParameterBank",
     "MicroBatcher",
+    "BankedBatcher",
     "ServingStats",
     "ServingError",
     "Overloaded",

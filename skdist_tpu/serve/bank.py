@@ -1,0 +1,428 @@
+"""
+Stacked parameter banks: the multi-tenant half of the serving plane.
+
+The fan-out backend's whole competency is "many small models, one
+compiled program, task axis = model axis" — but per-model dispatch
+stops applying it at the fit plane: a registry of 1000 same-family
+tenants (per-country, per-experiment, per-category models) pays one
+micro-batcher, one flush, and one XLA launch per tenant. This module
+applies the fit plane's trick to inference, the PRETZEL observation
+(Lee et al., OSDI'18) that white-box multi-model serving should share
+compiled stages and parameters across tenants:
+
+- **bank** = every registered model with the same kernel family, static
+  config, meta signature, ``serve_dtype``, and staged-params shape
+  (the grouping key is literally the compiled-program cache key plus
+  the params shape signature — two members of one bank are promised to
+  run the identical per-row math).
+- **stacked params**: each param leaf gains one leading *bank axis*
+  sized to a power-of-two capacity ladder. Capacity — not member
+  count — is what the compiled program sees, so registering tenant
+  513 into a 1024-capacity bank changes NO shapes and compiles
+  NOTHING; only a capacity doubling (or a compaction halving) is a new
+  program, and those are prewarmed before the generation publishes.
+- **banked kernel**: the decision/proba kernels are already vmapped
+  over the task axis, so a bank scores as one (task x batch) program —
+  each task slot carries ``rows_per_slot`` rows of ONE tenant plus a
+  ``tid`` scalar, and the kernel gathers that tenant's param row from
+  the stacked bank before running the member kernel unchanged. A
+  flush therefore carries interleaved requests for N tenants in a
+  single launch (the batcher's per-model-id scatter/gather builds the
+  slot layout; see ``serve.batcher.BankedBatcher``).
+- **generations**: a bank publish (new tenant, version rollover,
+  unregister, compaction) builds an immutable :class:`_BankGen` —
+  fresh stacked arrays, fresh device placement, prewarmed — and then
+  atomically swaps ``bank.current``. In-flight flushes keep the old
+  generation's device arrays alive until they gather; queued requests
+  resolve their tenant's slot against whatever generation their flush
+  dispatches on, so a rollout of tenant k never pauses tenants != k.
+- **compaction**: unregistering tenants leaves holes (zeroed rows are
+  unreachable — padding/garbage only); when occupancy drops below 50%
+  the bank re-slots densely and halves capacity, releasing the device
+  bytes. On-disk AOT artifacts are per-program-shape, shared across
+  every tenant of the family — there is nothing per-tenant to delete.
+
+Telemetry (process registry, ``serve.*`` so the fleet exporters carry
+it): ``serve.bank_rebuilds`` counter (labeled bank/reason),
+``serve.bank_occupancy`` / ``serve.bank_members`` /
+``serve.bank_capacity`` / ``serve.bank_resident_bytes`` gauges, and a
+``bank_swap`` trace instant per generation swap.
+"""
+
+import threading
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..parallel import compile_cache
+
+__all__ = ["ParameterBank", "bank_group_key", "banked_kernel"]
+
+
+def _capacity_for(n):
+    """Smallest power-of-two capacity holding ``n`` slots (floor 1)."""
+    cap = 1
+    while cap < int(n):
+        cap <<= 1
+    return cap
+
+
+def bank_group_key(plans, rows_per_slot):
+    """The grouping rule, as a hashable key: same kernel family /
+    static config / meta signature / serve_dtype (== the per-method
+    compiled-program cache keys) AND same staged-params shapes. Two
+    entries with equal keys are stackable and run identical per-row
+    math; anything else serves per-model."""
+    return (
+        "bank",
+        tuple(sorted(
+            (m, plan.cache_key(), compile_cache.shape_sig(plan.params))
+            for m, plan in plans.items()
+        )),
+        int(rows_per_slot),
+    )
+
+
+def banked_kernel(member_kernel):
+    """Wrap a member's decision/proba kernel for bank dispatch: the
+    task tree carries ``{"X": (rows_per_slot, d), "tid": scalar}`` per
+    slot, and the wrapper gathers the slot's tenant row from every
+    stacked param leaf (one dynamic-index gather, fused by XLA) before
+    running the member kernel UNCHANGED — per-row math is bitwise the
+    per-model path's."""
+
+    def bk(shared, task):
+        import jax
+
+        member = jax.tree_util.tree_map(
+            lambda leaf: leaf[task["tid"]], shared["params"]
+        )
+        return {"out": member_kernel(member, task["X"])}
+
+    return bk
+
+
+class _BankGen:
+    """One immutable published generation of a bank: a slot routing
+    table plus per-method device-resident stacked params and their
+    :class:`~skdist_tpu.parallel.backend.BatchedPlan`. Dispatch mirrors
+    ``_MethodPath.dispatch``'s async contract (launch now, return a
+    finalize the scatter thread blocks on)."""
+
+    __slots__ = ("ordinal", "capacity", "slot_of", "plans", "nbytes",
+                 "host_stacked")
+
+    def __init__(self, ordinal, capacity, slot_of, plans, nbytes,
+                 host_stacked=None):
+        self.ordinal = ordinal
+        self.capacity = capacity
+        self.slot_of = slot_of    # spec -> slot index
+        self.plans = plans        # method -> BatchedPlan (stacked)
+        self.nbytes = nbytes      # staged stacked bytes (all methods)
+        #: the host-side stacked trees this generation was placed from
+        #: — the next same-capacity publish copies these and rewrites
+        #: ONE slot instead of restacking every member (registration
+        #: stays O(capacity) bytes per publish, not O(members) leaf
+        #: walks — the difference between ~10 s and minutes on a
+        #: 10k-tenant catalog load)
+        self.host_stacked = host_stacked
+
+    def dispatch(self, method, X, tid):
+        """Launch one banked flush (``X`` (S, r, d) float32, ``tid``
+        (S,) int32, S a slot-ladder bucket) and return the finalize
+        producing the raw (S, r, out...) scores."""
+        plan = self.plans[method]
+        dev_out = plan.run_async({"X": X, "tid": tid})
+
+        def finalize():
+            return plan.gather(dev_out)["out"]
+
+        return finalize
+
+
+class ParameterBank:
+    """One bank: member bookkeeping + the generation build/swap machine.
+
+    Membership mutations (``add_member`` / ``remove_member``) serialize
+    on the bank lock and end in an atomic ``self.current`` swap;
+    the read side (the batcher's flush build) takes no lock — it grabs
+    ``bank.current`` once per flush and resolves every queued request's
+    slot against that generation.
+    """
+
+    def __init__(self, key, name, backend, plans, rows_per_slot,
+                 slot_buckets):
+        self.key = key
+        self.name = name            # short stable label ("bank0", ...)
+        self.backend = backend
+        self.rows_per_slot = int(rows_per_slot)
+        #: the flush slot-count ladder (multiples of the mesh task
+        #: slots) — fixed for the bank's lifetime so every capacity
+        #: rung prewarms one enumerable program set
+        self.slot_buckets = list(slot_buckets)
+        #: per-method reference plans (kernel/cache-key/postprocess
+        #: basis — any member's; the grouping key guarantees
+        #: interchangeability)
+        self._ref_plans = dict(plans)
+        ref = next(iter(plans.values()))
+        self.n_features = int(ref.n_features)
+        self.serve_dtype = ref.serve_dtype
+        self._jit_keys = {
+            m: compile_cache.structural_key(
+                "predict_banked", p.cls, p.which, p.static, p.meta_sig,
+                p.serve_dtype, self.rows_per_slot,
+            )
+            for m, p in plans.items()
+        }
+        self._lock = threading.Lock()
+        self._members = {}       # spec -> slot
+        self._member_plans = {}  # spec -> {method: DevicePredictPlan}
+        self._free = []          # freed slot indices (holes)
+        self._high = 0           # high-water slot index
+        self.capacity = 0
+        self.generation = 0
+        self.rebuilds = 0
+        self.current = None      # the published _BankGen
+
+    # ------------------------------------------------------------------
+    # membership
+    # ------------------------------------------------------------------
+    def add_member(self, spec, plans, prewarm=True):
+        """Stage ``spec`` into the bank: pick a slot (holes first),
+        grow capacity if needed, build + prewarm the next generation,
+        swap. Returns the slot. The old generation keeps serving until
+        the swap — a tenant publish never pauses the others."""
+        with self._lock:
+            if spec in self._members:
+                raise ValueError(f"{spec} is already in {self.name}")
+            # snapshot the slot bookkeeping: a staging failure below
+            # (device placement / prewarm compile) must roll the
+            # member back, or a phantom spec would inflate every
+            # future generation with no entry ever able to remove it
+            snapshot = (self.capacity, self._high, list(self._free))
+            if self._free:
+                slot = self._free.pop(0)
+            else:
+                slot = self._high
+                self._high += 1
+            grew = slot >= self.capacity
+            if grew:
+                self.capacity = _capacity_for(slot + 1)
+            self._members[spec] = slot
+            self._member_plans[spec] = dict(plans)
+            try:
+                self._rebuild("grow" if grew else "publish",
+                              prewarm=prewarm, changed_spec=spec)
+            except BaseException:
+                self._members.pop(spec, None)
+                self._member_plans.pop(spec, None)
+                self.capacity, self._high, self._free = (
+                    snapshot[0], snapshot[1], snapshot[2],
+                )
+                raise
+            return slot
+
+    def remove_member(self, spec):
+        """Drop ``spec``: its slot becomes a hole (params unreachable —
+        device bytes release at the next compaction), and a generation
+        WITHOUT the spec publishes so queued requests for it fail typed
+        instead of scoring a stale slot. Occupancy below 50% triggers
+        compaction: dense re-slot, capacity halved (a previously
+        visited rung — its programs are already compiled), stacked
+        bytes actually released. Returns the remaining member count."""
+        with self._lock:
+            slot = self._members.pop(spec, None)
+            if slot is None:
+                return len(self._members)
+            self._member_plans.pop(spec, None)
+            self._free.append(slot)
+            n = len(self._members)
+            if n and 2 * n <= self.capacity and self.capacity > 1:
+                order = sorted(self._members.items(), key=lambda kv: kv[1])
+                self._members = {s: i for i, (s, _) in enumerate(order)}
+                self._free = []
+                self._high = n
+                self.capacity = _capacity_for(n)
+                self._rebuild("compact")
+            else:
+                self._regen("remove")
+            return n
+
+    def members(self):
+        with self._lock:
+            return dict(self._members)
+
+    @property
+    def occupancy(self):
+        cap = self.capacity
+        return (len(self._members) / cap) if cap else 0.0
+
+    @property
+    def nbytes(self):
+        """Staged stacked bytes of the CURRENT generation — the bank's
+        resident HBM bill (the bytes-released evidence of unregister
+        compaction)."""
+        gen = self.current
+        return int(gen.nbytes) if gen is not None else 0
+
+    def row_buckets(self):
+        """The ladder in ROWS (slot buckets x rows_per_slot) — what a
+        banked entry reports as ``entry.buckets``."""
+        return [s * self.rows_per_slot for s in self.slot_buckets]
+
+    def prewarm(self):
+        """Re-run the current generation's prewarm (pure memo/disk hits
+        once built — the ``prewarm=False`` tooling escape hatch)."""
+        with self._lock:
+            gen = self.current
+            if gen is None:
+                return 0
+            return self._prewarm_gen(gen)
+
+    def stats(self):
+        with self._lock:
+            return {
+                "name": self.name,
+                "members": len(self._members),
+                "capacity": self.capacity,
+                "occupancy": round(self.occupancy, 4),
+                "generation": self.generation,
+                "rebuilds": self.rebuilds,
+                "rows_per_slot": self.rows_per_slot,
+                "slot_buckets": list(self.slot_buckets),
+                "serve_dtype": self.serve_dtype,
+                "resident_bytes": self.nbytes,
+            }
+
+    # ------------------------------------------------------------------
+    # generation build
+    # ------------------------------------------------------------------
+    def _stack(self, method, slot_of):
+        """Host-side stacked params for one method: every leaf gets the
+        leading bank axis at ``self.capacity``; holes stay zero (only
+        reachable as padding-slot garbage, always discarded)."""
+        import jax
+
+        ref = self._ref_plans[method].params
+        leaves_ref, treedef = jax.tree_util.tree_flatten(ref)
+        out = [
+            np.zeros((self.capacity,) + tuple(np.asarray(l).shape),
+                     np.asarray(l).dtype)
+            for l in leaves_ref
+        ]
+        for spec, slot in slot_of.items():
+            leaves = jax.tree_util.tree_leaves(
+                self._member_plans[spec][method].params
+            )
+            for dst, src in zip(out, leaves):
+                dst[slot] = np.asarray(src)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _rebuild(self, reason, prewarm=True, changed_spec=None):
+        """Build + publish the next generation: stack at the current
+        capacity, place on device, prewarm every slot bucket, swap.
+        Caller holds the bank lock. When only ``changed_spec`` differs
+        from the previous generation at UNCHANGED capacity, the stack
+        is the previous host arrays copied with that one slot
+        rewritten (O(capacity) bytes, no per-member walk); capacity
+        changes and compactions restack every member. Same-capacity
+        rebuilds are compile-free by construction (the jit entry is
+        memoised on the structural banked key; the AOT executables key
+        on shapes that did not change)."""
+        import jax
+
+        slot_of = dict(self._members)
+        prev = self.current
+        incremental = (
+            changed_spec is not None and prev is not None
+            and prev.capacity == self.capacity
+            and prev.host_stacked is not None
+        )
+        plans = {}
+        host = {}
+        nbytes = 0
+        from .quantize import quantized_nbytes
+
+        for method in self._ref_plans:
+            if incremental:
+                slot = slot_of[changed_spec]
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    prev.host_stacked[method]
+                )
+                member = jax.tree_util.tree_leaves(
+                    self._member_plans[changed_spec][method].params
+                )
+                out = []
+                for dst, src in zip(leaves, member):
+                    dst = dst.copy()  # copy-on-publish: the previous
+                    dst[slot] = np.asarray(src)  # gen stays immutable
+                    out.append(dst)
+                stacked = jax.tree_util.tree_unflatten(treedef, out)
+            else:
+                stacked = self._stack(method, slot_of)
+            host[method] = stacked
+            nbytes += quantized_nbytes(stacked)
+            plans[method] = self.backend.prepare_batched(
+                banked_kernel(self._ref_plans[method].kernel),
+                {"params": stacked},
+                cache_key=self._jit_keys[method],
+            )
+        gen = _BankGen(self.generation + 1, self.capacity, slot_of,
+                       plans, nbytes, host_stacked=host)
+        if prewarm:
+            self._prewarm_gen(gen)
+        # the swap IS the publish: one attribute store, no lock on the
+        # read side — in-flight flushes finish on the old generation
+        self.generation = gen.ordinal
+        self.current = gen
+        self.rebuilds += 1
+        self._bill(reason)
+
+    def _regen(self, reason):
+        """Publish a membership-only generation: shares the previous
+        generation's stacked device arrays and compiled plans, shrinks
+        only the slot routing table (the cheap non-compacting removal
+        path — no restack, no placement, no prewarm)."""
+        prev = self.current
+        gen = _BankGen(self.generation + 1, self.capacity,
+                       dict(self._members), prev.plans, prev.nbytes,
+                       host_stacked=prev.host_stacked)
+        self.generation = gen.ordinal
+        self.current = gen
+        self._bill(reason)
+
+    def _prewarm_gen(self, gen):
+        import jax
+
+        r = self.rows_per_slot
+        d = self.n_features
+        n = 0
+        for plan in gen.plans.values():
+            for s in self.slot_buckets:
+                plan.prewarm({
+                    "X": jax.ShapeDtypeStruct((s, r, d), np.float32),
+                    "tid": jax.ShapeDtypeStruct((s,), np.int32),
+                })
+                n += 1
+        return n
+
+    def _bill(self, reason):
+        obs_metrics.counter(
+            "serve.bank_rebuilds",
+            help="bank generation publishes, by reason",
+        ).inc(1, bank=self.name, reason=reason)
+        for fam, value in (
+            ("serve.bank_occupancy", round(self.occupancy, 4)),
+            ("serve.bank_members", len(self._members)),
+            ("serve.bank_capacity", self.capacity),
+            ("serve.bank_resident_bytes", self.nbytes),
+        ):
+            obs_metrics.gauge(fam).set(value, bank=self.name)
+        obs_trace.instant(
+            "bank_swap",
+            {"bank": self.name, "generation": int(self.generation),
+             "members": len(self._members),
+             "capacity": int(self.capacity), "reason": reason}
+            if obs_trace.enabled() else None,
+        )

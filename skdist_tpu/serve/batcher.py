@@ -44,6 +44,7 @@ __all__ = [
     "DeadlineExceeded",
     "CircuitOpen",
     "MicroBatcher",
+    "BankedBatcher",
     "shape_buckets",
 ]
 
@@ -110,6 +111,25 @@ class _Request:
         self.enq_t = time.monotonic() if enq_t is None else enq_t
 
 
+class _BankRequest(_Request):
+    """A queued request bound for a tenant-banked flush: carries its
+    tenant spec (``name@version``), the slot count it occupies
+    (``ceil(n / rows_per_slot)``), and the entry's postprocess (scores
+    → user-facing output, per tenant — classifiers map through THEIR
+    ``classes_``). ``slot_start`` is stamped at flush build so the
+    scatter can split the banked output back per request."""
+
+    __slots__ = ("spec", "n_slots", "postprocess", "slot_start")
+
+    def __init__(self, X, n, future, spec, n_slots, postprocess,
+                 deadline=None, enq_t=None):
+        super().__init__(X, n, future, deadline=deadline, enq_t=enq_t)
+        self.spec = spec
+        self.n_slots = n_slots
+        self.postprocess = postprocess
+        self.slot_start = -1
+
+
 def _complete(future, result=None, exc=None):
     """Resolve a request future, tolerating callers that already
     cancelled it (``fut.cancel()`` is public API on what ``submit``
@@ -156,7 +176,11 @@ class MicroBatcher:
         self.name = name
         self._cond = threading.Condition(threading.Lock())
         self._queue = deque()
-        self._queued_rows = 0
+        #: queued FLUSH UNITS — rows here; tenant SLOTS in the banked
+        #: subclass (whose bucket ladder counts slots, each carrying
+        #: rows_per_slot rows); _units() is the per-request conversion
+        self._queued_units = 0
+        self.max_units = self._max_units()
         self._stop = False
         # in-flight accounting: a SLOT is held from device launch until
         # the gather completes (scatter thread), so launched-but-
@@ -179,6 +203,14 @@ class MicroBatcher:
         self._thread.start()
 
     # ------------------------------------------------------------------
+    def _max_units(self):
+        """Largest flush budget in this batcher's accounting unit."""
+        return self.max_rows
+
+    def _units(self, request):
+        """How much of the flush budget one request occupies."""
+        return request.n
+
     def qsize(self):
         with self._cond:
             return len(self._queue)
@@ -197,7 +229,7 @@ class MicroBatcher:
             if self._stop:
                 raise ServingError("batcher is shut down")
             self._queue.append(request)
-            self._queued_rows += request.n
+            self._queued_units += self._units(request)
             if self.stats is not None:
                 self.stats.set_queue_depth(len(self._queue), key=self.name)
             self._cond.notify()
@@ -212,7 +244,7 @@ class MicroBatcher:
                     req = self._queue.popleft()
                     _complete(req.future, exc=ServingError(
                         "engine shut down before dispatch"))
-                self._queued_rows = 0
+                self._queued_units = 0
             self._stop = True
             self._cond.notify_all()
         # the dispatch loop enqueues the scatter sentinel itself when
@@ -244,41 +276,44 @@ class MicroBatcher:
             self._inflight.put(None)
 
     def _collect(self):
-        """Block until a flush is due (rows >= largest bucket, oldest
-        request aged out, or shutdown), then pop the FIFO prefix that
-        fits the largest bucket. Returns (None, 0) when stopped with an
-        empty queue."""
+        """Block until a flush is due (queued units >= largest bucket,
+        oldest request aged out, or shutdown), then pop the FIFO prefix
+        that fits the largest bucket. Returns (None, 0) when stopped
+        with an empty queue."""
         with self._cond:
             while not self._queue:
                 if self._stop:
                     return None, 0
                 self._cond.wait(0.1)
             deadline = self._queue[0].enq_t + self.max_delay_s
-            while self._queued_rows < self.max_rows and not self._stop:
+            while self._queued_units < self.max_units and not self._stop:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
-            batch, rows = [], 0
-            while self._queue and rows + self._queue[0].n <= self.max_rows:
+            batch, units = [], 0
+            while self._queue:
+                u = self._units(self._queue[0])
+                if units + u > self.max_units:
+                    break
                 req = self._queue.popleft()
-                self._queued_rows -= req.n
+                self._queued_units -= u
                 batch.append(req)
-                rows += req.n
+                units += u
             if not batch and self._queue:
                 # an unfittable head request (n > max_rows — the engine
                 # rejects these at submit; this is the backstop) must be
                 # failed and popped, or the loop would hot-spin on it
                 # and head-of-line-block everything behind it forever
                 req = self._queue.popleft()
-                self._queued_rows -= req.n
+                self._queued_units -= self._units(req)
                 _complete(req.future, exc=ServingError(
                     f"request of {req.n} rows can never fit the largest "
                     f"bucket ({self.max_rows})"
                 ))
             if self.stats is not None:
                 self.stats.set_queue_depth(len(self._queue), key=self.name)
-            return batch, rows
+            return batch, units
 
     def _flush(self, batch, rows):
         now = time.monotonic()
@@ -365,3 +400,149 @@ class MicroBatcher:
         for req in live:
             _complete(req.future, result=out[off:off + req.n])
             off += req.n
+
+
+class BankedBatcher(MicroBatcher):
+    """Request queue + dispatch loop for ONE (bank, method): the
+    per-model-id scatter/gather of multi-tenant serving.
+
+    Where :class:`MicroBatcher` serves one model and concatenates rows,
+    this serves EVERY tenant of a parameter bank and lays a flush out
+    as tenant slots: the flush tensor is ``(S, rows_per_slot, d)`` with
+    a per-slot ``tid`` (the tenant's bank slot, resolved against the
+    bank's CURRENT generation at flush build), ``S`` drawn from the
+    bank's slot-bucket ladder. A request of ``n`` rows occupies
+    ``ceil(n / rows_per_slot)`` consecutive slots (only its last slot
+    padded); unclaimed slots keep ``tid=0`` and zero rows — garbage
+    compute that is never scattered anywhere. The gather splits the
+    ``(S, rows_per_slot, out...)`` result back per request and applies
+    each request's OWN postprocess (per-tenant ``classes_`` mapping).
+
+    ``dispatch(gen, X, tid, specs)`` is the engine-guarded bank launch
+    (watchdog + per-tenant breaker settle for every spec in the
+    flush); like the base class it returns a finalize callable the
+    scatter thread drains. Queue accounting is in SLOTS (the units
+    hook), so the flush-when-full trigger matches the ladder.
+
+    Rollover/unregister safety: requests carry their tenant SPEC, not
+    a slot — a generation swapped between enqueue and flush re-resolves
+    every spec, so a re-bank mid-queue re-routes transparently and an
+    unregistered tenant's queued requests fail typed instead of
+    scoring a stale (or re-assigned) slot.
+    """
+
+    def __init__(self, bank, method, dispatch, max_delay_s=0.002,
+                 stats=None, name=""):
+        self.bank = bank
+        self.method = method
+        self.rows_per_slot = bank.rows_per_slot
+        self.slot_buckets = list(bank.slot_buckets)
+        super().__init__(
+            dispatch,
+            buckets=[s * self.rows_per_slot for s in self.slot_buckets],
+            max_delay_s=max_delay_s, stats=stats, pad=True,
+            name=name or f"{bank.name}.{method}",
+        )
+
+    def _max_units(self):
+        return self.slot_buckets[-1]
+
+    def _units(self, request):
+        return request.n_slots
+
+    def slot_bucket_for(self, slots):
+        for s in self.slot_buckets:
+            if s >= slots:
+                return s
+        raise ValueError(
+            f"{slots} slots exceed the largest slot bucket "
+            f"({self.slot_buckets[-1]})"
+        )
+
+    def _flush(self, batch, units):
+        now = time.monotonic()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                _complete(req.future, exc=DeadlineExceeded(
+                    f"request waited {now - req.enq_t:.3f}s, deadline "
+                    f"was {req.deadline - req.enq_t:.3f}s after enqueue"
+                ))
+                if self.stats is not None:
+                    self.stats.record_rejection("deadline")
+            else:
+                live.append(req)
+        if not live:
+            return
+        # resolve every spec against ONE generation — the flush's
+        # routing snapshot; a swap during assembly is harmless (the old
+        # generation's plans and params stay alive until gathered)
+        gen = self.bank.current
+        routed = []
+        for req in live:
+            if gen is None or req.spec not in gen.slot_of:
+                _complete(req.future, exc=ServingError(
+                    f"{req.spec} is no longer in its parameter bank "
+                    "(unregistered before dispatch)"
+                ))
+                if self.stats is not None:
+                    self.stats.record_rejection("error")
+            else:
+                routed.append(req)
+        live = routed
+        if not live:
+            return
+        live_slots = sum(r.n_slots for r in live)
+        live_rows = sum(r.n for r in live)
+        S = self.slot_bucket_for(live_slots)
+        r = self.rows_per_slot
+        d = self.bank.n_features
+        X = np.zeros((S, r, d), np.float32)
+        tid = np.zeros((S,), np.int32)
+        s = 0
+        for req in live:
+            k = req.n_slots
+            req.slot_start = s
+            X[s:s + k].reshape(k * r, d)[:req.n] = req.X
+            tid[s:s + k] = gen.slot_of[req.spec]
+            s += k
+        self._slots.acquire()
+        try:
+            with obs_trace.span(
+                "flush",
+                {"name": self.name, "rows": int(live_rows),
+                 "bucket": int(S * r),
+                 "tenants": len({q.spec for q in live})}
+                if obs_trace.enabled() else None,
+            ):
+                out = self._dispatch(
+                    gen, X, tid, frozenset(q.spec for q in live)
+                )
+        except Exception as exc:
+            self._slots.release()
+            self._fail(live, exc)
+            return
+        if callable(out):
+            self._inflight.put((out, live, live_rows, S * r))
+        else:  # pragma: no cover - bank dispatch is always async
+            self._slots.release()
+            self._scatter(out, live, live_rows, S * r)
+
+    def _scatter(self, out, live, live_rows, bucket):
+        if self.stats is not None:
+            self.stats.record_flush(
+                live_rows, bucket,
+                tenants=len({req.spec for req in live}),
+            )
+        out = np.asarray(out)
+        r = self.rows_per_slot
+        trailing = out.shape[2:]
+        for req in live:
+            s, k = req.slot_start, req.n_slots
+            rows = out[s:s + k].reshape((k * r,) + trailing)[:req.n]
+            try:
+                result = req.postprocess(rows)
+            except Exception as exc:  # per-request: one bad postprocess
+                _complete(req.future, exc=exc)  # must not strand others
+                continue
+            _complete(req.future, result=result)

@@ -55,11 +55,13 @@ import numpy as np
 from ..obs import metrics as obs_metrics
 from ..parallel import faults
 from .batcher import (
+    BankedBatcher,
     CircuitOpen,
     DeadlineExceeded,
     MicroBatcher,
     Overloaded,
     ServingError,
+    _BankRequest,
     _Request,
 )
 from .registry import ModelRegistry
@@ -89,13 +91,31 @@ class ServingEngine:
     def __init__(self, backend=None, registry=None, max_batch_rows=None,
                  buckets=None, max_delay_ms=2.0, max_queue_depth=1024,
                  default_timeout_s=None, watchdog_ms=None,
-                 breaker_threshold=3, breaker_cooldown_s=30.0):
+                 breaker_threshold=3, breaker_cooldown_s=30.0,
+                 bank_models=None, bank_rows_per_slot=None,
+                 max_queue_depth_per_tenant=None,
+                 fleet_rollup_only=None, max_model_splits=None):
+        """Multi-tenant knobs on top of the classic ones:
+        ``bank_models``/``bank_rows_per_slot`` configure the registry's
+        parameter banking (``serve.bank``; default: the
+        ``SKDIST_SERVE_BANKED`` env flag, off);
+        ``max_queue_depth_per_tenant`` adds a PER-``name@version``
+        admission bound under the engine-wide one, so one chatty tenant
+        of a banked catalog cannot starve its co-tenants' queue budget
+        (None = engine-wide bound only); ``fleet_rollup_only`` /
+        ``max_model_splits`` are the stats cardinality guards
+        (``serve.stats.ServingStats``)."""
         self.registry = registry if registry is not None else ModelRegistry(
             backend=backend, max_batch_rows=max_batch_rows,
-            buckets=buckets,
+            buckets=buckets, bank_models=bank_models,
+            bank_rows_per_slot=bank_rows_per_slot,
         )
         self.max_delay_s = float(max_delay_ms) / 1e3
         self.max_queue_depth = int(max_queue_depth)
+        self.max_queue_depth_per_tenant = (
+            None if max_queue_depth_per_tenant is None
+            else int(max_queue_depth_per_tenant)
+        )
         self.default_timeout_s = default_timeout_s
         if watchdog_ms is None:
             raw = os.environ.get("SKDIST_SERVE_WATCHDOG_MS", "").strip()
@@ -117,8 +137,15 @@ class ServingEngine:
         self._breaker = faults.CircuitBreaker(
             threshold=breaker_threshold, cooldown_s=breaker_cooldown_s,
         )
-        self._stats = ServingStats()
+        self._stats = ServingStats(
+            max_model_splits=max_model_splits,
+            fleet_rollup_only=fleet_rollup_only,
+        )
         self._batchers = {}
+        #: per-tenant outstanding submissions (admission bookkeeping;
+        #: decremented by each request's done callback)
+        self._tenant_pending = {}
+        self._tenant_lock = threading.Lock()
         self._lock = threading.Lock()
         self._closed = False
 
@@ -127,7 +154,7 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def register(self, name, model, methods=("predict",), version=None,
                  prewarm=True, serve_dtype="float32",
-                 quant_parity_bound=None):
+                 quant_parity_bound=None, bank=None):
         """Register (and prewarm) a fitted model; returns its entry.
         ``serve_dtype`` selects the stored-parameter precision tier
         (see ``ModelRegistry.register`` — int8/bf16 entries are
@@ -143,7 +170,7 @@ class ServingEngine:
             entry = self.registry.register(
                 name, model, methods=methods, version=version,
                 prewarm=prewarm, serve_dtype=serve_dtype,
-                quant_parity_bound=quant_parity_bound,
+                quant_parity_bound=quant_parity_bound, bank=bank,
             )
         if prewarm:
             self._stats.mark_warm()
@@ -155,11 +182,21 @@ class ServingEngine:
         drops the registry entries — releasing the staged device
         parameters. The unload half of the rollout loop; without it
         every historical version's params and batcher threads live for
-        the engine's lifetime."""
+        the engine's lifetime.
+
+        Banked tenants share their bank's batcher with their
+        co-tenants, so it stays open while the bank has members; only
+        an EMPTIED bank's batcher closes here (the registry has already
+        dropped the bank and its stacked params)."""
         removed = self.registry.unregister(name, version=version)
         gone = {(e.name, e.version) for e in removed}
+        live_banks = {b.key for b in self.registry.active_banks()}
         with self._lock:
-            keys = [k for k in self._batchers if (k[0], k[1]) in gone]
+            keys = [
+                k for k in self._batchers
+                if ((k[0], k[1]) in gone
+                    or (k[0] == "__bank__" and k[1] not in live_banks))
+            ]
             batchers = [self._batchers.pop(k) for k in keys]
         for b in batchers:
             b.close(drain=drain, timeout=timeout)
@@ -190,8 +227,10 @@ class ServingEngine:
                 "after the cooldown"
             )
         path = entry.methods[method]
+        banked = path.bank is not None
         X = self._as_request_rows(X, entry, device=path.device)
-        batcher = self._batcher_for(entry, method)
+        batcher = (self._bank_batcher_for(entry, method) if banked
+                   else self._batcher_for(entry, method))
         n = X.shape[0] if hasattr(X, "shape") else len(X)
         if n > batcher.max_rows:
             # both paths: a request the batcher can never fit would
@@ -208,25 +247,48 @@ class ServingEngine:
             raise Overloaded(
                 f"queue depth is at max_queue_depth={self.max_queue_depth}"
             )
+        serve_dtype = getattr(entry, "serve_dtype", "float32")
+        model_spec = entry.spec
+        tenant_bound = self.max_queue_depth_per_tenant
+        if tenant_bound is not None:
+            # the per-tenant admission slice: a chatty tenant hits ITS
+            # bound (typed Overloaded, shed at submit) while its
+            # co-tenants' budget — and the bank's flush cadence — stays
+            # untouched; released by the request's done callback
+            with self._tenant_lock:
+                cur = self._tenant_pending.get(model_spec, 0)
+                if cur >= tenant_bound:
+                    self._stats.record_rejection("overload")
+                    raise Overloaded(
+                        f"{model_spec} is at max_queue_depth_per_tenant"
+                        f"={tenant_bound}; other tenants are unaffected"
+                    )
+                self._tenant_pending[model_spec] = cur + 1
         timeout_s = (self.default_timeout_s if timeout_s is None
                      else timeout_s)
         enq_t = time.monotonic()
-        req = _Request(
-            X, n, Future(),
-            # `is not None`, not truthiness: an explicit timeout_s=0
-            # means "already due" (rejected at the next flush), not
-            # "no deadline"
-            deadline=(enq_t + timeout_s) if timeout_s is not None
-            else None,
-            enq_t=enq_t,
-        )
-        serve_dtype = getattr(entry, "serve_dtype", "float32")
-        model_spec = entry.spec
+        # `is not None`, not truthiness: an explicit timeout_s=0
+        # means "already due" (rejected at the next flush), not
+        # "no deadline"
+        deadline = (enq_t + timeout_s) if timeout_s is not None else None
+        if banked:
+            r = batcher.rows_per_slot
+            req = _BankRequest(
+                X, n, Future(), spec=model_spec,
+                n_slots=-(-n // r),
+                postprocess=path.plan.postprocess,
+                deadline=deadline, enq_t=enq_t,
+            )
+        else:
+            req = _Request(X, n, Future(), deadline=deadline,
+                           enq_t=enq_t)
         self._stats.record_submitted(serve_dtype=serve_dtype,
                                      model=model_spec)
         stats = self._stats
 
         def _done(fut):
+            if tenant_bound is not None:
+                self._release_tenant(model_spec)
             # a caller-cancelled future has no result/exception to read
             # (fut.exception() would itself raise CancelledError)
             if not fut.cancelled() and fut.exception() is None:
@@ -235,8 +297,23 @@ class ServingEngine:
                                        model=model_spec)
 
         req.future.add_done_callback(_done)
-        batcher.submit(req)
+        try:
+            batcher.submit(req)
+        except Exception:
+            # the enqueue itself failed (racing shutdown): the future
+            # never resolves, so release the tenant slot here
+            if tenant_bound is not None and not req.future.done():
+                self._release_tenant(model_spec)
+            raise
         return req.future
+
+    def _release_tenant(self, spec):
+        with self._tenant_lock:
+            cur = self._tenant_pending.get(spec, 0)
+            if cur <= 1:
+                self._tenant_pending.pop(spec, None)
+            else:
+                self._tenant_pending[spec] = cur - 1
 
     def predict(self, X, model=None, method="predict", timeout_s=None):
         """Synchronous ``submit``: blocks for the result; raises
@@ -282,6 +359,14 @@ class ServingEngine:
         out["circuit_breaker"] = self._breaker.states()
         out["watchdog_ms"] = (None if self.watchdog_s is None
                               else round(self.watchdog_s * 1e3, 3))
+        bank_stats = getattr(self.registry, "bank_stats", None)
+        banks = bank_stats() if callable(bank_stats) else []
+        if banks:
+            out["banks"] = banks
+        if self.max_queue_depth_per_tenant is not None:
+            out["max_queue_depth_per_tenant"] = (
+                self.max_queue_depth_per_tenant
+            )
         return out
 
     @property
@@ -340,91 +425,157 @@ class ServingEngine:
                 self._batchers[key] = b
             return b
 
-    def _guard_dispatch(self, key, dispatch):
-        """Wrap one model-method's dispatch with the fault layer: every
-        launch and every blocking finalize (gather) feeds the
-        per-version circuit breaker, and — when a watchdog budget is
-        configured — runs under it. A tripped watchdog fails the
-        flush's callers with a typed ``WatchdogTimeout`` NOW; the stuck
-        call keeps draining on a background thread (a blocked XLA
-        gather cannot be cancelled portably) and its late result is
-        dropped — which also means the flush's in-flight slot frees
-        early, so the budget briefly under-counts true device work.
-        ``watchdog_s=None`` (the default) adds nothing to the hot path
-        beyond the breaker's per-flush lock.
-
-        Every dispatch/finalize runs under this engine's compile
-        scope: a steady-state compile caused by a served shape bills
-        ``compile.scoped_misses{scope=<engine>}``, which is exactly
-        what ``compiles_after_warmup`` measures — including across the
-        watchdog's worker thread (the scope wraps ``fn`` itself, so it
-        travels with the work, not the calling thread)."""
-        breaker = self._breaker
-        watchdog_s = self.watchdog_s
-        scope_tag = self._stats.scope
-
-        def scoped(fn):
-            def run():
-                with obs_metrics.compile_scope(scope_tag):
-                    return fn()
-
-            return run
-
-        def under_watchdog(fn):
-            fn = scoped(fn)
-            if watchdog_s is None:
-                return fn()
-            box = {}
-            done = threading.Event()
-
-            def work():
-                try:
-                    box["out"] = fn()
-                except BaseException as exc:
-                    box["exc"] = exc
-                done.set()
-
-            t = threading.Thread(target=work, daemon=True,
-                                 name="skdist-serve-watchdog")
-            t.start()
-            if not done.wait(watchdog_s):
-                faults.record("watchdog_trips")
-                raise faults.WatchdogTimeout(
-                    f"{key} dispatch exceeded its watchdog budget "
-                    f"({watchdog_s * 1e3:.0f} ms)"
+    def _bank_batcher_for(self, entry, method):
+        """The shared batcher of a banked entry's (bank, method):
+        keyed by the bank's GROUP key, so every tenant — and every
+        future generation — of the bank rides one queue and one
+        dispatch loop."""
+        bank = entry.methods[method].bank
+        key = ("__bank__", bank.key, method)
+        stale = None
+        with self._lock:
+            if self._closed:
+                raise ServingError("engine is closed")
+            b = self._batchers.get(key)
+            if b is not None and b.bank is not bank:
+                # the group key was re-created after its previous bank
+                # emptied out (unregister-all then re-register): retire
+                # the stale batcher — its queue is necessarily empty —
+                # and build one bound to the live bank. The close (two
+                # thread joins) happens AFTER the lock drops: holding
+                # the engine-wide lock through a join would stall every
+                # concurrent submit behind one wedged gather
+                stale = self._batchers.pop(key)
+                b = None
+            if b is None:
+                b = BankedBatcher(
+                    bank, method,
+                    self._guard_bank_dispatch(bank, method),
+                    max_delay_s=self.max_delay_s,
+                    stats=self._stats,
+                    name=f"{bank.name}.{method}",
                 )
-            if "exc" in box:
-                raise box["exc"]
-            return box["out"]
+                self._batchers[key] = b
+        if stale is not None:
+            stale.close(drain=False, timeout=5.0)
+        return b
 
-        def settle(exc=None):
-            if exc is None:
+    def _watchdogged(self, key, fn):
+        """Run ``fn`` under this engine's compile scope and — when a
+        watchdog budget is configured — under it. A tripped watchdog
+        raises a typed ``WatchdogTimeout`` NOW; the stuck call keeps
+        draining on a background thread (a blocked XLA gather cannot be
+        cancelled portably) and its late result is dropped — which also
+        means the flush's in-flight slot frees early, so the budget
+        briefly under-counts true device work. The compile scope wraps
+        ``fn`` itself, so scoped-miss attribution travels with the work
+        even across the watchdog's worker thread."""
+        scope_tag = self._stats.scope
+        watchdog_s = self.watchdog_s
+
+        def run():
+            with obs_metrics.compile_scope(scope_tag):
+                return fn()
+
+        if watchdog_s is None:
+            return run()
+        box = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                box["out"] = run()
+            except BaseException as exc:
+                box["exc"] = exc
+            done.set()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="skdist-serve-watchdog")
+        t.start()
+        if not done.wait(watchdog_s):
+            faults.record("watchdog_trips")
+            raise faults.WatchdogTimeout(
+                f"{key} dispatch exceeded its watchdog budget "
+                f"({watchdog_s * 1e3:.0f} ms)"
+            )
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
+    def _settle(self, keys, exc=None):
+        """Feed one flush's outcome to the per-version circuit
+        breaker(s): ``keys`` is the spec(s) the flush carried — one for
+        per-model dispatch, every interleaved tenant for a banked
+        flush (a bank fault is every rider's fault; per-tenant
+        SUBMIT-side shedding keeps the isolation)."""
+        breaker = self._breaker
+        if exc is None:
+            for key in keys:
                 breaker.record_success(key)
-                return
-            kind = faults.classify(exc)
+            return
+        kind = faults.classify(exc)
+        for key in keys:
             if breaker.record_failure(key, kind):
                 faults.logger.warning(
                     "circuit for %s OPENED after repeated %s faults "
                     "(last: %s)", key, kind, exc,
                 )
 
+    def _guard_dispatch(self, key, dispatch):
+        """Wrap one model-method's dispatch with the fault layer: every
+        launch and every blocking finalize (gather) feeds the
+        per-version circuit breaker and runs under the watchdog budget
+        + compile scope (:meth:`_watchdogged`). ``watchdog_s=None``
+        (the default) adds nothing to the hot path beyond the breaker's
+        per-flush lock."""
+        keys = (key,)
+
         def guarded(X):
             try:
-                out = under_watchdog(lambda: dispatch(X))
+                out = self._watchdogged(key, lambda: dispatch(X))
             except Exception as exc:
-                settle(exc)
+                self._settle(keys, exc)
                 raise
             if not callable(out):
-                settle()
+                self._settle(keys)
                 return out
 
             def finalize():
                 try:
-                    res = under_watchdog(out)
+                    res = self._watchdogged(key, out)
                 except Exception as exc:
-                    settle(exc)
+                    self._settle(keys, exc)
                     raise
-                settle()
+                self._settle(keys)
+                return res
+
+            return finalize
+
+        return guarded
+
+    def _guard_bank_dispatch(self, bank, method):
+        """The banked counterpart of :meth:`_guard_dispatch`: one
+        launch carries N tenants, so the breaker settle fans out over
+        every spec the flush interleaved. Signature matches what
+        ``BankedBatcher`` dispatches: ``(gen, X, tid, specs)``."""
+        tag = f"{bank.name}.{method}"
+
+        def guarded(gen, X, tid, specs):
+            try:
+                out = self._watchdogged(
+                    tag, lambda: gen.dispatch(method, X, tid)
+                )
+            except Exception as exc:
+                self._settle(specs, exc)
+                raise
+
+            def finalize():
+                try:
+                    res = self._watchdogged(tag, out)
+                except Exception as exc:
+                    self._settle(specs, exc)
+                    raise
+                self._settle(specs)
                 return res
 
             return finalize

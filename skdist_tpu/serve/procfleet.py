@@ -544,6 +544,47 @@ class ProcessReplicaSet:
 
     register = rollout
 
+    def unregister(self, name, version=None):
+        """Fleet-wide unload: drop ``name@version`` (every version with
+        ``version=None``) from every routable worker AND from the
+        rollout spec store, so respawned generations do not re-register
+        it. On banked workers this shrinks each worker's bank in place
+        (compaction releases the stacked device bytes) while the other
+        tenants keep serving. Returns the per-replica removed-spec
+        lists."""
+        if self._closed:
+            raise ServingError("replica set is closed")
+        with self._respawn_lock:
+            live = [r for r in self._replicas
+                    if r.alive and not r.draining]
+            removed = []
+            for r in live:
+                try:
+                    out = r.pool.request(
+                        "unregister",
+                        {"name": name, "version": version},
+                        self.heartbeat_timeout_s * 4,
+                    )
+                    removed.append(out.get("removed", []))
+                except Exception as exc:
+                    # a replica that cannot answer respawns consistent
+                    # from the (about to be updated) _published store
+                    faults.log_suppressed(
+                        "ProcessReplicaSet.unregister", exc
+                    )
+            with self._lock:
+                recs = self._published.get(name)
+                if recs is not None:
+                    if version is None:
+                        del self._published[name]
+                    else:
+                        recs[:] = [rec for rec in recs
+                                   if rec["version"] != int(version)]
+                        if not recs:
+                            del self._published[name]
+        self._event("unregister", None, name=name, version=version)
+        return removed
+
     def _register_on(self, r, rec):
         # registration compiles (or loads AOT artifacts) — give it the
         # spawn budget, not the request budget

@@ -23,8 +23,17 @@ Ops:
 - ``request`` → synchronous ``engine.predict(...)``; concurrent
   connections dispatch concurrently, so the engine's micro-batcher
   still coalesces across callers inside this process.
-- ``stats`` → ``engine.stats()`` (the parent's fleet rollup input).
+- ``stats`` → ``engine.stats()`` (the parent's fleet rollup input;
+  includes the per-bank occupancy block on tenant-banked workers).
 - ``drain`` → ack, then the SIGTERM path (remote graceful stop).
+
+Multi-tenant banking is configured like any other engine knob — the
+parent's ``engine_kwargs={"bank_models": True, ...}`` rides the
+``--config`` JSON — and a respawned worker re-banks incrementally as
+the parent replays its rollout store: the bank grows through the same
+capacity rungs the previous generation compiled, so with the shared
+``artifact_dir`` AOT tier the respawn registers a 1000-tenant catalog
+with zero XLA compiles.
 
 A framing violation (fuzzed/truncated/oversized frame) abandons that
 one connection; the listener and every other connection keep serving.

@@ -40,6 +40,15 @@ Params trees matching neither contract refuse loudly at registration
 rather than silently changing model semantics. Measured error stays
 inside the registration parity gate, which is the authority either
 way.
+
+Quantized tiers compose with multi-tenant banking (``serve.bank``):
+the bank stacks the QUANTIZED tree leaf-wise — int8 weights gain the
+leading bank axis next to their per-channel ``w_scale`` rows, so a
+10k-tenant int8 catalog is one (B, p, k) int8 leaf plus a (B, k) f32
+scale leaf in HBM, and the per-slot tenant gather happens BEFORE the
+in-program dequant (the member kernel, dequant included, runs
+unchanged). ``serve_dtype`` is part of the bank grouping key: an int8
+tenant and an f32 tenant of the same family never share a bank.
 """
 
 import numpy as np

@@ -29,8 +29,23 @@ Buckets are powers-of-two row counts: floored at the backend's
 task-slot count (a flush shards ``bucket/n_slots`` rows per device) and
 capped by ``backend.hbm_round_cap`` using the entry's own row byte
 width, so a bucket that could not execute is never compiled.
+
+**Multi-tenant banks** (``bank_models=True`` or ``SKDIST_SERVE_BANKED=1``):
+device entries additionally group into stacked parameter banks
+(``serve.bank``) — same kernel family / static config / meta signature
+/ ``serve_dtype`` / params shapes share ONE compiled program whose
+stacked param leaves carry a leading bank axis, so one flush scores
+interleaved requests for N tenants (see ``serve.bank``'s module
+docstring for the full design). Registration then becomes: reserve the
+version, stage the member into its bank's next generation (stack +
+prewarm + atomic swap — the other tenants keep serving the old
+generation throughout), publish the routing entry. Host-fallback
+models and ``bank=False`` registrations keep per-model dispatch
+unchanged — a mixed catalog banks what it can and falls back for the
+rest.
 """
 
+import os
 import threading
 
 import numpy as np
@@ -38,6 +53,7 @@ import numpy as np
 from ..distribute.predict import device_predict_plan
 from ..parallel import resolve_backend
 from ..utils.validation import check_is_fitted
+from .bank import ParameterBank, bank_group_key
 from .batcher import shape_buckets
 from .quantize import SERVE_DTYPES, quantized_nbytes
 
@@ -59,20 +75,24 @@ _PARITY_PROBE_ROWS = 64
 
 
 class _MethodPath:
-    """Per-(entry, method) dispatch: device (bucketed, prewarmed) or
-    host fallback (exact-shape, thread-dispatched)."""
+    """Per-(entry, method) dispatch: device (bucketed, prewarmed),
+    banked device (the tenant's rows ride its bank's shared stacked
+    program — see ``serve.bank``), or host fallback (exact-shape,
+    thread-dispatched)."""
 
-    __slots__ = ("method", "plan", "batched", "model")
+    __slots__ = ("method", "plan", "batched", "model", "bank")
 
-    def __init__(self, model, method, plan=None, batched=None):
+    def __init__(self, model, method, plan=None, batched=None,
+                 bank=None):
         self.model = model
         self.method = method
         self.plan = plan          # DevicePredictPlan (device) or None
         self.batched = batched    # parallel.BatchedPlan or None
+        self.bank = bank          # serve.bank.ParameterBank or None
 
     @property
     def device(self):
-        return self.batched is not None
+        return self.batched is not None or self.bank is not None
 
     def dispatch(self, X):
         """One flush: (rows, d) float32 (bucket-padded, rows a multiple
@@ -104,11 +124,11 @@ class ModelEntry:
 
     __slots__ = ("name", "version", "model", "methods", "buckets",
                  "n_features", "serve_dtype", "quant_error",
-                 "params_nbytes")
+                 "params_nbytes", "bank")
 
     def __init__(self, name, version, model, methods, buckets,
                  n_features, serve_dtype="float32", quant_error=None,
-                 params_nbytes=None):
+                 params_nbytes=None, bank=None):
         self.name = name
         self.version = version
         self.model = model
@@ -124,6 +144,8 @@ class ModelEntry:
         #: methods (each method stages its own tree) — the tier's
         #: resident HBM bill
         self.params_nbytes = params_nbytes
+        #: the entry's ParameterBank when tenant-banked, else None
+        self.bank = bank
 
     @property
     def spec(self):
@@ -138,24 +160,55 @@ class ModelRegistry:
     """Thread-safe name@version store of :class:`ModelEntry` objects."""
 
     def __init__(self, backend=None, max_batch_rows=None, buckets=None,
-                 prewarm=True):
+                 prewarm=True, bank_models=None, bank_rows_per_slot=None):
         """``buckets`` overrides the power-of-two ladder (still floored
         at the backend's task slots and HBM-capped per entry);
         ``max_batch_rows`` sets the ladder's top instead.
         ``prewarm=False`` skips registration-time AOT compilation
         (first requests then compile lazily — only for tooling that
-        never serves)."""
+        never serves).
+
+        ``bank_models`` (default: the ``SKDIST_SERVE_BANKED`` env
+        flag) turns on multi-tenant parameter banking: device entries
+        group into stacked banks (``serve.bank``) and one flush scores
+        interleaved requests for many tenants. ``bank_rows_per_slot``
+        (default 1, env ``SKDIST_SERVE_BANK_ROWS``) is the row count
+        each tenant slot of a banked flush carries — 1 pads nothing
+        for single-row traffic; raise it when requests usually carry
+        several rows per tenant. Custom ``buckets`` apply to UNBANKED
+        entries only; banks derive their own slot ladder.
+        """
         self.backend = resolve_backend(backend)
         self.max_batch_rows = max_batch_rows
         self._buckets = list(buckets) if buckets is not None else None
         self.prewarm_default = bool(prewarm)
+        if bank_models is None:
+            bank_models = os.environ.get(
+                "SKDIST_SERVE_BANKED", ""
+            ).strip().lower() in ("1", "true", "yes", "on")
+        self.bank_models = bool(bank_models)
+        if bank_rows_per_slot is None:
+            raw = os.environ.get("SKDIST_SERVE_BANK_ROWS", "").strip()
+            bank_rows_per_slot = int(raw) if raw else 1
+        self.bank_rows_per_slot = max(1, int(bank_rows_per_slot))
         self._lock = threading.Lock()
         self._models = {}  # name -> {version: ModelEntry}
+        #: versions ever RESERVED per name (monotonic even across a
+        #: failed banked registration, which burns its number — the
+        #: price of staging outside the lock so publishing one tenant
+        #: never blocks routing reads for the others)
+        self._assigned = {}
+        #: membership transitions (bank lookup/create + add/remove +
+        #: drop-when-empty) serialize here; the request path never
+        #: takes it
+        self._banks_lock = threading.Lock()
+        self._banks = {}   # bank_group_key -> ParameterBank
+        self._bank_seq = 0
 
     # ------------------------------------------------------------------
     def register(self, name, model, methods=("predict",), version=None,
                  prewarm=None, serve_dtype="float32",
-                 quant_parity_bound=None):
+                 quant_parity_bound=None, bank=None):
         """Validate, stage, prewarm, and store; returns the entry.
 
         ``serve_dtype`` selects the stored-parameter precision tier
@@ -172,6 +225,14 @@ class ModelRegistry:
         key, so each registered tier is its own AOT-cached program
         family (publish the same model under several names/versions to
         route screening traffic at int8 next to exact f32).
+
+        ``bank`` overrides the registry's ``bank_models`` default for
+        this one entry (``False`` forces per-model dispatch inside a
+        banked registry — the parity baseline's escape hatch). Banked
+        registration is reserve-version → stage-into-bank (stack +
+        prewarm + atomic generation swap, the other tenants still
+        serving) → publish; a staging failure burns the reserved
+        version number but publishes nothing.
         """
         check_is_fitted(model)
         if serve_dtype not in SERVE_DTYPES:
@@ -187,7 +248,8 @@ class ModelRegistry:
                 raise ValueError(
                     f"model {type(model).__name__} has no {m!r} method"
                 )
-        paths = {}
+        do_prewarm = self.prewarm_default if prewarm is None else prewarm
+        plans = {}
         quant_error = None
         params_nbytes = None
         for m in methods:
@@ -200,7 +262,6 @@ class ModelRegistry:
                         f"{type(model).__name__} serves through the "
                         "host fallback, which is float32-only"
                     )
-                paths[m] = _MethodPath(model, m)
             else:
                 if serve_dtype != "float32":
                     err = self._quant_parity_probe(model, m, plan)
@@ -222,6 +283,21 @@ class ModelRegistry:
                         (params_nbytes or 0)
                         + quantized_nbytes(plan.params)
                     )
+            plans[m] = plan
+
+        banked = ((self.bank_models if bank is None else bool(bank))
+                  and all(p is not None for p in plans.values()))
+        if banked:
+            return self._register_banked(
+                name, model, version, plans, serve_dtype,
+                quant_error, params_nbytes, do_prewarm,
+            )
+
+        paths = {}
+        for m, plan in plans.items():
+            if plan is None:
+                paths[m] = _MethodPath(model, m)
+            else:
                 batched = self.backend.prepare_batched(
                     plan.block_kernel(), {"params": plan.params},
                     cache_key=plan.cache_key(),
@@ -236,26 +312,130 @@ class ModelRegistry:
         # live rollout that request must hit already-compiled programs
         # (a compile here would both spike its latency and trip the
         # compiles_after_warmup == 0 invariant)
-        if (self.prewarm_default if prewarm is None else prewarm):
+        if do_prewarm:
             self._prewarm_paths(paths, buckets, n_features)
 
         with self._lock:
-            versions = self._models.setdefault(name, {})
-            if version is None:
-                version = max(versions) + 1 if versions else 1
-            else:
-                version = int(version)
-                if version in versions:
-                    raise ValueError(
-                        f"{name}@{version} is already registered; "
-                        "versions are immutable — register a new one"
-                    )
+            version = self._reserve_version_locked(name, version)
             entry = ModelEntry(name, version, model, paths, buckets,
                                n_features, serve_dtype=serve_dtype,
                                quant_error=quant_error,
                                params_nbytes=params_nbytes)
-            versions[version] = entry
+            self._models.setdefault(name, {})[version] = entry
         return entry
+
+    # ------------------------------------------------------------------
+    # banked registration
+    # ------------------------------------------------------------------
+    def _register_banked(self, name, model, version, plans, serve_dtype,
+                         quant_error, params_nbytes, do_prewarm):
+        """The tenant-banked publish: the version is reserved FIRST (so
+        the spec — ``name@version`` — can join its bank before routing
+        sees it), the bank stages + prewarms + swaps its next
+        generation, then the entry lands in the routing table. Routing
+        reads never block on the stage (the registry lock is held only
+        around the reservation and the final publish)."""
+        with self._lock:
+            version = self._reserve_version_locked(name, version)
+        spec = f"{name}@{version}"
+        with self._banks_lock:
+            bank = self._bank_for(plans)
+            bank.add_member(spec, plans, prewarm=do_prewarm)
+        paths = {
+            m: _MethodPath(model, m, plan=plan, bank=bank)
+            for m, plan in plans.items()
+        }
+        ref = next(iter(plans.values()))
+        entry = ModelEntry(
+            name, version, model, paths, bank.row_buckets(),
+            int(ref.n_features), serve_dtype=serve_dtype,
+            quant_error=quant_error, params_nbytes=params_nbytes,
+            bank=bank,
+        )
+        with self._lock:
+            self._models.setdefault(name, {})[version] = entry
+        return entry
+
+    def _reserve_version_locked(self, name, version):
+        """Version numbering under the registry lock: monotonic per
+        name over every version ever PUBLISHED OR RESERVED, so a banked
+        registration staging outside the lock can never collide with a
+        concurrent one, and explicit re-use of any historical number
+        stays an immutability error."""
+        assigned = self._assigned.setdefault(name, set())
+        taken = set(self._models.get(name, ())) | assigned
+        if version is None:
+            version = max(taken) + 1 if taken else 1
+        else:
+            version = int(version)
+            if version in taken:
+                raise ValueError(
+                    f"{name}@{version} is already registered; "
+                    "versions are immutable — register a new one"
+                )
+        assigned.add(version)
+        return version
+
+    def _bank_for(self, plans):
+        """Resolve (or create) the bank a plans set belongs to. Caller
+        holds ``_banks_lock``."""
+        key = bank_group_key(plans, self.bank_rows_per_slot)
+        bank = self._banks.get(key)
+        if bank is None:
+            bank = ParameterBank(
+                key, f"bank{self._bank_seq}", self.backend, plans,
+                self.bank_rows_per_slot,
+                self._bank_slot_buckets(plans),
+            )
+            self._banks[key] = bank
+            self._bank_seq += 1
+        return bank
+
+    def _bank_slot_buckets(self, plans):
+        """The slot-count ladder of a new bank: the row ladder's policy
+        (doubling, floored at the mesh task slots) applied to SLOTS,
+        with the HBM cap billed per slot (``rows_per_slot`` input rows
+        + widest output rows + the tid scalar)."""
+        r = self.bank_rows_per_slot
+        d = max(int(p.n_features) for p in plans.values())
+        out_w = max(int(p.out_width) for p in plans.values())
+        n_slots = getattr(self.backend, "n_task_slots", 1)
+        max_rows = self.max_batch_rows or _DEFAULT_MAX_BATCH_ROWS
+        max_slots = max(n_slots, max_rows // r)
+        cap = self.backend.hbm_round_cap(r * 4 * (d + out_w) + 4)
+        if cap is not None:
+            max_slots = min(max_slots, max(n_slots, cap))
+        return shape_buckets(max_slots, min_rows=n_slots)
+
+    def active_banks(self):
+        """The live banks (for stats/debug and the engine's empty-bank
+        batcher cleanup)."""
+        with self._banks_lock:
+            return list(self._banks.values())
+
+    def bank_stats(self):
+        """Per-bank occupancy/capacity/generation snapshot."""
+        return [b.stats() for b in self.active_banks()]
+
+    def device_params_nbytes(self):
+        """Total STAGED device parameter bytes the registry currently
+        holds: per-entry staged trees for unbanked device entries plus
+        every bank's current stacked generation — the evidence that
+        ``unregister`` (and bank compaction) actually releases
+        residency."""
+        with self._lock:
+            entries = [e for vs in self._models.values()
+                       for e in vs.values()]
+        total = 0
+        for e in entries:
+            if e.bank is not None:
+                continue  # banked residency is billed per bank below
+            for p in e.methods.values():
+                if p.plan is not None:
+                    total += quantized_nbytes(p.plan.params)
+        for b in self.active_banks():
+            total += b.nbytes
+        return int(total)
 
     @staticmethod
     def _quant_parity_probe(model, method, qplan):
@@ -319,7 +499,11 @@ class ModelRegistry:
 
     def prewarm_entry(self, entry):
         """AOT-compile every (method, bucket) program of an existing
-        entry (e.g. after registering with ``prewarm=False``)."""
+        entry (e.g. after registering with ``prewarm=False``). A banked
+        entry prewarms its BANK's current generation (shared with its
+        co-tenants)."""
+        if entry.bank is not None:
+            return entry.bank.prewarm()
         return self._prewarm_paths(entry.methods, entry.buckets,
                                    entry.n_features)
 
@@ -334,8 +518,8 @@ class ModelRegistry:
             return 0
         n = 0
         for path in paths.values():
-            if not path.device:
-                continue
+            if path.batched is None:  # host fallback or banked (the
+                continue              # bank prewarms its own ladder)
             n_slots = path.batched.n_task_slots
             for bucket in buckets:
                 block = bucket // n_slots
@@ -396,7 +580,17 @@ class ModelRegistry:
         server accumulates one device-resident parameter set per
         historical version. Returns the removed entries. In-flight
         requests holding the entry finish normally (the plan lives
-        until their dispatch drops it)."""
+        until their dispatch drops it).
+
+        Banked entries leave their bank: the spec drops out of the
+        routing generation immediately (queued requests for it fail
+        typed at their flush), the slot becomes a hole, and the stacked
+        DEVICE bytes release at the bank's next compaction (occupancy
+        < 50% — see ``serve.bank``). On-disk AOT artifacts are keyed by
+        program SHAPE and shared by every tenant of the family, so
+        there is nothing per-tenant to delete there. An emptied bank is
+        dropped entirely (its generations — and their device arrays —
+        die with the last outstanding flush)."""
         with self._lock:
             versions = self._models.get(name)
             if not versions:
@@ -417,7 +611,23 @@ class ModelRegistry:
                     ) from None
                 if not versions:
                     del self._models[name]
-            return removed
+            # release the numbers: unregister-then-re-register of an
+            # explicit version stays legal (as it always was), and a
+            # fully unloaded name restarts at 1. Reservations of
+            # still-staging banked registrations are NOT removed (they
+            # were never published, so they are not in `removed`).
+            assigned = self._assigned.get(name)
+            if assigned is not None:
+                assigned.difference_update(e.version for e in removed)
+                if not assigned:
+                    self._assigned.pop(name, None)
+        for entry in removed:
+            if entry.bank is not None:
+                with self._banks_lock:
+                    left = entry.bank.remove_member(entry.spec)
+                    if left == 0:
+                        self._banks.pop(entry.bank.key, None)
+        return removed
 
     def names(self):
         with self._lock:

@@ -184,6 +184,45 @@ class ReplicaSet:
     # an alias matching the single-engine verb
     register = rollout
 
+    def unregister(self, name, version=None, drain=True):
+        """Fleet-wide unload: drop ``name@version`` (every version with
+        ``version=None``) from every live replica AND from the rollout
+        spec store, so future respawned generations do not re-register
+        it — without this, an unloaded tenant's params would resurrect
+        on the next respawn. Returns the per-replica removed-entry
+        lists. On banked engines this is the incremental re-bank
+        shrink: each replica's bank drops the tenant (compaction below
+        50% occupancy) while its co-tenants keep serving."""
+        if self._closed:
+            raise ServingError("replica set is closed")
+        removed = []
+        for r in self._live():
+            # per-replica tolerance (mirrors ProcessReplicaSet): a
+            # replica that cannot unload now (dying, already missing
+            # the name) must not strand the spec-store cleanup — its
+            # next respawn rebuilds from the updated store anyway, and
+            # aborting here would leave the fleet split-brain with no
+            # working retry (the healthy replicas already unloaded)
+            try:
+                removed.append(
+                    r.engine.unregister(name, version=version,
+                                        drain=drain)
+                )
+            except Exception as exc:
+                faults.log_suppressed("ReplicaSet.unregister", exc)
+        with self._lock:
+            recs = self._published.get(name)
+            if recs is not None:
+                if version is None:
+                    del self._published[name]
+                else:
+                    recs[:] = [rec for rec in recs
+                               if rec["version"] != int(version)]
+                    if not recs:
+                        del self._published[name]
+        self._event("unregister", None, name=name, version=version)
+        return removed
+
     # ------------------------------------------------------------------
     # request path
     # ------------------------------------------------------------------

@@ -33,6 +33,7 @@ multi-tenant serving item:
 """
 
 import itertools
+import os
 import threading
 from collections import deque
 
@@ -45,14 +46,51 @@ __all__ = ["ServingStats"]
 #: also the ``engine`` label on the registry-side serving counters
 _SCOPE_IDS = itertools.count()
 
+#: per-model splits beyond the cap aggregate here — at 1000+ tenants an
+#: unbounded ``by_model`` table (and its label children) would make
+#: every snapshot and every Prometheus scrape O(tenants)
+_MODEL_OVERFLOW_KEY = "_other"
+
+#: default cap on distinct per-model split cells (dtype splits are
+#: bounded by SERVE_DTYPES and stay uncapped)
+_DEFAULT_MODEL_SPLITS = 512
+
+#: bucket boundaries of the tenants-per-flush histogram (counts, not
+#: seconds — the default latency ladder would collapse everything into
+#: the +Inf bucket)
+_TENANTS_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
 
 class ServingStats:
-    """Thread-safe rolling serving metrics (see module docstring)."""
+    """Thread-safe rolling serving metrics (see module docstring).
 
-    def __init__(self, window=4096, scope=None):
+    **Cardinality guards** (the multi-tenant catalog's protection):
+    ``max_model_splits`` caps the per-``name@version`` split table —
+    tenants past the cap aggregate under ``"_other"`` — and each
+    per-model cell's latency ring is bounded at ``window // 16``
+    samples (the engine-wide ring keeps the full window; a 1000-tenant
+    catalog must not hold 1000 full-size rings). ``fleet_rollup_only``
+    (or ``SKDIST_SERVE_FLEET_ROLLUP_ONLY=1``) drops the per-model
+    dimension entirely — no ``by_model`` cells, no ``model=`` label on
+    the registry-side counters — so the Prometheus exposition stays
+    O(pages), not O(tenants); the fleet/dtype rollups and the
+    per-tenant circuit breakers are unaffected.
+    """
+
+    def __init__(self, window=4096, scope=None, max_model_splits=None,
+                 fleet_rollup_only=None):
         self._lock = threading.Lock()
         self._lat = deque(maxlen=window)
         self._window = window
+        self.max_model_splits = (
+            _DEFAULT_MODEL_SPLITS if max_model_splits is None
+            else max(1, int(max_model_splits))
+        )
+        if fleet_rollup_only is None:
+            fleet_rollup_only = os.environ.get(
+                "SKDIST_SERVE_FLEET_ROLLUP_ONLY", ""
+            ).strip().lower() in ("1", "true", "yes", "on")
+        self.fleet_rollup_only = bool(fleet_rollup_only)
         #: the compile-attribution tag (obs.metrics.compile_scope) and
         #: the ``engine`` label of this engine's registry counters
         self.scope = (
@@ -73,6 +111,9 @@ class ServingStats:
         #: per-model (name@version) split: same shape as the dtype
         #: split — the first rung of per-tenant stats
         self._by_model = {}
+        #: tenants-per-flush rolling histogram {n_tenants: flushes} —
+        #: how much tenant interleaving the banked batcher achieves
+        self._tenants_per_flush = {}
         self._bucket_hits = {}
         self._rows_served = 0
         self._capacity_served = 0
@@ -123,18 +164,36 @@ class ServingStats:
     # ------------------------------------------------------------------
     # recording (batcher/engine side)
     # ------------------------------------------------------------------
-    def _cell(self, table, key):
+    def _cell(self, table, key, ring=None):
         cell = table.get(key)
         if cell is None:
             cell = table[key] = {
                 "requests": 0, "completed": 0,
-                "lat": deque(maxlen=max(256, self._window // 4)),
+                "lat": deque(maxlen=ring
+                             or max(256, self._window // 4)),
             }
         return cell
 
+    def _model_cell(self, model):
+        """The per-tenant split cell, under the cardinality guard:
+        None in rollup-only mode; the overflow cell once the table is
+        at its cap; always a SMALL latency ring (``window // 16``)."""
+        if self.fleet_rollup_only:
+            return None
+        if (model not in self._by_model
+                and len(self._by_model) >= self.max_model_splits):
+            model = _MODEL_OVERFLOW_KEY
+        return self._cell(self._by_model, model,
+                          ring=max(64, self._window // 16))
+
     def _route(self, model, serve_dtype):
         """One dict hit on the request hot path: the (model, dtype)
-        route's three bound registry handles, resolved once."""
+        route's three bound registry handles, resolved once. In
+        rollup-only mode the model label is dropped BEFORE binding, so
+        the registry's serving families never grow a per-tenant label
+        dimension."""
+        if self.fleet_rollup_only:
+            model = None
         key = (model, serve_dtype)
         r = self._bound.get(key)
         if r is None:
@@ -157,7 +216,9 @@ class ServingStats:
             if serve_dtype is not None:
                 self._cell(self._by_dtype, serve_dtype)["requests"] += 1
             if model is not None:
-                self._cell(self._by_model, model)["requests"] += 1
+                cell = self._model_cell(model)
+                if cell is not None:
+                    cell["requests"] += 1
         self._route(model, serve_dtype)[0].inc()
 
     def record_completed(self, latency_s, serve_dtype=None, model=None):
@@ -170,9 +231,10 @@ class ServingStats:
                 cell["completed"] += 1
                 cell["lat"].append(latency_s)
             if model is not None:
-                cell = self._cell(self._by_model, model)
-                cell["completed"] += 1
-                cell["lat"].append(latency_s)
+                cell = self._model_cell(model)
+                if cell is not None:
+                    cell["completed"] += 1
+                    cell["lat"].append(latency_s)
         _req, comp, lat = self._route(model, serve_dtype)
         comp.inc()
         lat.observe(latency_s)
@@ -192,7 +254,10 @@ class ServingStats:
                 self._dispatch_errors += 1
         self._bound_child("serve.rejections", kind=str(kind)).inc()
 
-    def record_flush(self, rows, bucket):
+    def record_flush(self, rows, bucket, tenants=None):
+        """``tenants`` (banked flushes) is how many DISTINCT models the
+        flush interleaved — the multi-tenant batching win, recorded as
+        a count histogram."""
         with self._lock:
             self._flushes += 1
             self._rows_served += int(rows)
@@ -200,9 +265,19 @@ class ServingStats:
             self._bucket_hits[int(bucket)] = (
                 self._bucket_hits.get(int(bucket), 0) + 1
             )
+            if tenants is not None:
+                self._tenants_per_flush[int(tenants)] = (
+                    self._tenants_per_flush.get(int(tenants), 0) + 1
+                )
         self._bound_child("serve.flushes").inc()
         self._bound_child("serve.rows_served").inc(int(rows))
         self._bound_child("serve.capacity_served").inc(int(bucket))
+        if tenants is not None:
+            obs_metrics.histogram(
+                "serve.tenants_per_flush",
+                help="distinct tenants interleaved per banked flush",
+                buckets=_TENANTS_BUCKETS,
+            ).observe(int(tenants), **self._reg_labels())
 
     def set_queue_depth(self, depth, key=None):
         """Per-batcher gauge (``key`` = the batcher's name): a
@@ -284,6 +359,12 @@ class ServingStats:
                 ),
                 "bucket_hits": dict(sorted(self._bucket_hits.items())),
             }
+            if self._tenants_per_flush:
+                out["tenants_per_flush"] = dict(
+                    sorted(self._tenants_per_flush.items())
+                )
+            if self.fleet_rollup_only:
+                out["stats_mode"] = "fleet_rollup_only"
             by_dtype = {
                 dt: {"requests": c["requests"],
                      "completed": c["completed"],

@@ -1,0 +1,357 @@
+"""
+Multi-tenant banked serving (skdist_tpu.serve.bank): bank grouping and
+generation swaps, banked-vs-unbanked byte parity across precision
+tiers, mixed-family fallback, rollout/unregister under load, per-tenant
+admission + stats cardinality guards, and process-fleet re-banking.
+"""
+
+import copy
+import threading
+
+import numpy as np
+import pytest
+
+from skdist_tpu.models import LinearSVC, LogisticRegression
+from skdist_tpu.serve import Overloaded, ServingEngine, ServingStats
+from skdist_tpu.serve.stats import _MODEL_OVERFLOW_KEY
+
+
+def _perturbed(model, i, eps=0.03):
+    """A distinct tenant from one fitted template: same shapes/meta
+    (same bank group), visibly different coefficients (so a scatter
+    bug routes to the WRONG answer, not the same one)."""
+    m = copy.deepcopy(model)
+    m._params = {
+        k: ((np.asarray(v) * (1.0 + eps * (i + 1))).astype(
+            np.asarray(v).dtype) if k == "W" else v)
+        for k, v in m._params.items()
+    }
+    return m
+
+
+@pytest.fixture(scope="module")
+def tenant_data():
+    rng = np.random.RandomState(0)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.7, size=(80, 8)) for c in (-1.5, 1.5)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1], 80)
+    base = LogisticRegression(max_iter=40).fit(X, y)
+    return X, y, base
+
+
+# ---------------------------------------------------------------------------
+# bank grouping + parity
+# ---------------------------------------------------------------------------
+
+def test_banked_outputs_byte_identical_per_tenant(tenant_data,
+                                                  tpu_backend):
+    """The acceptance core: every tenant's banked outputs are
+    byte-identical to its own unbanked dispatch, for every precision
+    tier — the tid-gather wrapper must not change per-row math."""
+    X, _, base = tenant_data
+    tenants = [_perturbed(base, i) for i in range(6)]
+    for dtype in ("float32", "bfloat16", "int8"):
+        banked = ServingEngine(backend=tpu_backend, max_batch_rows=64,
+                               max_delay_ms=1.0, bank_models=True)
+        plain = ServingEngine(backend=tpu_backend, max_batch_rows=64,
+                              max_delay_ms=1.0, bank_models=False)
+        for i, m in enumerate(tenants):
+            for eng in (banked, plain):
+                eng.register(f"t{i}", m, methods=("predict_proba",),
+                             serve_dtype=dtype)
+        assert len(banked.registry.active_banks()) == 1
+        assert not plain.registry.active_banks()
+        for i in range(len(tenants)):
+            for n in (1, 3, 7):
+                got = banked.predict_proba(X[:n], model=f"t{i}",
+                                           timeout_s=30)
+                ref = plain.predict_proba(X[:n], model=f"t{i}",
+                                          timeout_s=30)
+                assert np.array_equal(np.asarray(got), np.asarray(ref)), (
+                    f"{dtype} tenant {i} rows {n}: banked != unbanked"
+                )
+        assert banked.stats()["compiles_after_warmup"] == 0
+        banked.close()
+        plain.close()
+
+
+def test_bank_grouping_rules(tenant_data, tpu_backend):
+    """Same family+shape+dtype share one bank; a different family, a
+    different dtype, and a host model do not."""
+    X, y, base = tenant_data
+    svc = LinearSVC(max_iter=30).fit(X, y)
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    sk = SkLR(max_iter=100).fit(X, y)
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=32,
+                        max_delay_ms=1.0, bank_models=True)
+    e1 = eng.register("a", _perturbed(base, 0))
+    e2 = eng.register("b", _perturbed(base, 1))
+    e3 = eng.register("svc", svc)                      # other family
+    e4 = eng.register("a8", _perturbed(base, 2), serve_dtype="int8")
+    e5 = eng.register("sk", sk)                        # host fallback
+    e6 = eng.register("solo", _perturbed(base, 3), bank=False)
+    assert e1.bank is e2.bank and e1.bank is not None
+    assert e3.bank is not None and e3.bank is not e1.bank
+    assert e4.bank is not None and e4.bank is not e1.bank
+    assert e5.bank is None and not e5.device
+    assert e6.bank is None and e6.device  # per-model opt-out
+    # mixed catalog still serves every route correctly
+    assert (eng.predict(X[:4], model="sk") == sk.predict(X[:4])).all()
+    assert (eng.predict(X[:4], model="svc") == svc.predict(X[:4])).all()
+    assert (eng.predict(X[:4], model="solo")
+            == e6.model.predict(X[:4])).all()
+    assert (eng.predict(X[:4], model="a") == e1.model.predict(X[:4])).all()
+    st = eng.stats()
+    assert len(st["banks"]) == 3
+    eng.close()
+
+
+def test_bank_capacity_ladder_and_slots(tenant_data, tpu_backend):
+    """Capacity is a power-of-two ladder over members; re-registering
+    within capacity changes no shapes (generation bumps, capacity
+    does not)."""
+    X, _, base = tenant_data
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=32,
+                        max_delay_ms=1.0, bank_models=True)
+    caps = []
+    for i in range(5):
+        eng.register(f"t{i}", _perturbed(base, i))
+        caps.append(eng.registry.active_banks()[0].capacity)
+    assert caps == [1, 2, 4, 4, 8]
+    bank = eng.registry.active_banks()[0]
+    assert bank.current.slot_of == {
+        f"t{i}@1": i for i in range(5)
+    }
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# rollout / unregister lifecycle
+# ---------------------------------------------------------------------------
+
+def test_rollout_under_load_zero_failures(tenant_data, tpu_backend):
+    """Publishing version k+1 of one tenant (a fresh bank generation,
+    atomically swapped) must not fail or pause in-flight traffic for
+    any tenant."""
+    X, _, base = tenant_data
+    n_tenants = 8
+    tenants = [_perturbed(base, i) for i in range(n_tenants)]
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=64,
+                        max_delay_ms=1.0, bank_models=True)
+    for i, m in enumerate(tenants):
+        eng.register(f"t{i}", m)
+    expected = {i: m.predict(X) for i, m in enumerate(tenants)}
+    errors = []
+    stop = threading.Event()
+
+    def client(seed):
+        r = np.random.RandomState(seed)
+        while not stop.is_set():
+            t = int(r.randint(0, n_tenants))
+            n = int(r.randint(1, 5))
+            i = int(r.randint(0, len(X) - n))
+            try:
+                out = eng.predict(X[i:i + n], model=f"t{t}@1",
+                                  timeout_s=30)
+                if not (out == expected[t][i:i + n]).all():
+                    errors.append(("mismatch", seed, t))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(("error", seed, repr(exc)))
+
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        # two rollovers + one brand-new tenant, all mid-traffic
+        v2 = _perturbed(base, 50)
+        eng.register("t3", v2)             # t3@2 — re-bank + swap
+        eng.register("t0", _perturbed(base, 51))
+        eng.register("fresh", _perturbed(base, 52))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors[:3]
+    # the rollover actually routes: bare name -> v2's coefficients
+    out = eng.predict(X[:5], model="t3")
+    assert (out == v2.predict(X[:5])).all()
+    bank = eng.registry.active_banks()[0]
+    assert len(bank.members()) == n_tenants + 3
+    assert eng.stats()["compiles_after_warmup"] == 0
+    eng.close()
+
+
+def test_unregister_releases_bank_bytes(tenant_data, tpu_backend):
+    """The bytes-released audit: dropping tenants below 50% occupancy
+    compacts the bank (device residency shrinks); dropping the last
+    tenant drops the bank and its batcher entirely."""
+    X, _, base = tenant_data
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=32,
+                        max_delay_ms=1.0, bank_models=True)
+    for i in range(8):
+        eng.register(f"t{i}", _perturbed(base, i))
+    eng.predict(X[:2], model="t0")  # materialise the bank batcher
+    full = eng.registry.device_params_nbytes()
+    assert full > 0
+    bank = eng.registry.active_banks()[0]
+    assert bank.capacity == 8
+    for i in range(6):
+        eng.unregister(f"t{i}")
+    shrunk = eng.registry.device_params_nbytes()
+    assert shrunk <= full // 2, (full, shrunk)
+    assert eng.registry.active_banks()[0].capacity == 2
+    # the survivors still serve, and a queued unregistered spec fails
+    out = eng.predict(X[:3], model="t7")
+    assert (out == _perturbed(base, 7).predict(X[:3])).all()
+    eng.unregister("t6")
+    eng.unregister("t7")
+    assert eng.registry.device_params_nbytes() == 0
+    assert not eng.registry.active_banks()
+    assert not any(k[0] == "__bank__" for k in eng._batchers)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant admission + stats cardinality
+# ---------------------------------------------------------------------------
+
+class _SlowHostModel:
+    def __init__(self, delay_s=0.25):
+        self.delay_s = delay_s
+        self.fitted_ = True
+        self.n_features_in_ = 4
+
+    def predict(self, X):
+        import time
+
+        time.sleep(self.delay_s)
+        return np.zeros(np.asarray(X).shape[0])
+
+
+def test_per_tenant_admission_bound(tpu_backend):
+    """One chatty tenant hits ITS bound (typed Overloaded) while a
+    co-tenant's submissions stay admitted."""
+    eng = ServingEngine(backend=tpu_backend, max_delay_ms=1.0,
+                        max_queue_depth=64,
+                        max_queue_depth_per_tenant=2)
+    eng.register("chatty", _SlowHostModel(), prewarm=False)
+    eng.register("quiet", _SlowHostModel(0.01), prewarm=False)
+    x = np.zeros((1, 4), np.float32)
+    futs = [eng.submit(x, model="chatty") for _ in range(2)]
+    with pytest.raises(Overloaded, match="max_queue_depth_per_tenant"):
+        eng.submit(x, model="chatty")
+    # the co-tenant is unaffected by chatty's bound
+    futs.append(eng.submit(x, model="quiet"))
+    eng.close(drain=True)
+    assert all(f.done() for f in futs)
+    assert not eng._tenant_pending  # every slot released
+
+
+def test_stats_model_split_cardinality_cap():
+    stats = ServingStats(window=1024, max_model_splits=4)
+    for i in range(10):
+        stats.record_submitted(serve_dtype="float32", model=f"m{i}@1")
+        stats.record_completed(0.001, serve_dtype="float32",
+                               model=f"m{i}@1")
+    snap = stats.snapshot()
+    by_model = snap["by_model"]
+    assert len(by_model) == 5  # 4 distinct + the overflow cell
+    assert _MODEL_OVERFLOW_KEY in by_model
+    assert by_model[_MODEL_OVERFLOW_KEY]["requests"] == 6
+    # per-tenant rings are capped well below the engine-wide window
+    cell = stats._by_model["m0@1"]
+    assert cell["lat"].maxlen == max(64, 1024 // 16)
+
+
+def test_stats_fleet_rollup_only_drops_model_dimension():
+    from skdist_tpu.obs import metrics as obs_metrics
+
+    stats = ServingStats(window=256, fleet_rollup_only=True)
+    scope = stats.scope
+    for i in range(5):
+        stats.record_submitted(serve_dtype="float32", model=f"m{i}@1")
+        stats.record_completed(0.002, serve_dtype="float32",
+                               model=f"m{i}@1")
+    snap = stats.snapshot()
+    assert "by_model" not in snap
+    assert snap["stats_mode"] == "fleet_rollup_only"
+    assert snap["by_serve_dtype"]["float32"]["completed"] == 5
+    # the registry-side counters never grew a model label under this
+    # engine's scope — exposition stays O(pages), not O(tenants)
+    kids = obs_metrics.counter("serve.requests").children()
+    scoped = [k for k in kids if ("engine", scope) in k]
+    assert scoped and all(
+        not any(lk == "model" for lk, _ in key) for key in scoped
+    )
+
+
+def test_tenants_per_flush_recorded(tenant_data, tpu_backend):
+    """Concurrent mixed-tenant traffic interleaves tenants into shared
+    flushes, and the stats record it."""
+    X, _, base = tenant_data
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=64,
+                        max_delay_ms=4.0, bank_models=True)
+    n_tenants = 6
+    for i in range(n_tenants):
+        eng.register(f"t{i}", _perturbed(base, i))
+    errors = []
+
+    def client(t):
+        try:
+            for _ in range(10):
+                eng.predict(X[:2], model=f"t{t}", timeout_s=30)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_tenants)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    st = eng.stats()
+    tpf = st.get("tenants_per_flush")
+    assert tpf and max(tpf) >= 2, tpf  # >=1 flush carried >=2 tenants
+    assert st["banks"][0]["members"] == n_tenants
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: respawn re-banking
+# ---------------------------------------------------------------------------
+
+def test_procfleet_respawn_rebanks_zero_compiles(tenant_data, tmp_path):
+    """A ProcessReplicaSet worker generation replaced under
+    rolling_restart re-banks its whole catalog from the rollout store
+    (same capacity rungs, shared AOT artifact tier) and serves every
+    tenant with zero post-warmup compiles."""
+    from skdist_tpu.serve import ProcessReplicaSet
+
+    X, _, base = tenant_data
+    tenants = [_perturbed(base, i) for i in range(6)]
+    with ProcessReplicaSet(
+        n_replicas=1,
+        artifact_dir=str(tmp_path / "aot"),
+        engine_kwargs={"max_batch_rows": 32, "max_delay_ms": 1.0,
+                       "bank_models": True},
+        heartbeat_interval_s=0.2, respawn_backoff_s=0.05,
+    ) as fleet:
+        for i, m in enumerate(tenants):
+            fleet.rollout(f"t{i}", m, methods=("predict",))
+        gen0 = fleet.replica(0).generation
+        fleet.rolling_restart()
+        assert fleet.replica(0).generation > gen0
+        for i, m in enumerate(tenants):
+            out = fleet.predict(X[:3], model=f"t{i}", timeout_s=40.0)
+            assert (out == m.predict(X[:3])).all(), f"tenant {i}"
+        st = fleet.stats()
+        eng = st["replicas"][0]["engine"]
+        assert eng["compiles_after_warmup"] == 0
+        assert eng["banks"][0]["members"] == len(tenants)
+        # fleet-wide unload shrinks the respawn spec store too
+        fleet.unregister("t5")
+        assert "t5" not in fleet.stats()["published"]
