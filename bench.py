@@ -1107,6 +1107,225 @@ def obs_aux(quick=True, repeats=3, trace_path=None):
             os.environ["SKDIST_SLICE_ITERS"] = old_slice
 
 
+def obs_fleet_aux(quick=True, repeats=2, trace_path=None,
+                  incident_dir=None):
+    """Measured readout of FLEET-WIDE observability (PR 15) on a
+    3-process ``ProcessReplicaSet`` under threaded load:
+
+    - the traced leg SIGKILLs replica 1's process mid-load and collects
+      the evidence: a pre-kill ``/metrics`` scrape covering all three
+      replicas' harvested counters, the incident file the supervisor
+      dumped for the dead replica (with the worker's standing
+      flight-recorder snapshot embedded), the stitched Perfetto trace
+      (per-process tracks + cross-process route→flush flow links), and
+      post-respawn HARVESTED ``compiles_after_warmup`` deltas;
+    - two untraced legs measure the telemetry harvest's cost: the same
+      load with the periodic harvest ON vs ``SKDIST_OBS_HARVEST=0``
+      (min-of-``repeats`` walls each) → ``harvest_overhead_frac``.
+
+    Best-effort: a dict with "error" on any failure."""
+    import shutil
+    import tempfile
+    import threading as _threading
+    import urllib.request
+
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.obs import trace as obs_trace
+    from skdist_tpu.serve import ProcessReplicaSet
+    from skdist_tpu.testing.faultinject import FaultInjector
+
+    n_replicas = 3
+    n_threads, n_requests = (4, 30) if quick else (6, 40)
+    total = n_threads * n_requests
+    kill_at = total // 4
+    rng = np.random.RandomState(0)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.6, size=(60, 8)) for c in (-1.5, 1.5)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1], 60)
+    model = LogisticRegression(max_iter=20, engine="xla").fit(X, y)
+    aot_dir = tempfile.mkdtemp(prefix="skobs-aot-")
+    incident_dir = incident_dir or tempfile.mkdtemp(prefix="skobs-inc-")
+    prev_traced = obs_trace.enabled()
+    prev_harvest = os.environ.get("SKDIST_OBS_HARVEST")
+
+    def load(fleet, injector=None):
+        """The fixed threaded load; returns (wall_s, n_failed)."""
+        errors = []
+        lock = _threading.Lock()
+
+        def client(tid):
+            crng = np.random.RandomState(tid)
+            for _ in range(n_requests):
+                x = crng.normal(size=(3, X.shape[1])).astype(np.float32)
+                try:
+                    out = fleet.predict(x, model="clf", timeout_s=30.0)
+                    assert np.asarray(out).shape[0] == 3
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(exc))
+
+        threads = [_threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.perf_counter()
+        if injector is not None:
+            with injector:
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+        else:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        return time.perf_counter() - t0, len(errors)
+
+    def make_fleet(harvest, obs_port=None):
+        os.environ["SKDIST_OBS_HARVEST"] = "1" if harvest else "0"
+        return ProcessReplicaSet(
+            n_replicas=n_replicas, artifact_dir=aot_dir,
+            engine_kwargs={"max_batch_rows": 64, "max_delay_ms": 1.0},
+            heartbeat_interval_s=0.25, harvest_interval_s=0.25,
+            obs_port=obs_port, incident_dir=incident_dir,
+        )
+
+    try:
+        out = {"n_replicas": n_replicas, "requests": total,
+               "kill_at": kill_at}
+
+        # -- traced + killed leg: the evidence run ---------------------
+        obs_trace.set_enabled(True)
+        obs_trace.clear()
+        with make_fleet(harvest=True, obs_port=0) as fleet:
+            fleet.rollout("clf", model, methods=("predict",))
+            for i in range(8):  # pre-kill traffic on every replica
+                fleet.predict(X[i:i + 3], model="clf", timeout_s=30.0)
+            pre_kill = urllib.request.urlopen(
+                fleet.ops_url + "/metrics", timeout=30
+            ).read().decode()
+            out["pre_kill_metric_replicas"] = sorted(
+                str(i) for i in range(n_replicas)
+                if f'replica="{i}"' in pre_kill
+            )
+            out["pre_kill_stale_zero"] = all(
+                ln.rsplit(" ", 1)[1] == "0"
+                for ln in pre_kill.splitlines()
+                if ln.startswith("skdist_stale{")
+            )
+            inj = FaultInjector().kill_replica_proc(1, at_request=kill_at)
+            wall, failed = load(fleet, injector=inj)
+            out["killed_leg_wall_s"] = round(wall, 3)
+            out["failed_requests"] = failed
+            # wait out the respawn, then prove the fleet recovered
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if fleet.replica(1).alive:
+                    break
+                time.sleep(0.2)
+            for i in range(12):
+                fleet.predict(X[i:i + 3], model="clf", timeout_s=30.0)
+            fleet.harvest_now()
+            st = fleet.stats()
+            out["respawns"] = sum(
+                1 for e in st["events"] if e["kind"] == "respawn"
+            )
+            hv = st["harvest"]["replicas"]
+            out["harvested_compiles_after_warmup"] = {
+                i: hv[i]["compiles_after_warmup"] for i in sorted(hv)
+            }
+            out["harvest_stale"] = {i: hv[i]["stale"] for i in sorted(hv)}
+            doc = fleet.export_fleet_trace(trace_path)
+            pids = {e["pid"] for e in doc["traceEvents"]
+                    if e.get("ph") != "M"}
+            out["trace_pid_tracks"] = len(pids)
+            out["trace_flow_links"] = sum(
+                1 for e in doc["traceEvents"] if e.get("ph") == "s"
+            )
+            out["trace_route_spans"] = sum(
+                1 for e in doc["traceEvents"]
+                if e.get("name") == "route" and e.get("ph") == "X"
+            )
+            out["trace_worker_flush_spans"] = sum(
+                1 for e in doc["traceEvents"]
+                if e.get("name") == "flush" and e.get("ph") == "X"
+                and e["pid"] != os.getpid()
+            )
+        incidents = sorted(
+            p for p in os.listdir(incident_dir)
+            if p.startswith("skdist-incident-") and "replica1" in p
+        )
+        out["incident_files"] = incidents
+        out["incident_parses"] = False
+        out["incident_has_worker_snapshot"] = False
+        if incidents:
+            with open(os.path.join(incident_dir, incidents[-1])) as fh:
+                idoc = json.load(fh)
+            out["incident_parses"] = (
+                idoc.get("schema") == 1
+                and idoc.get("extra", {}).get("replica") == 1
+            )
+            wsnap = idoc.get("extra", {}).get("worker_flightrec")
+            out["incident_has_worker_snapshot"] = bool(
+                wsnap and wsnap.get("pid")
+            )
+
+        # -- harvest-overhead legs (untraced, unkilled) ----------------
+        obs_trace.set_enabled(False)
+        walls = {}
+        for label, harvest in (("harvest_on", True),
+                               ("harvest_off", False)):
+            best = None
+            for _ in range(repeats):
+                with make_fleet(harvest=harvest) as fleet:
+                    fleet.rollout("clf", model, methods=("predict",))
+                    # one warm pass so neither leg pays first-flush cost
+                    load(fleet)
+                    wall, failed = load(fleet)
+                if failed:
+                    return {"error": f"{label} leg failed {failed} reqs"}
+                best = wall if best is None else min(best, wall)
+            walls[label] = best
+        out["harvest_on_wall_s"] = round(walls["harvest_on"], 3)
+        out["harvest_off_wall_s"] = round(walls["harvest_off"], 3)
+        out["harvest_overhead_frac"] = round(
+            max(0.0, walls["harvest_on"] / walls["harvest_off"] - 1.0), 4
+        )
+        # deterministic off-path bound (the obs_smoke technique): with
+        # tracing AND harvest off, this layer's only hot-path additions
+        # are one thread-local context read per submit and one no-op
+        # context scope per flush — measure the per-call cost directly
+        # and multiply by the run's call count; an A/B wall diff could
+        # never resolve nanoseconds on a multi-second fleet wall
+        n_probe = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n_probe):
+            obs_trace.current_context()
+        per_read_s = (time.perf_counter() - t0) / n_probe
+        t0 = time.perf_counter()
+        for _ in range(n_probe):
+            with obs_trace.use_context(None):
+                pass
+        per_scope_s = (time.perf_counter() - t0) / n_probe
+        out["off_path_per_call_ns"] = round(
+            (per_read_s + per_scope_s) * 1e9, 1
+        )
+        out["off_path_overhead_frac_bound"] = round(
+            total * (per_read_s + per_scope_s)
+            / walls["harvest_off"], 6
+        )
+        return out
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        obs_trace.set_enabled(prev_traced)
+        if prev_harvest is None:
+            os.environ.pop("SKDIST_OBS_HARVEST", None)
+        else:
+            os.environ["SKDIST_OBS_HARVEST"] = prev_harvest
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+
 def gbdt_workload(quick=True, seed=0):
     """Tabular multiclass problem for the GBDT readout (covtype-shaped:
     informative dense features + a non-linear term, 3 classes) plus a
@@ -1881,9 +2100,38 @@ def _obs_main(quick=True):
     return payload
 
 
+def _obs_fleet_main(quick=True):
+    """Standalone capture of the fleet-observability readout →
+    ``BENCH_obs_fleet_r15.json`` (pre-kill fleet exposition coverage,
+    incident-file evidence for a SIGKILLed replica, stitched-trace
+    track/flow counts, harvest on/off walls + overhead fraction). Also
+    writes the stitched Perfetto trace next to it
+    (``BENCH_obs_fleet_r15_trace.json``)."""
+    import jax
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    payload = {
+        "metric": "fleet_observability",
+        "aux": obs_fleet_aux(
+            quick=quick,
+            trace_path=os.path.join(
+                here, "BENCH_obs_fleet_r15_trace.json"
+            ),
+        ),
+        "platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(payload, indent=1), flush=True)
+    with open(os.path.join(here, "BENCH_obs_fleet_r15.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
 if __name__ == "__main__":
     if "--phase" in sys.argv:
         _phase_main(sys.argv)
+    elif "--obs-fleet" in sys.argv:
+        _obs_fleet_main(quick=("--full" not in sys.argv))
     elif "--obs" in sys.argv:
         _obs_main(quick=("--full" not in sys.argv))
     elif "--gbdt" in sys.argv:

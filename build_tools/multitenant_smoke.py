@@ -96,13 +96,26 @@ def fleet_leg(failures, n_tenants=64, clients=6, requests=25):
     out = fleet.predict(Xs[:4], model="f0", timeout_s=30)
     if not (np.asarray(out) == rollover.predict(Xs[:4])).all():
         failures.append("fleet leg: rollover did not route to v2")
-    st = fleet.stats()
+    st = fleet.stats()  # each engine snapshot refreshes its gauge
+    # the 0-compile gate reads the registry's harvested
+    # serve.compiles_after_warmup gauge (per engine scope + replica
+    # label — the same surface the procfleet harvest merges), not the
+    # per-engine stats field
+    from skdist_tpu.obs import metrics as obs_metrics
+
+    gauge = obs_metrics.gauge("serve.compiles_after_warmup")
+    by_replica = {
+        dict(key)["replica"]: v
+        for key, v in gauge.children().items()
+        if "replica" in dict(key)
+    }
     for ent in st["replicas"]:
         eng = ent["engine"] or {}
-        if eng.get("compiles_after_warmup") != 0:
+        harvested = by_replica.get(str(ent["index"]))
+        if harvested != 0:
             failures.append(
-                f"fleet leg: replica {ent['index']} compiles_after_"
-                f"warmup={eng.get('compiles_after_warmup')}"
+                f"fleet leg: replica {ent['index']} harvested "
+                f"compiles_after_warmup={harvested}"
             )
         banks = eng.get("banks") or []
         if not banks or banks[0]["members"] != n_tenants + 1:

@@ -1,0 +1,165 @@
+"""Fleet-observability smoke: the PR-15 acceptance gate, standalone on
+the CPU mesh.
+
+Runs ``bench.obs_fleet_aux`` — a 3-process ``ProcessReplicaSet`` under
+threaded load with replica 1's PROCESS SIGKILLed mid-load — and
+asserts:
+
+- the ops endpoint's PRE-KILL ``/metrics`` scrape carries all three
+  replicas' harvested counters (``replica=`` labels) with every
+  ``skdist_stale`` gauge at 0;
+- the fleet serves every request across the kill, respawns exactly
+  one worker, and the POST-RESPAWN **harvested**
+  ``compiles_after_warmup`` is 0 on every fresh replica (the
+  supervisor-merged value, not a worker-local field);
+- the supervisor dumped an incident file for the dead replica that
+  parses (schema 1, replica identity, death reason) and embeds the
+  worker's last standing flight-recorder snapshot;
+- the stitched trace is Perfetto-loadable with >= 3 per-process pid
+  tracks, >= 1 cross-process route→flush flow link, and worker-side
+  ``flush`` spans from non-router pids;
+- the periodic telemetry harvest costs <= 5% wall vs
+  ``SKDIST_OBS_HARVEST=0`` on the identical load, and the fully-off
+  path (harvest + tracing disabled) is bounded <= 1% by a measured
+  per-call certificate (one thread-local read per submit, one no-op
+  context scope per flush — the obs_smoke technique; an A/B wall diff
+  cannot resolve nanoseconds).
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/obs_fleet_smoke.py [--overhead 0.05] [--full]
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def _check_trace_file(path, failures):
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        failures.append("stitched trace has no traceEvents")
+        return
+    for ev in evs:
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            if key not in ev:
+                failures.append(f"stitched event missing {key}: {ev}")
+                return
+    names = [e for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    if len(names) < 3:
+        failures.append(
+            f"only {len(names)} named process tracks in the stitched "
+            "trace (want >= 3)"
+        )
+
+
+def main(argv):
+    overhead_gate = 0.05
+    if "--overhead" in argv:
+        overhead_gate = float(argv[argv.index("--overhead") + 1])
+    import tempfile
+
+    from bench import obs_fleet_aux
+
+    trace_path = os.path.join(
+        tempfile.gettempdir(), f"skdist_obs_fleet_{os.getpid()}.json"
+    )
+    aux = obs_fleet_aux(quick=("--full" not in argv),
+                        trace_path=trace_path)
+    print(json.dumps(aux, indent=1))
+    if "error" in aux:
+        raise SystemExit(f"FAIL: obs fleet aux died: {aux['error']}")
+
+    failures = []
+    if aux["pre_kill_metric_replicas"] != ["0", "1", "2"]:
+        failures.append(
+            "pre-kill /metrics scrape missing replicas: "
+            f"{aux['pre_kill_metric_replicas']}"
+        )
+    if not aux["pre_kill_stale_zero"]:
+        failures.append("a replica was stale before the kill")
+    if aux["failed_requests"]:
+        failures.append(
+            f"{aux['failed_requests']} requests failed across the kill"
+        )
+    if aux["respawns"] != 1:
+        failures.append(
+            f"{aux['respawns']} supervised respawns, want exactly 1"
+        )
+    compiles = aux["harvested_compiles_after_warmup"]
+    stale = aux["harvest_stale"]
+    for i, c in compiles.items():
+        if stale.get(i):
+            failures.append(f"replica {i} harvest is stale post-respawn")
+        elif c != 0:
+            failures.append(
+                f"replica {i} HARVESTED compiles_after_warmup={c} != 0 "
+                "(the respawn must prewarm from the shared AOT tier)"
+            )
+    if not aux["incident_files"]:
+        failures.append("no incident file for the SIGKILLed replica")
+    elif not aux["incident_parses"]:
+        failures.append("the incident file does not parse as schema 1")
+    elif not aux["incident_has_worker_snapshot"]:
+        failures.append(
+            "the incident lacks the dead worker's standing "
+            "flight-recorder snapshot"
+        )
+    if aux["trace_pid_tracks"] < 3:
+        failures.append(
+            f"stitched trace has {aux['trace_pid_tracks']} pid tracks "
+            "(want >= 3: router + workers)"
+        )
+    if aux["trace_flow_links"] < 1:
+        failures.append(
+            "no cross-process route→flush flow link in the stitched "
+            "trace"
+        )
+    if aux["trace_worker_flush_spans"] < 1:
+        failures.append("no worker-side flush span in the stitched trace")
+    if aux["harvest_overhead_frac"] > overhead_gate:
+        failures.append(
+            f"harvest overhead {aux['harvest_overhead_frac']} > "
+            f"{overhead_gate} vs SKDIST_OBS_HARVEST=0"
+        )
+    if aux["off_path_overhead_frac_bound"] > 0.01:
+        failures.append(
+            "off-path (harvest+trace disabled) per-call bound "
+            f"{aux['off_path_overhead_frac_bound']} > 0.01"
+        )
+    _check_trace_file(trace_path, failures)
+    os.unlink(trace_path)
+
+    if failures:
+        print("FAIL:\n  " + "\n  ".join(failures))
+        raise SystemExit(1)
+    print(
+        f"PASS: {aux['requests']}/{aux['requests']} served across a "
+        f"SIGKILL ({aux['respawns']} respawn, harvested compiles "
+        f"{compiles}), fleet /metrics covered "
+        f"{aux['pre_kill_metric_replicas']} pre-kill, incident "
+        f"{aux['incident_files'][-1]} parses with worker snapshot, "
+        f"stitched trace {aux['trace_pid_tracks']} pid tracks / "
+        f"{aux['trace_flow_links']} flow links, harvest overhead "
+        f"{aux['harvest_overhead_frac']:.4f} <= {overhead_gate} "
+        f"(off-path bound {aux['off_path_overhead_frac_bound']:.6f} "
+        "<= 0.01)"
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

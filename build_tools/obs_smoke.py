@@ -64,7 +64,7 @@ def _check_trace_file(path, failures):
 def _check_prometheus(text, failures):
     n = 0
     for line in text.strip().splitlines():
-        if line.startswith("# TYPE "):
+        if line.startswith("# TYPE ") or line.startswith("# HELP "):
             continue
         if not _PROM_SAMPLE.match(line):
             failures.append(f"unparseable exposition line: {line!r}")

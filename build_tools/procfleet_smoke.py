@@ -147,6 +147,7 @@ def scenario_process_fleet(failures, p99_budget_ms):
             out = fleet.predict(X[:4], model="clf", timeout_s=30.0)
             post += int(out.shape[0] == 4)
         snap = faults.snapshot()
+        fleet.harvest_now()  # pull every worker's telemetry frame NOW
         st = fleet.stats()
 
     total = FLEET_THREADS * REQS_PER_THREAD
@@ -177,13 +178,22 @@ def scenario_process_fleet(failures, p99_budget_ms):
         failures.append(
             "process fleet: the respawned process served nothing"
         )
-    compiles = [r["engine"]["compiles_after_warmup"]
-                for r in st["replicas"] if r["engine"]]
+    # the 0-compile gate reads the HARVESTED scoped-miss deltas (the
+    # supervisor-merged telemetry, PR 15) — not a stats field each
+    # worker computed about itself inside the same frame it serves
+    harvest = st["harvest"]["replicas"]
+    compiles = [harvest[i]["compiles_after_warmup"]
+                for i in sorted(harvest) if not harvest[i]["stale"]]
+    if len(compiles) != FLEET_REPLICAS:
+        failures.append(
+            f"process fleet: only {len(compiles)}/{FLEET_REPLICAS} "
+            f"replicas harvested fresh telemetry ({harvest})"
+        )
     if any(c != 0 for c in compiles):
         failures.append(
-            f"process fleet: post-warmup compiles {compiles} != 0 "
-            "(the respawned process must prewarm from the shared disk "
-            "AOT tier)"
+            f"process fleet: harvested post-warmup compiles {compiles} "
+            "!= 0 (the respawned process must prewarm from the shared "
+            "disk AOT tier)"
         )
     p99 = max((r["engine"]["p99_ms"] or 0.0)
               for r in st["replicas"] if r["engine"])

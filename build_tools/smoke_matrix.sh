@@ -115,6 +115,22 @@
 #                             Prometheus exposition parses with
 #                             per-replica / per-name@version serving
 #                             labels (telemetry-plane PR).
+#   obs_fleet_smoke.py      — fleet-wide observability: 3-process
+#                             ProcessReplicaSet under threaded load
+#                             with replica 1's process SIGKILLed
+#                             mid-load -> pre-kill /metrics scrape
+#                             covers all three replicas' harvested
+#                             counters (stale gauges 0), 0 failed
+#                             requests, exactly 1 respawn, HARVESTED
+#                             compiles_after_warmup 0 fleet-wide,
+#                             parsed incident file embedding the dead
+#                             worker's standing flight-recorder
+#                             snapshot, stitched Perfetto trace with
+#                             >= 3 pid tracks + cross-process
+#                             route->flush flow links, telemetry
+#                             harvest overhead <= 5% vs
+#                             SKDIST_OBS_HARVEST=0 (distributed
+#                             observability PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
@@ -129,4 +145,5 @@ python build_tools/procfleet_smoke.py
 python build_tools/kernels_smoke.py
 python build_tools/gbdt_smoke.py
 python build_tools/obs_smoke.py
+python build_tools/obs_fleet_smoke.py
 python build_tools/multitenant_smoke.py

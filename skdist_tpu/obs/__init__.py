@@ -20,10 +20,22 @@ Three parts, one store:
   the registry, including the serving fleet's per-replica and
   per-``name@version`` label dimensions.
 
-See docs/DESIGN.md "Telemetry plane".
+Two distributed additions ride on top (PR 15):
+
+- :mod:`obs.flightrec` — an always-on bounded flight recorder of
+  recent rounds/faults/fleet events, dumped to timestamped incident
+  files on replica death, crash-loop parks, ``AllReplicasUnhealthy``,
+  and exhausted round retries; workers keep an atomically-rewritten
+  standing snapshot so even a SIGKILLed process leaves its last
+  seconds behind for the supervisor to harvest.
+- :mod:`obs.httpd` — the opt-in stdlib ops endpoint
+  (``SKDIST_OBS_PORT``): ``/metrics`` (fleet exposition),
+  ``/healthz``, ``/debug/flightrec``.
+
+See docs/DESIGN.md "Telemetry plane" and "Distributed observability".
 """
 
-from . import export, metrics, trace  # noqa: F401
+from . import export, flightrec, httpd, metrics, trace  # noqa: F401
 from .metrics import (  # noqa: F401
     ROUND_STATS_REQUIRED,
     RoundStats,
@@ -39,12 +51,16 @@ from .trace import (  # noqa: F401
     export_chrome_trace,
     instant,
     span,
+    stitch_traces,
 )
 
 __all__ = [
     "metrics",
     "trace",
     "export",
+    "flightrec",
+    "httpd",
+    "stitch_traces",
     "registry",
     "counter",
     "gauge",

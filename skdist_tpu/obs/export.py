@@ -40,10 +40,18 @@ __all__ = [
     "json_snapshot",
     "fleet_text",
     "fleet_snapshot",
+    "escape_label_value",
 ]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+#: exposition-grammar escapes for label VALUES (exactly the three
+#: escapable characters of the text format: backslash first so an
+#: escaped escape never double-fires) — a model registered as
+#: ``name@version`` with quotes/newlines in its name must still emit
+#: parseable text
 _LABEL_ESC = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+#: HELP text escapes only backslash and newline (quotes are legal there)
+_HELP_ESC = {"\\": "\\\\", "\n": "\\n"}
 
 
 def _prom_name(name, prefix="skdist"):
@@ -51,15 +59,17 @@ def _prom_name(name, prefix="skdist"):
     return f"{prefix}_{name}" if prefix else name
 
 
+def escape_label_value(v):
+    """One label value under the text-exposition escaping rules."""
+    return "".join(_LABEL_ESC.get(c, c) for c in str(v))
+
+
 def _prom_labels(key, extra=()):
     pairs = list(extra) + list(key)
     if not pairs:
         return ""
     body = ",".join(
-        '{}="{}"'.format(
-            _NAME_RE.sub("_", k),
-            "".join(_LABEL_ESC.get(c, c) for c in str(v)),
-        )
+        '{}="{}"'.format(_NAME_RE.sub("_", k), escape_label_value(v))
         for k, v in pairs
     )
     return "{" + body + "}"
@@ -67,8 +77,24 @@ def _prom_labels(key, extra=()):
 
 def _fmt(v):
     if isinstance(v, float):
+        # the grammar's value tokens for non-finite floats
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        if v == float("-inf"):
+            return "-Inf"
         return repr(v)
     return str(v)
+
+
+def _headers(lines, pname, kind, help_text):
+    """The per-family ``# HELP`` + ``# TYPE`` pair (HELP first, the
+    conventional order; omitted when the family registered no help)."""
+    if help_text:
+        esc = "".join(_HELP_ESC.get(c, c) for c in str(help_text))
+        lines.append(f"# HELP {pname} {esc}")
+    lines.append(f"# TYPE {pname} {kind}")
 
 
 def prometheus_text(registry=None, prefix="skdist"):
@@ -80,17 +106,17 @@ def prometheus_text(registry=None, prefix="skdist"):
     for name, fam in sorted(reg.families().items()):
         pname = _prom_name(name, prefix)
         if fam.kind == "counter":
-            lines.append(f"# TYPE {pname}_total counter")
+            _headers(lines, f"{pname}_total", "counter", fam.help)
             for key, v in sorted(fam.children().items()):
                 lines.append(
                     f"{pname}_total{_prom_labels(key)} {_fmt(v)}"
                 )
         elif fam.kind == "gauge":
-            lines.append(f"# TYPE {pname} gauge")
+            _headers(lines, pname, "gauge", fam.help)
             for key, v in sorted(fam.children().items()):
                 lines.append(f"{pname}{_prom_labels(key)} {_fmt(v)}")
         elif fam.kind == "histogram":
-            lines.append(f"# TYPE {pname} histogram")
+            _headers(lines, pname, "histogram", fam.help)
             bounds = fam.buckets
             for key, child in sorted(fam.children().items()):
                 cum = 0

@@ -1,0 +1,300 @@
+"""
+Flight recorder: an always-on bounded ring of what this process was
+doing RIGHT BEFORE it mattered.
+
+The telemetry registry answers "how much, cumulatively"; the tracer
+answers "what, in order" but is opt-in and sized for whole searches.
+When a replica process dies, a fleet parks a crash-looping worker, a
+router raises ``AllReplicasUnhealthy``, or a round loop exhausts its
+retry budget, the question is narrower and the stakes higher: *what
+were the last few hundred things this process did*, captured at a cost
+low enough to leave on unconditionally. That is this module — the
+aviation flight-recorder shape: a small ring of recent round stats,
+fault-layer events, and fleet lifecycle notes, dumped to a timestamped
+**incident file** when something dies.
+
+Three write paths feed the ring with no configuration:
+
+- ``publish_round_stats`` (``obs.metrics``) notes every completed
+  dispatch's round summary;
+- ``faults.record`` notes every fault-layer event (retries, parks,
+  failovers, heartbeat misses ...);
+- the procfleet supervisor notes replica lifecycle events.
+
+Each note is one dict append under a lock — O(ring) memory, no I/O.
+I/O happens only at DUMP time:
+
+- :meth:`FlightRecorder.dump_incident` writes
+  ``skdist-incident-<UTC>-pid<pid>-<reason>.json`` (ring + registry
+  snapshot + recent trace-span tail) into ``SKDIST_FLIGHTREC_DIR``
+  (default: ``<tmp>/skdist-flightrec``). Reasons are throttled (one
+  dump per reason per ``min_interval_s``) so a router raising
+  ``AllReplicasUnhealthy`` per queued request cannot dump-storm the
+  disk.
+- a **standing snapshot** (:meth:`start_autodump`): a daemon thread
+  atomically rewrites one well-known file every interval. This is the
+  SIGKILL answer — a process cannot dump *at* SIGKILL, so it dumps
+  *continuously* and cheaply, and the supervisor harvests the last
+  written snapshot of a dead child from its standing file (the
+  procfleet contract). SIGTERM and normal exits additionally dump
+  synchronously (:func:`install_signal_dump` chains the existing
+  handler), and the write path is plain json-dump-to-temp + atomic
+  ``os.replace`` — a reader never sees a torn file.
+
+Incident files are self-describing JSON (schema in DESIGN.md
+"Distributed observability"): ``{"schema": 1, "kind": "incident",
+"reason", "t_unix", "pid", "label", "events": [...], "metrics":
+{...}, "spans": [...]}``.
+"""
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "FlightRecorder",
+    "recorder",
+    "note",
+    "dump_incident",
+    "incident_dir",
+    "install_signal_dump",
+]
+
+SCHEMA = 1
+
+#: keys of a RoundStats dict worth keeping per ring entry (the full
+#: dict rides last_round_stats already; the ring wants the story line)
+_ROUND_KEYS = (
+    "mode", "rounds", "tasks", "retries", "kernel_mode",
+    "retired_rung", "retired_convergence",
+)
+
+#: how many of the trace ring's most recent events an incident carries
+_SPAN_TAIL = 64
+
+
+def incident_dir(explicit=None):
+    """Where incident files land: the explicit argument, else
+    ``SKDIST_FLIGHTREC_DIR``, else ``<tmp>/skdist-flightrec``."""
+    if explicit:
+        return str(explicit)
+    env = os.environ.get("SKDIST_FLIGHTREC_DIR", "").strip()
+    if env:
+        return env
+    return os.path.join(tempfile.gettempdir(), "skdist-flightrec")
+
+
+class FlightRecorder:
+    """Bounded event ring + incident/standing-snapshot dumps (module
+    docstring). ``capacity`` bounds the ring; ``min_interval_s``
+    throttles per-reason incident dumps."""
+
+    def __init__(self, capacity=512, min_interval_s=5.0, label=None):
+        self._ring = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self.min_interval_s = float(min_interval_s)
+        self.label = label
+        self._last_dump = {}   # reason -> monotonic time of last dump
+        self._seq = 0          # uniquifies same-second incident names
+        self._auto_stop = None
+        self._auto_thread = None
+        self.standing_path = None
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+    def note(self, kind, **data):
+        """Append one event to the ring. Values must be cheap plain
+        data (they are json-dumped at incident time with a str()
+        fallback for anything exotic)."""
+        ev = {"t": time.time(), "kind": str(kind)}
+        ev.update(data)
+        with self._lock:
+            self._ring.append(ev)
+
+    def note_round(self, stats):
+        """One completed dispatch's summary (called by
+        ``obs.metrics.publish_round_stats``)."""
+        if not isinstance(stats, dict):
+            return
+        self.note("round", **{k: stats.get(k) for k in _ROUND_KEYS})
+
+    def set_label(self, label):
+        """Identity stamped into every dump (the procfleet sets
+        ``replica <i>`` worker-side)."""
+        self.label = str(label)
+
+    def events(self):
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+    # ------------------------------------------------------------------
+    # dumping
+    # ------------------------------------------------------------------
+    def snapshot_doc(self, reason=None, metrics=True):
+        """The dump document: ring events, a registry snapshot, and
+        the tail of the trace ring (span summaries — name/ts/dur/args,
+        already plain dicts)."""
+        from . import metrics as obs_metrics
+        from . import trace as obs_trace
+
+        doc = {
+            "schema": SCHEMA,
+            "kind": "incident" if reason else "snapshot",
+            "t_unix": time.time(),
+            "pid": os.getpid(),
+            "label": self.label,
+            "events": self.events(),
+        }
+        if reason:
+            doc["reason"] = str(reason)
+        if metrics:
+            try:
+                doc["metrics"] = obs_metrics.registry().snapshot()
+            except Exception as exc:  # a dump must never raise
+                doc["metrics"] = {"error": repr(exc)}
+        try:
+            # limit= renders ONLY the tail — this runs every second on
+            # the autodump thread, where rendering a full 64k ring to
+            # keep 64 events would be continuous allocation burn
+            doc["spans"] = obs_trace.chrome_trace_events(
+                clock="wall", limit=_SPAN_TAIL
+            )
+        except Exception as exc:
+            doc["spans"] = [{"error": repr(exc)}]
+        return doc
+
+    @staticmethod
+    def _write_atomic(path, doc):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, default=str)
+        os.replace(tmp, path)
+
+    def dump_incident(self, reason, dir=None, throttle=True, extra=None):
+        """Write a timestamped incident file; returns its path, or
+        None when throttled / the write failed (a recorder must never
+        take down the thing it is recording). ``extra`` (a plain-data
+        dict) lands under the doc's ``"extra"`` key — the procfleet
+        supervisor attaches the dead replica's identity and its last
+        harvested worker snapshot there."""
+        reason = str(reason)
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump.get(reason)
+            if throttle and last is not None and (
+                    now - last < self.min_interval_s):
+                return None
+            self._last_dump[reason] = now
+            self._seq += 1
+            seq = self._seq
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in reason)[:64]
+        path = os.path.join(
+            incident_dir(dir),
+            f"skdist-incident-{stamp}-pid{os.getpid()}"
+            f"-{seq:03d}-{safe}.json",
+        )
+        doc = self.snapshot_doc(reason=reason)
+        if extra is not None:
+            doc["extra"] = extra
+        try:
+            self._write_atomic(path, doc)
+        except Exception:
+            return None
+        return path
+
+    def dump_now(self, path=None):
+        """Synchronously (re)write the standing snapshot file."""
+        path = path or self.standing_path
+        if not path:
+            return None
+        try:
+            self._write_atomic(path, self.snapshot_doc())
+        except Exception:
+            return None
+        return path
+
+    # ------------------------------------------------------------------
+    # standing snapshot (the SIGKILL path)
+    # ------------------------------------------------------------------
+    def start_autodump(self, path, interval_s=1.0):
+        """Start the standing-snapshot daemon thread (idempotent per
+        recorder; a second call re-points the path)."""
+        self.standing_path = str(path)
+        if self._auto_thread is not None and self._auto_thread.is_alive():
+            return
+        stop = self._auto_stop = threading.Event()
+
+        def loop():
+            while not stop.wait(float(interval_s)):
+                self.dump_now()
+            self.dump_now()  # one final write on clean stop
+
+        self._auto_thread = threading.Thread(
+            target=loop, daemon=True, name="skdist-flightrec-autodump",
+        )
+        self._auto_thread.start()
+
+    def stop_autodump(self, final_dump=True):
+        stop = self._auto_stop
+        if stop is not None:
+            stop.set()
+        t = self._auto_thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._auto_thread = None
+        if final_dump:
+            self.dump_now()
+
+
+_RECORDER = FlightRecorder()
+
+
+def recorder():
+    """The process-wide default recorder."""
+    return _RECORDER
+
+
+def note(kind, **data):
+    _RECORDER.note(kind, **data)
+
+
+def dump_incident(reason, dir=None, throttle=True):
+    return _RECORDER.dump_incident(reason, dir=dir, throttle=throttle)
+
+
+def install_signal_dump(signals=None, reason="signal"):
+    """Dump an incident when one of ``signals`` (default: SIGTERM)
+    arrives, CHAINING any existing handler — Python signal handlers
+    run between bytecodes on the main thread, which is as
+    "signal-safe" as a Python process gets; SIGKILL is unhandleable by
+    design, which is what the standing autodump file is for."""
+    import signal as _signal
+
+    if signals is None:
+        signals = (_signal.SIGTERM,)
+    for sig in signals:
+        prev = _signal.getsignal(sig)
+
+        def handler(signum, frame, _prev=prev):
+            _RECORDER.dump_incident(f"{reason}-{signum}")
+            _RECORDER.dump_now()
+            if callable(_prev):
+                _prev(signum, frame)
+            elif _prev == _signal.SIG_DFL:
+                _signal.signal(signum, _signal.SIG_DFL)
+                _signal.raise_signal(signum)
+
+        _signal.signal(sig, handler)

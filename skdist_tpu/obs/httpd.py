@@ -1,0 +1,175 @@
+"""
+The ops endpoint: a stdlib-only HTTP server exposing the telemetry
+plane to scrapers and humans.
+
+Three routes, all read-only:
+
+- ``/metrics`` — Prometheus text exposition (the Prometheus scrape
+  contract). The hook decides WHOSE metrics: a bare process serves its
+  own registry; the procfleet supervisor serves the harvested FLEET
+  registry, so one scrape covers every replica process with
+  ``replica``/``pid`` labels and ``skdist_stale`` marking replicas
+  whose harvest went quiet.
+- ``/healthz`` — liveness JSON. Status 200 while the hook reports
+  healthy, 503 otherwise (the fleet hook reports unhealthy when no
+  replica is routable — load balancers and k8s probes read the status
+  code, humans read the body).
+- ``/debug/flightrec`` — the flight recorder's current snapshot
+  document (``obs.flightrec``): the last few hundred things this
+  process (and, under the fleet hook, its workers' standing files)
+  did.
+
+Opt-in only: nothing binds unless the operator passes a port or sets
+``SKDIST_OBS_PORT`` (``ProcessReplicaSet`` reads it; the variable is
+STRIPPED from worker spawn environments so a fleet's children do not
+fight the supervisor for the bind). Port 0 binds an ephemeral port —
+read it back from :attr:`OpsServer.port` (tests, and multi-tenant
+hosts that register the port elsewhere). The server binds
+``127.0.0.1`` by default: the exposition carries operational detail,
+and putting it on a routable interface is an explicit operator
+decision (``host=``).
+
+Built on ``http.server.ThreadingHTTPServer`` — no dependencies, a few
+requests per scrape interval, entirely off the serving hot path.
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["OpsServer", "start_from_env", "resolve_port"]
+
+
+def resolve_port(explicit=None):
+    """The configured ops port: the explicit argument wins, else
+    ``SKDIST_OBS_PORT``; None/empty = endpoint off. ``0`` is a LIVE
+    value (ephemeral bind), so only None/"" disable."""
+    if explicit is not None:
+        return int(explicit)
+    raw = os.environ.get("SKDIST_OBS_PORT", "").strip()
+    if raw == "":
+        return None
+    return int(raw)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "skdist-obs/1"
+
+    def _send(self, code, body, content_type):
+        data = body.encode("utf-8") if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        hooks = self.server.hooks
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(
+                    200, hooks["metrics"](),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/healthz":
+                doc = hooks["healthz"]()
+                code = 200 if doc.get("healthy", True) else 503
+                self._send(code, json.dumps(doc, default=str),
+                           "application/json")
+            elif path == "/debug/flightrec":
+                self._send(
+                    200, json.dumps(hooks["flightrec"](), default=str),
+                    "application/json",
+                )
+            else:
+                self._send(404, json.dumps({
+                    "error": "not found",
+                    "routes": ["/metrics", "/healthz",
+                               "/debug/flightrec"],
+                }), "application/json")
+        except Exception as exc:  # a broken hook must not kill the server
+            self._send(500, json.dumps({"error": repr(exc)}),
+                       "application/json")
+
+    def log_message(self, fmt, *args):
+        pass  # scrapes every few seconds must not spam stderr
+
+
+def _default_metrics():
+    from . import export
+
+    return export.prometheus_text()
+
+
+def _default_healthz():
+    return {"healthy": True, "pid": os.getpid()}
+
+
+def _default_flightrec():
+    from . import flightrec
+
+    return flightrec.recorder().snapshot_doc()
+
+
+class OpsServer:
+    """The ops endpoint (module docstring). Hooks are zero-arg
+    callables returning the route's payload; each defaults to the
+    process-local view."""
+
+    def __init__(self, port=0, host="127.0.0.1", metrics=None,
+                 healthz=None, flightrec=None):
+        self.hooks = {
+            "metrics": metrics or _default_metrics,
+            "healthz": healthz or _default_healthz,
+            "flightrec": flightrec or _default_flightrec,
+        }
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.hooks = self.hooks
+        self._thread = None
+
+    @property
+    def port(self):
+        """The BOUND port (meaningful after construction, incl. the
+        ephemeral-bind case of port=0)."""
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="skdist-obs-httpd",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def start_from_env(port=None, **hooks):
+    """Start an :class:`OpsServer` when a port is configured
+    (argument or ``SKDIST_OBS_PORT``); returns it, or None when the
+    endpoint is off."""
+    resolved = resolve_port(port)
+    if resolved is None:
+        return None
+    return OpsServer(port=resolved, **hooks).start()

@@ -66,6 +66,7 @@ __all__ = [
     "RoundStats",
     "new_round_stats",
     "publish_round_stats",
+    "merge_state",
 ]
 
 _DEFAULT_BUCKETS = (
@@ -309,6 +310,26 @@ class Histogram(_Metric):
                 for key, c in self._children.items()
             }
 
+    def merge_child(self, key, dump):
+        """Fold one harvested child dump (a :meth:`children` value)
+        into the child at label ``key`` — the fleet-merge path. Bucket
+        layouts must match (the harvest recreates the family with the
+        dumped boundaries); the percentile ring does NOT travel (raw
+        samples stay process-local — the merged view keeps bucket
+        counts/sum/count, which is what the exposition serves)."""
+        counts = dump["counts"]
+        if len(counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"histogram {self.name!r}: merge of {len(counts)} "
+                f"buckets into {len(self.buckets) + 1}"
+            )
+        with self._lock:
+            child = self._child(key)
+            for i, c in enumerate(counts):
+                child.counts[i] += int(c)
+            child.sum += float(dump["sum"])
+            child.count += int(dump["count"])
+
     def reset(self):
         with self._lock:
             self._children.clear()
@@ -383,6 +404,24 @@ class MetricsRegistry:
         value-or-hist dict}}} — the JSON exporter's input."""
         return snapshot_families(self.families())
 
+    def dump_state(self):
+        """The registry's full state in a merge-round-trippable form:
+        ``{name: {"kind", "help", "children": {label-key tuple:
+        value}}}`` (histograms add ``buckets``/``window``). Unlike
+        :meth:`snapshot` the label keys stay STRUCTURED tuples — this
+        is the telemetry-harvest wire form (it rides the procfleet's
+        pickle frames), and :func:`merge_state` rebuilds exact label
+        children from it, with fleet labels layered on top."""
+        out = {}
+        for name, m in self.families().items():
+            ent = {"kind": m.kind, "help": m.help,
+                   "children": m.children()}
+            if m.kind == "histogram":
+                ent["buckets"] = tuple(m.buckets)
+                ent["window"] = m.window
+            out[name] = ent
+        return out
+
 
 def snapshot_families(families):
     """Render a {name: family} mapping as nested plain dicts — the ONE
@@ -396,6 +435,39 @@ def snapshot_families(families):
             for key, val in m.children().items()
         }}
     return out
+
+
+def merge_state(state, into, labels=None):
+    """Fold one process's :meth:`MetricsRegistry.dump_state` into the
+    ``into`` registry, layering ``labels`` (e.g. ``{"replica": "1",
+    "pid": "4242"}``) onto every child — the fleet-merge primitive the
+    procfleet supervisor uses to build one exposition covering every
+    worker. Fleet labels WIN over same-named labels the worker already
+    carried (the supervisor's roster is the authority on which replica
+    slot a process occupies). Counters/histograms accumulate, gauges
+    last-write-win per label child."""
+    labels = {str(k): str(v) for k, v in (labels or {}).items()}
+    for name, ent in state.items():
+        kind = ent.get("kind")
+        for key, val in ent.get("children", {}).items():
+            child_labels = dict(key)
+            child_labels.update(labels)
+            if kind == "counter":
+                into.counter(name, help=ent.get("help", "")).inc(
+                    val, **child_labels
+                )
+            elif kind == "gauge":
+                into.gauge(name, help=ent.get("help", "")).set(
+                    val, **child_labels
+                )
+            elif kind == "histogram":
+                fam = into.histogram(
+                    name, help=ent.get("help", ""),
+                    buckets=ent.get("buckets", _DEFAULT_BUCKETS),
+                    window=ent.get("window", 4096),
+                )
+                fam.merge_child(_label_key(child_labels), val)
+    return into
 
 
 _REGISTRY = MetricsRegistry()
@@ -546,3 +618,6 @@ def publish_round_stats(stats):
     # kernel_mode is stamped AFTER the dispatch returns
     # (models/linear.annotate_round_kernel_mode), which bills the
     # rounds.kernel_mode counter itself — not double-counted here
+    from . import flightrec
+
+    flightrec.recorder().note_round(stats)

@@ -690,7 +690,10 @@ class LocalBackend(TaskBackend):
                 ))
                 break
             except _RoundsExhausted as oom:
-                # no adaptive retry on host memory; surface the real error
+                # no adaptive retry on host memory; surface the real
+                # error — with the flight recorder frozen first (the
+                # last rounds' story is the incident's evidence)
+                _obs_incident("rounds_exhausted")
                 raise oom.cause
             except _RoundFault as rf:
                 rounds_out.extend(rf.completed)
@@ -1273,6 +1276,7 @@ class TPUBackend(TaskBackend):
                     # already inside the next collective — resuming here
                     # with a different round plan would deadlock, not
                     # recover. Fail loudly with the remedy instead.
+                    _obs_incident("rounds_exhausted")
                     raise RuntimeError(
                         "batched_map exhausted device memory in a "
                         "multi-process run; the per-process OOM resume "
@@ -1284,6 +1288,7 @@ class TPUBackend(TaskBackend):
                 rounds_out.extend(oom.completed)
                 offset += oom.consumed
                 if chunk <= d:
+                    _obs_incident("rounds_exhausted")
                     raise oom.cause
                 chunk = int(math.ceil(chunk / 2 / d) * d)
                 warnings.warn(
@@ -1353,6 +1358,7 @@ class TPUBackend(TaskBackend):
                     # is single-process only. The message carries no
                     # process-local state (offsets, salvage counts), so
                     # every process that raises prints the same remedy.
+                    _obs_incident("multiprocess_round_fault")
                     raise RuntimeError(
                         f"batched_map hit a {rf.kind} fault in a "
                         "multi-process run; round retry cannot "
@@ -1759,6 +1765,15 @@ def _cached_device_put(leaf, sharding, enabled):
         except (KeyError, StopIteration):  # concurrent eviction
             break
     return dev
+
+
+def _obs_incident(reason):
+    """Freeze the flight recorder to a timestamped incident file right
+    before a fail-loud raise (best-effort + throttled — see
+    ``obs.flightrec``)."""
+    from ..obs import flightrec
+
+    flightrec.dump_incident(reason)
 
 
 class _RoundsExhausted(Exception):

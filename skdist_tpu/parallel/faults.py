@@ -292,6 +292,17 @@ def record(counter, n=1):
     if counter not in FAULT_COUNTERS:
         raise KeyError(f"unknown fault counter {counter!r}")
     _events().inc(int(n), kind=counter)
+    # every fault-layer event also lands in the flight recorder's
+    # bounded ring: an incident file's last-seconds story is mostly
+    # made of these (fault events are rare by construction — this is
+    # one dict append, never I/O)
+    from ..obs import flightrec
+
+    flightrec.note("fault", event=counter, n=int(n))
+    if counter == "retries_exhausted":
+        # the round loop is about to fail loud: freeze the story now,
+        # while the raising stack still exists
+        flightrec.dump_incident("retries_exhausted")
 
 
 def snapshot():

@@ -458,11 +458,21 @@ class ElasticMeshManager:
         epoch = self._epoch
         me = int(jax.process_index())
         ns = f"{self.kv_namespace}/e{epoch}"
+        # the trace context rides the SAME KV round trip as the prefix:
+        # every survivor publishes its active context (or a fresh one),
+        # and all adopt the minimum-id survivor's trace id — so the
+        # stitched multi-process trace shows ONE epoch-agreement line
+        # across every process's track instead of per-process orphans
+        my_ctx = obs_trace.current_context() or (
+            obs_trace.new_context() if obs_trace.enabled() else None
+        )
         client.key_value_set(
-            f"{ns}/p{me}", json.dumps({"prefix": int(local_prefix)}),
+            f"{ns}/p{me}",
+            json.dumps({"prefix": int(local_prefix), "trace": my_ctx}),
             allow_overwrite=True,
         )
         prefixes = {me: int(local_prefix)}
+        traces = {me: my_ctx}
         lost = set()
         timeout_ms = max(1, int(self.agree_timeout_s * 1e3))
         for pid in self.participant_ids:
@@ -472,7 +482,9 @@ class ElasticMeshManager:
                 raw = client.blocking_key_value_get(
                     f"{ns}/p{pid}", timeout_ms
                 )
-                prefixes[pid] = int(json.loads(raw)["prefix"])
+                peer = json.loads(raw)
+                prefixes[pid] = int(peer["prefix"])
+                traces[pid] = peer.get("trace")
             except Exception:
                 lost.add(pid)
         lost |= set(self._probe_lost())
@@ -490,12 +502,16 @@ class ElasticMeshManager:
             "elastic epoch %d agreement: survivors=%s lost=%s -> resume "
             "from task prefix %d", epoch, survivors, sorted(lost), agreed,
         )
-        obs_trace.instant(
-            "elastic_epoch_agreement",
-            {"epoch": epoch, "prefix": int(agreed),
-             "survivors": len(survivors), "lost": len(lost)}
-            if obs_trace.enabled() else None,
+        agreed_ctx = next(
+            (traces[pid] for pid in survivors if traces.get(pid)), None
         )
+        with obs_trace.use_context(agreed_ctx):
+            obs_trace.instant(
+                "elastic_epoch_agreement",
+                {"epoch": epoch, "prefix": int(agreed),
+                 "survivors": len(survivors), "lost": len(lost)}
+                if obs_trace.enabled() else None,
+            )
         mesh = self._resize("shrink", frozenset(self._coordinated_lost)) \
             if lost else None
         return int(agreed), mesh

@@ -99,9 +99,13 @@ def shape_buckets(max_rows, min_rows=1):
 
 
 class _Request:
-    """One queued inference request."""
+    """One queued inference request. ``trace_ctx`` (set by the engine
+    when a Dapper-style trace context is active on the submitting
+    thread — e.g. a procfleet worker answering a routed frame) lets
+    the flush that eventually carries the rows parent its span under
+    the router's span across the process boundary."""
 
-    __slots__ = ("X", "n", "future", "deadline", "enq_t")
+    __slots__ = ("X", "n", "future", "deadline", "enq_t", "trace_ctx")
 
     def __init__(self, X, n, future, deadline=None, enq_t=None):
         self.X = X
@@ -109,6 +113,7 @@ class _Request:
         self.future = future
         self.deadline = deadline
         self.enq_t = time.monotonic() if enq_t is None else enq_t
+        self.trace_ctx = None
 
 
 class _BankRequest(_Request):
@@ -350,7 +355,16 @@ class MicroBatcher:
         # device, so the launch itself never exceeds the budget
         self._slots.acquire()
         try:
-            with obs_trace.span(
+            # the flush's span adopts the FIRST carried request's trace
+            # context (a coalesced flush has one span but many callers;
+            # the oldest request is the one whose latency the flush
+            # decides) — worker-side flush/compile spans then parent
+            # under the router's cross-process span
+            ctx = next(
+                (q.trace_ctx for q in live if q.trace_ctx is not None),
+                None,
+            )
+            with obs_trace.use_context(ctx), obs_trace.span(
                 "flush",
                 {"name": self.name, "rows": int(live_rows),
                  "bucket": int(bucket)}
@@ -508,7 +522,11 @@ class BankedBatcher(MicroBatcher):
             s += k
         self._slots.acquire()
         try:
-            with obs_trace.span(
+            ctx = next(
+                (q.trace_ctx for q in live if q.trace_ctx is not None),
+                None,
+            )
+            with obs_trace.use_context(ctx), obs_trace.span(
                 "flush",
                 {"name": self.name, "rows": int(live_rows),
                  "bucket": int(S * r),

@@ -53,6 +53,7 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..parallel import faults
 from .batcher import (
     BankedBatcher,
@@ -282,6 +283,10 @@ class ServingEngine:
         else:
             req = _Request(X, n, Future(), deadline=deadline,
                            enq_t=enq_t)
+        # carry the submitting thread's trace context (set by the
+        # procfleet worker from the routed frame) onto the request, so
+        # the flush that serves it can parent under the router's span
+        req.trace_ctx = obs_trace.current_context()
         self._stats.record_submitted(serve_dtype=serve_dtype,
                                      model=model_spec)
         stats = self._stats

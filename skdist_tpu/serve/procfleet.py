@@ -62,6 +62,26 @@ every routed request ordinal, so "replica 1 is SIGKILLed at request
 60 under load" is an exact, replayable sentence
 (``build_tools/procfleet_smoke.py``).
 
+- **the supervisor owns fleet observability** (PR 15): workers answer
+  a ``telemetry`` op with their full metrics-registry dump, scoped
+  compile delta, trace ring, and flight-recorder ring; the supervisor
+  merges them into ONE fleet registry (``replica``/``pid`` labels,
+  Prometheus-federation shape) behind :meth:`fleet_metrics_text` /
+  :meth:`fleet_json_snapshot`, stitches per-process trace rings into
+  one Perfetto file (:meth:`export_fleet_trace` — worker flush spans
+  parent under the router's ``route`` spans via the shipped trace
+  context), writes a timestamped INCIDENT file on every replica
+  death / crash-loop park / ``AllReplicasUnhealthy`` (embedding the
+  dead child's last standing flight-recorder snapshot — the SIGKILL
+  post-mortem), and optionally serves it all on the stdlib ops
+  endpoint (``obs_port=`` / ``SKDIST_OBS_PORT``: ``/metrics``,
+  ``/healthz``, ``/debug/flightrec``). A replica whose harvest fails
+  — dead mid-RPC, parked, or answering an older frame schema —
+  degrades to its LAST harvested state marked by the
+  ``skdist_stale{replica=...}`` gauge instead of failing ``stats()``
+  or the exposition. ``SKDIST_OBS_HARVEST=0`` disables the periodic
+  harvest entirely.
+
 The wire protocol is pickle over a parent-owned unix socket: a
 same-host, same-user trust boundary (the socket lives in a
 ``mkdtemp`` directory), not a network protocol.
@@ -82,6 +102,10 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FutureTimeout
 
+from ..obs import export as obs_export
+from ..obs import flightrec as obs_flightrec
+from ..obs import httpd as obs_httpd
+from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..parallel import faults
 from ..utils.childproc import _kill_group
@@ -101,7 +125,22 @@ __all__ = [
     "FrameTooLarge",
     "send_frame",
     "recv_frame",
+    "TELEMETRY_SCHEMA",
 ]
+
+#: version tag of the ``telemetry`` op's reply frame; a worker
+#: answering a DIFFERENT schema (a mixed-version fleet mid-upgrade)
+#: degrades to stale-marked, never to a parse crash in the supervisor
+TELEMETRY_SCHEMA = 1
+
+
+def harvest_enabled():
+    """The periodic telemetry harvest is ON by default;
+    ``SKDIST_OBS_HARVEST=0`` is the kill switch (also the baseline leg
+    of the harvest-overhead smoke gate)."""
+    return os.environ.get("SKDIST_OBS_HARVEST", "").strip().lower() not in (
+        "0", "false", "no",
+    )
 
 # ---------------------------------------------------------------------------
 # wire protocol: length-prefixed pickled frames
@@ -300,6 +339,9 @@ class _ProcReplica:
         "alive", "parked", "draining", "misses", "failures", "routed",
         "in_flight", "queue_depth", "deaths", "consecutive_deaths",
         "respawn_due_at", "death_reason", "intentional_stop",
+        "flightrec_path", "telemetry_state", "telemetry_pid",
+        "telemetry_compiles", "telemetry_stale", "trace_part",
+        "flightrec_events",
     )
 
     def __init__(self, index):
@@ -322,6 +364,21 @@ class _ProcReplica:
         self.respawn_due_at = None
         self.death_reason = None
         self.intentional_stop = False
+        #: the worker's standing flight-recorder file (stable across
+        #: generations: the supervisor reads a dead child's last
+        #: snapshot from it)
+        self.flightrec_path = None
+        #: last successful telemetry harvest: registry dump / pid /
+        #: scoped compile delta / trace part / flight-recorder ring.
+        #: ``telemetry_stale`` starts True (nothing harvested yet) and
+        #: flips on each harvest outcome — a failed harvest KEEPS the
+        #: old state and only marks it stale
+        self.telemetry_state = None
+        self.telemetry_pid = None
+        self.telemetry_compiles = None
+        self.telemetry_stale = True
+        self.trace_part = None
+        self.flightrec_events = None
 
     @property
     def pid(self):
@@ -351,9 +408,24 @@ class ProcessReplicaSet:
                  respawn_backoff_s=0.25, max_respawn_backoff_s=10.0,
                  crash_loop_window_s=30.0, crash_loop_threshold=3,
                  spawn_timeout_s=120.0, drain_timeout_s=15.0,
-                 request_timeout_s=60.0, unhealthy_wait_s=30.0):
+                 request_timeout_s=60.0, unhealthy_wait_s=30.0,
+                 harvest_interval_s=2.0, obs_port=None,
+                 incident_dir=None):
+        """Observability knobs on top of the fault-domain ones:
+        ``harvest_interval_s`` paces the supervisor's periodic
+        ``telemetry`` harvest (``SKDIST_OBS_HARVEST=0`` disables it;
+        scrapes and :meth:`stats` refresh on demand either way);
+        ``obs_port`` (default: ``SKDIST_OBS_PORT``; ``0`` = ephemeral)
+        opts into the ops endpoint; ``incident_dir`` overrides where
+        incident files land (default ``SKDIST_FLIGHTREC_DIR`` /
+        ``<tmp>/skdist-flightrec`` — deliberately OUTSIDE the fleet's
+        socket tempdir, which is removed on close)."""
         if int(n_replicas) < 1:
             raise ValueError(f"n_replicas must be >= 1; got {n_replicas}")
+        # resolve (and validate) the ops port BEFORE any worker spawns:
+        # a malformed SKDIST_OBS_PORT must fail here, not after the
+        # fleet is up (which would orphan the spawned processes)
+        self._obs_port = obs_httpd.resolve_port(obs_port)
         self.artifact_dir = str(artifact_dir) if artifact_dir else None
         self.engine_kwargs = dict(engine_kwargs or {})
         self.backend_spec = backend_spec
@@ -371,6 +443,8 @@ class ProcessReplicaSet:
         self.drain_timeout_s = float(drain_timeout_s)
         self.request_timeout_s = request_timeout_s
         self.unhealthy_wait_s = float(unhealthy_wait_s)
+        self.harvest_interval_s = float(harvest_interval_s)
+        self.incident_dir = incident_dir
 
         self._dir = tempfile.mkdtemp(prefix="skpf-")
         self._lock = threading.Lock()
@@ -398,6 +472,13 @@ class ProcessReplicaSet:
             max_workers=1, thread_name_prefix="skdist-procfleet-respawn",
         )
         for r in self._replicas:
+            # standing flight-recorder file, STABLE across generations:
+            # a dead generation's last snapshot is still there when the
+            # supervisor builds the incident file
+            r.flightrec_path = os.path.join(
+                self._dir, f"r{r.index}.flightrec.json"
+            )
+        for r in self._replicas:
             try:
                 self._spawn(r)
                 r.alive = True
@@ -413,6 +494,28 @@ class ProcessReplicaSet:
             name="skdist-procfleet-supervisor",
         )
         self._supervisor.start()
+        self._harvester = None
+        if self.harvest_interval_s > 0:
+            self._harvester = threading.Thread(
+                target=self._harvest_loop, daemon=True,
+                name="skdist-procfleet-harvest",
+            )
+            self._harvester.start()
+        self._obs_server = None
+        port = self._obs_port
+        if port is not None:
+            try:
+                self._obs_server = obs_httpd.OpsServer(
+                    port=port,
+                    metrics=lambda: self.fleet_metrics_text(refresh=True),
+                    healthz=self._healthz,
+                    flightrec=self._flightrec_doc,
+                ).start()
+            except OSError:
+                # a taken port must not leak a spawned fleet: tear the
+                # workers down before surfacing the bind failure
+                self.close(drain=False)
+                raise
 
     # ------------------------------------------------------------------
     # spawning
@@ -423,6 +526,11 @@ class ProcessReplicaSet:
             "backend": self.backend_spec,
             "artifact_dir": self.artifact_dir,
             "replica": r.index,
+            "flightrec": r.flightrec_path,
+            # the parent may have enabled tracing programmatically
+            # (set_enabled) — the spawn carries the decision so the
+            # worker's track isn't empty in the stitched fleet trace
+            "trace": bool(obs_trace.enabled()),
         })
         if self._worker_argv is not None:
             return list(self._worker_argv(r.index, sock_path, cfg))
@@ -439,6 +547,10 @@ class ProcessReplicaSet:
         )
         r.log_path = os.path.join(self._dir, f"r{r.index}.log")
         env = dict(os.environ)
+        # the ops endpoint is the SUPERVISOR's: an inherited
+        # SKDIST_OBS_PORT would have every worker fight it (and each
+        # other) for the bind; worker_env may still set it explicitly
+        env.pop("SKDIST_OBS_PORT", None)
         # the worker must resolve skdist_tpu the way the parent did
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))
@@ -634,6 +746,9 @@ class ProcessReplicaSet:
                 with self._lock:
                     all_parked = all(p.parked for p in self._replicas)
                 if all_parked or time.monotonic() >= give_up_at:
+                    obs_flightrec.recorder().dump_incident(
+                        "all_replicas_unhealthy", dir=self.incident_dir,
+                    )
                     exc = AllReplicasUnhealthy(
                         f"all {len(self._replicas)} replica processes "
                         "refused the request"
@@ -655,12 +770,23 @@ class ProcessReplicaSet:
                 r.routed += 1
                 r.in_flight += 1
             try:
-                out = r.pool.request(
-                    "request",
-                    {"X": X, "model": model, "method": method,
-                     "timeout_s": timeout_s},
-                    rpc_timeout,
-                )
+                # the routing span is the fleet trace's cross-process
+                # parent: the request frame ships the context, the
+                # worker adopts it, and its flush/compile spans parent
+                # here in the stitched Perfetto view
+                traced = obs_trace.enabled()
+                payload = {"X": X, "model": model, "method": method,
+                           "timeout_s": timeout_s}
+                with obs_trace.use_context(
+                    obs_trace.new_context() if traced else None
+                ), obs_trace.span(
+                    "route",
+                    {"replica": int(r.index), "method": str(method)}
+                    if traced else None,
+                ):
+                    if traced:
+                        payload["_trace"] = obs_trace.current_context()
+                    out = r.pool.request("request", payload, rpc_timeout)
                 with self._lock:
                     r.failures = 0
                 return out
@@ -738,6 +864,24 @@ class ProcessReplicaSet:
                     # not kill heartbeats for every other replica
                     faults.log_suppressed(
                         "ProcessReplicaSet._supervise", exc
+                    )
+
+    def _harvest_loop(self):
+        """The periodic telemetry harvest runs on its OWN thread: one
+        wedged replica can hold a harvest RPC for its full timeout,
+        and that stall must never delay heartbeat-miss accrual or
+        respawns for the rest of the fleet (the supervisor thread IS
+        the fleet's liveness)."""
+        while not self._closed:
+            self._stop_evt.wait(self.harvest_interval_s)
+            if self._closed:
+                return
+            if harvest_enabled():
+                try:
+                    self.harvest_now()
+                except Exception as exc:
+                    faults.log_suppressed(
+                        "ProcessReplicaSet._harvest_loop", exc
                     )
 
     def _supervise_one(self, r):
@@ -832,6 +976,39 @@ class ProcessReplicaSet:
                 "parked", r.index, reason=reason,
                 deaths_in_window=len(r.deaths),
             )
+        # the post-mortem: a timestamped incident file combining the
+        # supervisor's flight recorder with the dead child's LAST
+        # standing snapshot (written by its autodump thread — the only
+        # telemetry a SIGKILLed process leaves behind)
+        self._dump_replica_incident(
+            r, "crash_loop_park" if r.parked else "replica_death", reason
+        )
+
+    def _dump_replica_incident(self, r, kind, reason):
+        worker_snap = None
+        try:
+            if r.flightrec_path and os.path.exists(r.flightrec_path):
+                with open(r.flightrec_path, "r", encoding="utf-8") as fh:
+                    worker_snap = json.load(fh)
+        except Exception as exc:
+            faults.log_suppressed(
+                "ProcessReplicaSet._dump_replica_incident", exc
+            )
+            worker_snap = {"error": repr(exc)}
+        path = obs_flightrec.recorder().dump_incident(
+            f"{kind}-replica{r.index}", dir=self.incident_dir,
+            extra={
+                "replica": int(r.index),
+                "generation": int(r.generation),
+                "pid": r.pid,
+                "death_reason": str(reason),
+                "worker_flightrec": worker_snap,
+            },
+        )
+        if path is not None:
+            self._event("incident", r.index, path=path,
+                        incident_kind=kind)
+        return path
 
     def _respawn(self, r, reason=None):
         """Respawn one dead replica: fresh process, wait ready,
@@ -994,6 +1171,13 @@ class ProcessReplicaSet:
             self._closed = True
         self._stop_evt.set()
         self._supervisor.join(timeout=5.0)
+        if self._harvester is not None:
+            self._harvester.join(timeout=5.0)
+        if self._obs_server is not None:
+            try:
+                self._obs_server.stop()
+            except Exception as exc:
+                faults.log_suppressed("ProcessReplicaSet.close.obs", exc)
         for r in self._replicas:
             if r.proc is not None:
                 try:
@@ -1015,6 +1199,153 @@ class ProcessReplicaSet:
         return False
 
     # ------------------------------------------------------------------
+    # telemetry harvest (cross-process observability)
+    # ------------------------------------------------------------------
+    def _harvest_one(self, r):
+        """Pull one replica's telemetry frame. ANY failure — the
+        worker died mid-RPC, answers an older frame schema, is parked
+        or between generations — keeps the replica's LAST harvested
+        state and marks it stale; harvest never throws past here."""
+        if not r.alive or r.draining or r.pool is None:
+            r.telemetry_stale = True
+            return False
+        try:
+            reply = r.pool.request(
+                "telemetry", {"schema": TELEMETRY_SCHEMA},
+                self.heartbeat_timeout_s * 4,
+            )
+            if (not isinstance(reply, dict)
+                    or reply.get("schema") != TELEMETRY_SCHEMA
+                    or not isinstance(reply.get("state"), dict)):
+                raise ServingError(
+                    "telemetry schema mismatch: got "
+                    f"{reply.get('schema') if isinstance(reply, dict) else type(reply).__name__!r}, "
+                    f"want {TELEMETRY_SCHEMA} (mixed-version fleet?)"
+                )
+        except Exception as exc:
+            r.telemetry_stale = True
+            faults.log_suppressed("ProcessReplicaSet.harvest", exc)
+            return False
+        r.telemetry_state = reply["state"]
+        r.telemetry_pid = reply.get("pid")
+        r.telemetry_compiles = reply.get("compiles_after_warmup")
+        if reply.get("trace") is not None:
+            r.trace_part = reply["trace"]
+        r.flightrec_events = reply.get("flightrec")
+        r.telemetry_stale = False
+        return True
+
+    def harvest_now(self):
+        """Harvest every routable replica synchronously; returns the
+        number of fresh harvests. The supervisor calls this on its
+        ``harvest_interval_s`` cadence; scrapes, :meth:`stats` and the
+        trace export call it on demand."""
+        return sum(self._harvest_one(r) for r in list(self._replicas))
+
+    def fleet_registry(self, refresh=False):
+        """ONE registry covering the whole fleet: the supervisor's own
+        families merged with every replica's last harvested dump,
+        labeled ``replica``/``pid`` — the Prometheus-federation shape.
+        The ``stale`` gauge (exposed as ``skdist_stale{replica=...}``)
+        marks replicas whose last harvest failed: their numbers are
+        present but frozen at the last good harvest."""
+        if refresh:
+            self.harvest_now()
+        reg = obs_metrics.MetricsRegistry()
+        obs_metrics.merge_state(
+            obs_metrics.registry().dump_state(), reg
+        )
+        stale = reg.gauge(
+            "stale",
+            help="1 when the replica's last telemetry harvest failed "
+                 "(its merged numbers are frozen at the last success)",
+        )
+        with self._lock:
+            replicas = list(self._replicas)
+        for r in replicas:
+            labels = {"replica": r.index}
+            if r.telemetry_pid is not None:
+                labels["pid"] = r.telemetry_pid
+            if r.telemetry_state is not None:
+                try:
+                    obs_metrics.merge_state(r.telemetry_state, reg, labels)
+                except Exception as exc:
+                    # a malformed dump degrades THIS replica to stale,
+                    # never the whole exposition
+                    r.telemetry_stale = True
+                    faults.log_suppressed(
+                        "ProcessReplicaSet.fleet_registry", exc
+                    )
+            stale.set(
+                1 if (r.telemetry_stale or r.telemetry_state is None)
+                else 0,
+                replica=str(r.index),
+            )
+        return reg
+
+    def fleet_metrics_text(self, refresh=False):
+        """Prometheus exposition of :meth:`fleet_registry` — what the
+        ops endpoint's ``/metrics`` serves."""
+        return obs_export.prometheus_text(self.fleet_registry(refresh))
+
+    def fleet_json_snapshot(self, refresh=False, path=None):
+        """JSON counterpart of :meth:`fleet_metrics_text`."""
+        return obs_export.json_snapshot(
+            self.fleet_registry(refresh), path=path
+        )
+
+    def export_fleet_trace(self, path=None, refresh=True):
+        """Stitch the router's trace ring with every replica's
+        harvested ring into one Perfetto-loadable Chrome trace: one
+        named track per process, worker flush/compile spans
+        parent-linked (flow arrows) under the router's ``route``
+        spans. Dead replicas contribute their last harvested ring."""
+        if refresh:
+            self.harvest_now()
+        parts = [obs_trace.trace_part(
+            label=f"router (pid {os.getpid()})"
+        )]
+        for r in list(self._replicas):
+            part = r.trace_part
+            if not part:
+                continue
+            part = dict(part)
+            part["label"] = f"replica {r.index} (pid {part.get('pid')})"
+            parts.append(part)
+        return obs_trace.stitch_traces(parts, path=path)
+
+    def _healthz(self):
+        """The ops endpoint's liveness doc: healthy while ANY replica
+        is routable (the router's own availability criterion)."""
+        with self._lock:
+            replicas = [{
+                "index": r.index, "alive": r.alive, "parked": r.parked,
+                "draining": r.draining, "generation": r.generation,
+                "pid": r.pid, "stale": r.telemetry_stale,
+            } for r in self._replicas]
+            requests = self._requests
+        live = sum(1 for r in replicas
+                   if r["alive"] and not r["draining"])
+        return {
+            "healthy": bool(live) and not self._closed,
+            "live_replicas": live,
+            "n_replicas": len(replicas),
+            "requests": requests,
+            "replicas": replicas,
+        }
+
+    def _flightrec_doc(self):
+        """The ops endpoint's ``/debug/flightrec``: the supervisor's
+        own recorder plus every replica's last harvested ring."""
+        return {
+            "router": obs_flightrec.recorder().snapshot_doc(),
+            "replicas": {
+                str(r.index): r.flightrec_events
+                for r in list(self._replicas)
+            },
+        }
+
+    # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
     def stats(self):
@@ -1022,7 +1353,12 @@ class ProcessReplicaSet:
         router gauges, per-replica entries with the child engine's own
         stats (fetched over the wire), and the fleet ``by_model``
         rollup — plus the supervisor's process-level view (pid,
-        parked, queue depth)."""
+        parked, queue depth) and the harvested telemetry block.
+        Refreshes the harvest first (this is an operator call already
+        paying one RPC per replica; the ``SKDIST_OBS_HARVEST=0``
+        switch gates only the PERIODIC harvest, per its docstring)."""
+        if not self._closed:
+            self.harvest_now()
         with self._lock:
             replicas = list(self._replicas)
             out = {
@@ -1053,10 +1389,32 @@ class ProcessReplicaSet:
             per.append(ent)
         out["replicas"] = per
         out["by_model"] = fleet_by_model(per)
+        # the harvested view (satellite of the cross-process harvest):
+        # per-replica scoped compile deltas as the SUPERVISOR merged
+        # them — the 0-compile gates read these instead of trusting a
+        # field each worker computed about itself mid-frame
+        out["harvest"] = {
+            "enabled": harvest_enabled(),
+            "replicas": {
+                str(r.index): {
+                    "stale": bool(r.telemetry_stale
+                                  or r.telemetry_state is None),
+                    "pid": r.telemetry_pid,
+                    "compiles_after_warmup": r.telemetry_compiles,
+                }
+                for r in replicas
+            },
+        }
         return out
 
     def replica(self, index):
         return self._replicas[int(index)]
+
+    @property
+    def ops_url(self):
+        """Base URL of the ops endpoint, or None when it is off."""
+        return (None if self._obs_server is None
+                else self._obs_server.url)
 
     # ------------------------------------------------------------------
     # internals
@@ -1066,6 +1424,10 @@ class ProcessReplicaSet:
             self.events.append(
                 dict(kind=kind, replica=index, t=time.time(), **extra)
             )
+        # fleet lifecycle rides the flight recorder too: an incident
+        # file's event ring shows the kills/respawns/parks leading up
+        # to whatever died
+        obs_flightrec.note(f"fleet.{kind}", replica=index, **extra)
 
     def _tick(self):
         """Per-request housekeeping: deterministic request ordinal +

@@ -25,7 +25,25 @@ Ops:
   still coalesces across callers inside this process.
 - ``stats`` → ``engine.stats()`` (the parent's fleet rollup input;
   includes the per-bank occupancy block on tenant-banked workers).
+- ``telemetry`` → this process's observability state in one frame
+  (``procfleet.TELEMETRY_SCHEMA``): the full metrics-registry dump
+  (structured label keys — ``obs.metrics.dump_state``), the
+  engine-scoped ``compiles_after_warmup`` delta, the trace ring as a
+  stitchable wall-clock part (when tracing is on), and the flight
+  recorder's ring. The supervisor merges it into the FLEET registry
+  with ``replica``/``pid`` labels, so one Prometheus scrape covers
+  every worker process.
 - ``drain`` → ack, then the SIGTERM path (remote graceful stop).
+
+Distributed-trace plumbing: a routed ``request`` frame may carry a
+``_trace`` context (``obs.trace.new_context`` from the parent's
+routing span); the worker adopts it for the dispatch, so its
+``flush``/``compile``/``bank_swap`` spans parent under the router's
+span in the stitched fleet trace. The worker also keeps a STANDING
+flight-recorder snapshot (atomic rewrite of the parent-assigned
+``flightrec`` path) — its last written generation is what the
+supervisor harvests into the incident file when this process dies a
+death it cannot dump at (SIGKILL, OOM-kill).
 
 Multi-tenant banking is configured like any other engine knob — the
 parent's ``engine_kwargs={"bank_models": True, ...}`` rides the
@@ -46,6 +64,9 @@ import signal
 import socket
 import sys
 import threading
+
+#: most recent trace events one telemetry reply ships (see the op)
+_TRACE_HARVEST_LIMIT = 4096
 
 
 def _build_backend(spec):
@@ -88,13 +109,42 @@ def _dispatch(engine, state, op, payload):
             from .batcher import ServingError
 
             raise ServingError("worker is draining (engine closed soon)")
-        return engine.predict(
-            payload["X"], model=payload.get("model"),
-            method=payload.get("method", "predict"),
-            timeout_s=payload.get("timeout_s"),
-        )
+        from skdist_tpu.obs import trace as obs_trace
+
+        with obs_trace.use_context(payload.get("_trace")):
+            return engine.predict(
+                payload["X"], model=payload.get("model"),
+                method=payload.get("method", "predict"),
+                timeout_s=payload.get("timeout_s"),
+            )
     if op == "stats":
         return engine.stats()
+    if op == "telemetry":
+        from skdist_tpu.obs import flightrec
+        from skdist_tpu.obs import metrics as obs_metrics
+        from skdist_tpu.obs import trace as obs_trace
+        from .procfleet import TELEMETRY_SCHEMA
+
+        # reading the delta also refreshes the
+        # serve.compiles_after_warmup gauge inside the dumped state
+        compiles = engine._stats.compiles_after_warmup()
+        rec = flightrec.recorder()
+        rec.dump_now()  # the standing file tracks every harvest too
+        return {
+            "schema": TELEMETRY_SCHEMA,
+            "pid": os.getpid(),
+            "state": obs_metrics.registry().dump_state(),
+            "compiles_after_warmup": compiles,
+            # a bounded tail: the harvest repeats on an interval, and
+            # shipping a full 64k-event ring would cost ~15 MB of
+            # pickle per reply; the part's `dropped` counts what the
+            # bound (and the ring itself) left behind
+            "trace": (
+                obs_trace.trace_part(limit=_TRACE_HARVEST_LIMIT)
+                if obs_trace.enabled() else None
+            ),
+            "flightrec": rec.events(),
+        }
     if op == "drain":
         state["shutdown"]()
         return {"draining": True}
@@ -150,6 +200,16 @@ def serve_forever(engine, sock_path):
     def shutdown():
         draining.set()
         try:
+            # the drain is this process's last act: freeze its flight
+            # recorder to disk while it is still plainly alive (the
+            # signal-handler path runs between bytecodes on the main
+            # thread — the most signal-safe dump Python offers)
+            from skdist_tpu.obs import flightrec
+
+            flightrec.recorder().dump_now()
+        except Exception:
+            pass
+        try:
             # closing the listener unblocks accept(); in-flight
             # connections finish their current frames
             listener.close()
@@ -185,6 +245,13 @@ def main(argv=None):
         from skdist_tpu.parallel.compile_cache import enable_disk_cache
 
         enable_disk_cache(cfg["artifact_dir"])
+    if cfg.get("trace"):
+        # the parent traced at spawn time without necessarily exporting
+        # SKDIST_TRACE — the worker must record too or the stitched
+        # fleet trace has an empty track where this process should be
+        from skdist_tpu.obs import trace as obs_trace
+
+        obs_trace.set_enabled(True)
     backend = _build_backend(cfg.get("backend"))
     from skdist_tpu.serve.engine import ServingEngine
 
@@ -193,6 +260,17 @@ def main(argv=None):
         # the fleet index rides the worker's OWN telemetry registry, so
         # its Prometheus exposition splits by replica like ReplicaSet's
         engine._stats.set_label(replica=str(cfg["replica"]))
+    from skdist_tpu.obs import flightrec
+
+    rec = flightrec.recorder()
+    if cfg.get("replica") is not None:
+        rec.set_label(f"replica {cfg['replica']}")
+    if cfg.get("flightrec"):
+        # the standing snapshot: atomically rewritten every second so a
+        # SIGKILL still leaves this process's last seconds on disk for
+        # the supervisor's incident harvest (SIGTERM additionally dumps
+        # synchronously inside serve_forever's shutdown path)
+        rec.start_autodump(cfg["flightrec"])
     return serve_forever(engine, args.socket)
 
 

@@ -59,6 +59,7 @@ import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as _FutureTimeout
 
+from ..obs import flightrec as obs_flightrec
 from ..obs import trace as obs_trace
 from ..parallel import faults
 from ..parallel.compile_cache import enable_disk_cache
@@ -243,6 +244,10 @@ class ReplicaSet:
         def attempt(last_exc=None):
             r = self._pick(exclude=tried)
             if r is None:
+                # flight-recorder post-mortem: the ring shows the
+                # failovers/respawns that exhausted the fleet (throttled
+                # — one file per cooldown, not one per queued request)
+                obs_flightrec.dump_incident("all_replicas_unhealthy")
                 exc = AllReplicasUnhealthy(
                     f"all {len(self._replicas)} replicas refused the "
                     "request"

@@ -311,12 +311,23 @@ class ServingStats:
     # ------------------------------------------------------------------
     def compiles_after_warmup(self):
         """Compile-shaped misses attributed to THIS engine's scope
-        since :meth:`mark_warm`; None before any warm mark."""
+        since :meth:`mark_warm`; None before any warm mark. Every read
+        also publishes the delta as the
+        ``serve.compiles_after_warmup`` registry GAUGE (with this
+        engine's labels), so the number survives the process boundary:
+        the procfleet telemetry harvest merges each worker's gauge
+        into the fleet registry, and the 0-compile smoke gates assert
+        on the HARVESTED value instead of trusting a field a sick
+        worker computed about itself."""
         with self._lock:
             warm = self._warm_scoped
         if warm is None:
             return None
-        return int(compile_cache.scoped_misses(self.scope) - warm)
+        delta = int(compile_cache.scoped_misses(self.scope) - warm)
+        self._bound_child(
+            "serve.compiles_after_warmup", metric_kind="gauge"
+        ).set(delta)
+        return delta
 
     @staticmethod
     def _percentile(sorted_vals, q):

@@ -559,3 +559,360 @@ class TestServingStatsView:
             compile_cache.kernel_memo(("warmtest", st.scope, 2),
                                       lambda: object())
         assert st.compiles_after_warmup() == 1
+
+
+# ---------------------------------------------------------------------------
+# PR 15: distributed observability units (trace drops, context/stitch,
+# state merge, exposition conformance, flight recorder, ops endpoint)
+# ---------------------------------------------------------------------------
+
+class TestTraceDrops:
+    def test_overflow_bills_dropped_counter_and_export_metadata(
+            self, tracing):
+        """Satellite: trace-ring overflow is detectable — the
+        ``trace.dropped_spans`` counter moves and the Chrome export's
+        ``otherData.dropped`` marks the file truncated."""
+        obs_trace.set_ring_size(8)
+        try:
+            before = obs_metrics.counter("trace.dropped_spans").get()
+            for _ in range(20):
+                with obs_trace.span("s"):
+                    pass
+            assert obs_trace.dropped() == 12
+            after = obs_metrics.counter("trace.dropped_spans").get()
+            assert after - before == 12
+            doc = obs_trace.export_chrome_trace()
+            assert doc["otherData"]["dropped"] == 12
+            # a fresh ring exports clean again (counter stays cumulative)
+            obs_trace.clear()
+            with obs_trace.span("s"):
+                pass
+            assert obs_trace.export_chrome_trace()[
+                "otherData"]["dropped"] == 0
+        finally:
+            obs_trace.set_ring_size(65536)
+
+
+class TestTraceContext:
+    def test_nested_spans_chain_parent_ids(self, tracing):
+        ctx = obs_trace.new_context()
+        with obs_trace.use_context(ctx):
+            with obs_trace.span("route"):
+                inner_ctx = obs_trace.current_context()
+                with obs_trace.span("flush"):
+                    pass
+        evs = {e["name"]: e for e in obs_trace.chrome_trace_events()}
+        route, flush = evs["route"], evs["flush"]
+        assert route["args"]["trace_id"] == ctx["trace_id"]
+        assert route["args"]["parent_id"] == ctx["span_id"]
+        assert flush["args"]["parent_id"] == route["args"]["span_id"]
+        assert inner_ctx["span_id"] == route["args"]["span_id"]
+        # the thread context was restored on exit
+        assert obs_trace.current_context() is None
+
+    def test_no_context_spans_carry_no_ids(self, tracing):
+        with obs_trace.span("bare"):
+            pass
+        ev = obs_trace.chrome_trace_events()[-1]
+        assert "args" not in ev or "trace_id" not in ev.get("args", {})
+
+    def test_instant_adopts_context(self, tracing):
+        ctx = obs_trace.new_context()
+        with obs_trace.use_context(ctx):
+            obs_trace.instant("elastic_epoch_agreement", {"epoch": 1})
+        ev = obs_trace.chrome_trace_events()[-1]
+        assert ev["args"]["trace_id"] == ctx["trace_id"]
+        assert ev["args"]["parent_id"] == ctx["span_id"]
+
+    def test_stitch_links_cross_process_spans(self, tracing):
+        """A worker span whose parent_id lives in a DIFFERENT pid gets
+        a flow-arrow pair; same-pid nesting does not."""
+        ctx = obs_trace.new_context()
+        with obs_trace.use_context(ctx):
+            with obs_trace.span("route"):
+                shipped = obs_trace.current_context()
+        router = obs_trace.trace_part(label="router")
+        # fake the worker's ring in "another process"
+        obs_trace.clear()
+        with obs_trace.use_context(shipped):
+            with obs_trace.span("flush"):
+                pass
+        worker = obs_trace.trace_part(label="replica 0")
+        worker["pid"] = router["pid"] + 1
+        for ev in worker["events"]:
+            ev["pid"] = worker["pid"]
+        doc = obs_trace.stitch_traces([router, worker])
+        names = {}
+        for ev in doc["traceEvents"]:
+            names.setdefault(ev["ph"], []).append(ev)
+        # named process tracks for both parts
+        meta = [e for e in names["M"] if e["name"] == "process_name"]
+        assert {e["args"]["name"] for e in meta} == {"router",
+                                                    "replica 0"}
+        assert {e["pid"] for e in doc["traceEvents"]} >= {
+            router["pid"], worker["pid"]}
+        # exactly one flow pair: s at the router's route span, f at the
+        # worker's flush span
+        assert len(names.get("s", [])) == 1
+        assert len(names.get("f", [])) == 1
+        assert names["s"][0]["pid"] == router["pid"]
+        assert names["f"][0]["pid"] == worker["pid"]
+        assert names["s"][0]["id"] == names["f"][0]["id"]
+        json.dumps(doc)  # the stitched doc is JSON-serializable
+
+
+class TestStateMerge:
+    def test_dump_merge_roundtrip_with_fleet_labels(self):
+        src = MetricsRegistry()
+        src.counter("serve.requests", help="req").inc(7, model="m@1")
+        src.gauge("serve.queue_depth").set(3)
+        src.histogram("serve.latency_s", buckets=(0.01, 0.1)).observe(
+            0.05, model="m@1"
+        )
+        merged = MetricsRegistry()
+        obs_metrics.merge_state(src.dump_state(), merged,
+                                labels={"replica": 0, "pid": 41})
+        assert merged.counter("serve.requests").get(
+            model="m@1", replica="0", pid="41"
+        ) == 7
+        assert merged.gauge("serve.queue_depth").get(
+            replica="0", pid="41"
+        ) == 3
+        count, total = merged.histogram("serve.latency_s").get(
+            model="m@1", replica="0", pid="41"
+        )
+        assert count == 1 and total == pytest.approx(0.05)
+        # histogram bucket layout traveled with the dump
+        assert merged.histogram("serve.latency_s").buckets == (0.01, 0.1)
+
+    def test_merge_accumulates_and_fleet_labels_win(self):
+        """Two harvests of the same worker accumulate counters; a
+        worker that self-labeled replica=9 is overridden by the
+        supervisor's roster."""
+        src = MetricsRegistry()
+        src.counter("c").inc(2, replica="9")
+        merged = MetricsRegistry()
+        obs_metrics.merge_state(src.dump_state(), merged,
+                                labels={"replica": 1})
+        obs_metrics.merge_state(src.dump_state(), merged,
+                                labels={"replica": 1})
+        assert merged.counter("c").get(replica="1") == 4
+        assert merged.counter("c").get(replica="9") == 0
+
+
+def _parse_prometheus(text):
+    """Tiny exposition parser for the round-trip pin: returns
+    {(name, frozenset(label items)): float} and validates HELP/TYPE
+    lines. Handles the three escaped characters in label values."""
+    import re
+
+    samples = {}
+    types = {}
+    helps = set()
+    name_re = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            helps.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            assert name_re.match(name), name
+            assert kind in ("counter", "gauge", "histogram")
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            body, value = rest.rsplit("} ", 1)
+            labels = {}
+            lab_re = re.compile(
+                r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(?:,|$)'
+            )
+            pos = 0
+            while pos < len(body):
+                m = lab_re.match(body, pos)
+                assert m, f"bad label body {body!r} at {pos}"
+                raw = m.group(2)
+                val = (raw.replace("\\\\", "\x00")
+                       .replace('\\"', '"')
+                       .replace("\\n", "\n")
+                       .replace("\x00", "\\"))
+                labels[m.group(1)] = val
+                pos = m.end()
+        else:
+            name, value = line.rsplit(" ", 1)
+            labels = {}
+        assert name_re.match(name), name
+        samples[(name, frozenset(labels.items()))] = float(value)
+    return samples, types, helps
+
+
+class TestExpositionConformance:
+    def test_odd_label_values_roundtrip(self):
+        r"""Satellite: a model named with backslashes, quotes, and
+        newlines still emits exposition text a conforming parser reads
+        back VERBATIM."""
+        reg = MetricsRegistry()
+        odd = 'we"ird\\mo,del\n@1'
+        reg.counter("serve.requests", help="requests routed").inc(
+            5, model=odd
+        )
+        reg.histogram("serve.latency_s", help="seconds",
+                      buckets=(0.01,)).observe(0.5, model=odd)
+        text = obs_export.prometheus_text(reg)
+        samples, types, helps = _parse_prometheus(text)
+        key = ("skdist_serve_requests_total",
+               frozenset({("model", odd)}.union()))
+        assert samples[key] == 5.0
+        assert types["skdist_serve_requests_total"] == "counter"
+        # histogram family got TYPE + HELP headers and parseable
+        # bucket/sum/count samples carrying the odd label
+        assert types["skdist_serve_latency_s"] == "histogram"
+        assert "skdist_serve_latency_s" in helps
+        assert samples[(
+            "skdist_serve_latency_s_bucket",
+            frozenset({("model", odd), ("le", "+Inf")}),
+        )] == 1.0
+        assert samples[(
+            "skdist_serve_latency_s_count", frozenset({("model", odd)}),
+        )] == 1.0
+
+    def test_nonfinite_values_use_grammar_tokens(self):
+        reg = MetricsRegistry()
+        reg.gauge("g").set(float("inf"), k="a")
+        reg.gauge("g").set(float("-inf"), k="b")
+        text = obs_export.prometheus_text(reg)
+        assert 'skdist_g{k="a"} +Inf' in text
+        assert 'skdist_g{k="b"} -Inf' in text
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_incident_dump(self, tmp_path):
+        from skdist_tpu.obs.flightrec import FlightRecorder
+
+        rec = FlightRecorder(capacity=8, min_interval_s=0.0)
+        for i in range(20):
+            rec.note("round", i=i)
+        evs = rec.events()
+        assert len(evs) == 8
+        assert evs[-1]["i"] == 19
+        path = rec.dump_incident(
+            "unit/test reason", dir=str(tmp_path),
+            extra={"replica": 1, "worker_flightrec": {"events": []}},
+        )
+        doc = json.loads(open(path).read())
+        assert doc["schema"] == 1
+        assert doc["kind"] == "incident"
+        assert doc["reason"] == "unit/test reason"
+        assert doc["pid"] == __import__("os").getpid()
+        assert doc["extra"]["replica"] == 1
+        assert [e["i"] for e in doc["events"]] == list(range(12, 20))
+        assert "metrics" in doc and "spans" in doc
+        # the reason was sanitized into the filename
+        assert "unit_test" in path
+
+    def test_incident_throttle(self, tmp_path):
+        from skdist_tpu.obs.flightrec import FlightRecorder
+
+        rec = FlightRecorder(min_interval_s=60.0)
+        p1 = rec.dump_incident("r", dir=str(tmp_path))
+        p2 = rec.dump_incident("r", dir=str(tmp_path))
+        p3 = rec.dump_incident("other", dir=str(tmp_path))
+        assert p1 is not None and p2 is None and p3 is not None
+
+    def test_standing_autodump_atomic(self, tmp_path):
+        import time as _time
+
+        from skdist_tpu.obs.flightrec import FlightRecorder
+
+        rec = FlightRecorder()
+        rec.note("x", v=1)
+        path = tmp_path / "standing.json"
+        rec.start_autodump(str(path), interval_s=0.05)
+        try:
+            deadline = _time.monotonic() + 5.0
+            while _time.monotonic() < deadline:
+                if path.exists():
+                    break
+                _time.sleep(0.02)
+            doc = json.loads(path.read_text())
+            assert doc["kind"] == "snapshot"
+            assert doc["events"][-1]["kind"] == "x"
+        finally:
+            rec.stop_autodump()
+        # a later note lands in the final stop-time dump
+        rec.note("y")
+        rec.dump_now()
+        doc = json.loads(path.read_text())
+        assert doc["events"][-1]["kind"] == "y"
+
+    def test_round_stats_feed(self):
+        """publish_round_stats notes a round summary into the
+        process recorder (the metrics→flightrec hook)."""
+        from skdist_tpu.obs import flightrec
+
+        stats = new_round_stats(mode="classic", rounds=3, tasks=24)
+        obs_metrics.publish_round_stats(stats)
+        kinds = [e for e in flightrec.recorder().events()
+                 if e["kind"] == "round"]
+        assert kinds and kinds[-1]["rounds"] == 3
+        assert kinds[-1]["mode"] == "classic"
+
+    def test_fault_record_feeds_recorder(self):
+        from skdist_tpu.obs import flightrec
+        from skdist_tpu.parallel import faults
+
+        faults.record("rounds_retried")
+        evs = [e for e in flightrec.recorder().events()
+               if e["kind"] == "fault"]
+        assert evs and evs[-1]["event"] == "rounds_retried"
+
+
+class TestOpsEndpoint:
+    def test_routes_and_status_codes(self):
+        import urllib.error
+        import urllib.request
+
+        from skdist_tpu.obs import httpd as obs_httpd
+
+        state = {"healthy": True}
+        reg = MetricsRegistry()
+        reg.counter("serve.requests").inc(3, replica="0")
+
+        srv = obs_httpd.OpsServer(
+            port=0,
+            metrics=lambda: obs_export.prometheus_text(reg),
+            healthz=lambda: dict(state),
+        ).start()
+        try:
+            body = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=5
+            ).read().decode()
+            assert "skdist_serve_requests_total" in body
+            assert 'replica="0"' in body
+            with urllib.request.urlopen(
+                    srv.url + "/healthz", timeout=5) as resp:
+                assert resp.status == 200
+                assert json.load(resp)["healthy"] is True
+            state["healthy"] = False
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+            assert ei.value.code == 503
+            doc = json.load(urllib.request.urlopen(
+                srv.url + "/debug/flightrec", timeout=5
+            ))
+            assert doc["kind"] == "snapshot"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/nope", timeout=5)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_off_by_default(self, monkeypatch):
+        from skdist_tpu.obs import httpd as obs_httpd
+
+        monkeypatch.delenv("SKDIST_OBS_PORT", raising=False)
+        assert obs_httpd.start_from_env() is None
+        assert obs_httpd.resolve_port(None) is None
+        monkeypatch.setenv("SKDIST_OBS_PORT", "0")
+        assert obs_httpd.resolve_port(None) == 0

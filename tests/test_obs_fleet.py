@@ -1,0 +1,299 @@
+"""
+Fleet-wide observability (PR 15): the ProcessReplicaSet telemetry
+harvest, its degradation contract, incident files, and the ops
+endpoint — unit-tested with CHEAP fake workers (plain socket servers
+speaking the wire protocol; no jax import per child), mirroring
+``test_procfleet.py``'s idiom. The heavy end-to-end leg (real worker
+processes, SIGKILL, stitched trace, overhead gate) lives in
+``build_tools/obs_fleet_smoke.py``.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from skdist_tpu.obs import export as obs_export
+from skdist_tpu.obs import flightrec as obs_flightrec
+from skdist_tpu.serve import ProcessReplicaSet
+from skdist_tpu.serve.procfleet import TELEMETRY_SCHEMA, harvest_enabled
+
+#: a wire-conformant worker whose ``telemetry`` behaviour is picked by
+#: argv: "good" answers the current schema with a labeled counter in
+#: its dump; "old-schema" answers schema 0 (a mixed-version fleet);
+#: "no-op" predates the op entirely (ValueError over the wire);
+#: "die-mid-telemetry" exits hard INSIDE the telemetry RPC
+_FAKE_WORKER = r"""
+import os, pickle, socket, struct, sys, threading
+sock_path, mode = sys.argv[1], sys.argv[2]
+H = struct.Struct(">I")
+def recv_exact(c, n):
+    b = b""
+    while len(b) < n:
+        chunk = c.recv(n - len(b))
+        if not chunk:
+            raise EOFError
+        b += chunk
+    return b
+def recv(c):
+    (n,) = H.unpack(recv_exact(c, 4))
+    return pickle.loads(recv_exact(c, n))
+def send(c, obj):
+    p = pickle.dumps(obj)
+    c.sendall(H.pack(len(p)) + p)
+def telemetry_reply():
+    if mode == "old-schema":
+        return {"ok": True, "value": {"schema": 0, "state": {}}}
+    if mode == "no-op":
+        return {"ok": False, "etype": "ValueError",
+                "msg": "unknown op 'telemetry'"}
+    state = {
+        "serve.requests": {
+            "kind": "counter", "help": "",
+            "children": {(("model", "m@1"),): 7},
+        },
+        "serve.compiles_after_warmup": {
+            "kind": "gauge", "help": "",
+            "children": {(("engine", "serve-0"),): 0},
+        },
+    }
+    return {"ok": True, "value": {
+        "schema": 1, "pid": os.getpid(), "state": state,
+        "compiles_after_warmup": 0, "trace": None, "flightrec": [],
+    }}
+def serve(c):
+    try:
+        while True:
+            op, payload = recv(c)
+            if op == "telemetry" and mode == "die-mid-telemetry":
+                os._exit(9)
+            if op == "ping":
+                send(c, {"ok": True, "value": {
+                    "pid": os.getpid(), "draining": False,
+                    "queue_depth": 0}})
+            elif op == "telemetry":
+                send(c, telemetry_reply())
+            else:
+                send(c, {"ok": True, "value": {}})
+    except Exception:
+        pass
+ls = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+try:
+    os.unlink(sock_path)
+except FileNotFoundError:
+    pass
+ls.bind(sock_path)
+ls.listen(8)
+while True:
+    c, _ = ls.accept()
+    threading.Thread(target=serve, args=(c,), daemon=True).start()
+"""
+
+
+def _fake_argv(mode):
+    def argv(index, sock_path, cfg):
+        return [sys.executable, "-c", _FAKE_WORKER, sock_path, mode]
+
+    return argv
+
+
+def _fleet(mode, n=1, **kwargs):
+    kwargs.setdefault("spawn_timeout_s", 15.0)
+    kwargs.setdefault("heartbeat_interval_s", 5.0)  # tests drive harvest
+    kwargs.setdefault("harvest_interval_s", 0.0)    # ... manually
+    kwargs.setdefault("respawn_backoff_s", 30.0)
+    return ProcessReplicaSet(
+        n_replicas=n, worker_argv=_fake_argv(mode), **kwargs
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fast_incidents():
+    rec = obs_flightrec.recorder()
+    prev = rec.min_interval_s
+    rec.min_interval_s = 0.0
+    yield
+    rec.min_interval_s = prev
+
+
+def _stale_value(text, replica):
+    for line in text.splitlines():
+        if line.startswith("skdist_stale{") and (
+                f'replica="{replica}"' in line):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"no skdist_stale sample for replica {replica}"
+                         f" in:\n{text}")
+
+
+def test_harvest_merges_worker_state_with_fleet_labels():
+    with _fleet("good", n=2) as fleet:
+        assert fleet.harvest_now() == 2
+        reg = fleet.fleet_registry()
+        for i in (0, 1):
+            pid = fleet.replica(i).telemetry_pid
+            assert pid is not None
+            assert reg.counter("serve.requests").get(
+                model="m@1", replica=str(i), pid=str(pid)
+            ) == 7
+        st = fleet.stats()
+        hb = st["harvest"]
+        assert hb["enabled"] == harvest_enabled()
+        for i in ("0", "1"):
+            assert hb["replicas"][i]["stale"] is False
+            assert hb["replicas"][i]["compiles_after_warmup"] == 0
+        text = fleet.fleet_metrics_text()
+        assert 'skdist_serve_requests_total' in text
+        assert _stale_value(text, 0) == 0.0
+        assert _stale_value(text, 1) == 0.0
+
+
+def test_old_schema_degrades_to_stale_not_failure():
+    with _fleet("old-schema") as fleet:
+        assert fleet.harvest_now() == 0
+        st = fleet.stats()  # stats() must not raise
+        assert st["harvest"]["replicas"]["0"]["stale"] is True
+        assert _stale_value(fleet.fleet_metrics_text(), 0) == 1.0
+
+
+def test_pre_telemetry_worker_degrades_to_stale():
+    """A worker built before the telemetry op exists answers
+    ValueError over the wire — stale, never a stats() crash."""
+    with _fleet("no-op") as fleet:
+        assert fleet.harvest_now() == 0
+        assert fleet.stats()["harvest"]["replicas"]["0"]["stale"] is True
+        assert _stale_value(fleet.fleet_metrics_text(), 0) == 1.0
+
+
+def test_worker_death_mid_telemetry_keeps_last_state(tmp_path):
+    """A replica dying INSIDE the telemetry RPC: the fleet keeps its
+    last good harvest, marks it stale, and exposition still parses."""
+    with _fleet("die-mid-telemetry",
+                incident_dir=str(tmp_path)) as fleet:
+        r = fleet.replica(0)
+        # seed a last-good state as if an earlier harvest succeeded
+        r.telemetry_state = {
+            "serve.requests": {"kind": "counter", "help": "",
+                               "children": {(): 3}},
+        }
+        r.telemetry_pid = r.pid
+        r.telemetry_stale = False
+        assert fleet.harvest_now() == 0
+        assert r.telemetry_stale is True
+        text = fleet.fleet_metrics_text()
+        # frozen last-good numbers still exposed, marked stale
+        assert "skdist_serve_requests_total" in text
+        assert _stale_value(text, 0) == 1.0
+
+
+def test_parked_replica_is_stale_and_death_dumps_incident(tmp_path):
+    def crash_argv(index, sock_path, cfg):
+        return [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+    fleet = ProcessReplicaSet(
+        n_replicas=1, worker_argv=crash_argv, spawn_timeout_s=10.0,
+        respawn_backoff_s=0.01, crash_loop_threshold=2,
+        crash_loop_window_s=60.0, heartbeat_interval_s=0.05,
+        harvest_interval_s=0.0, incident_dir=str(tmp_path),
+    )
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if fleet.replica(0).parked:
+                break
+            time.sleep(0.05)
+        assert fleet.replica(0).parked
+        assert fleet.harvest_now() == 0
+        assert fleet.stats()["harvest"]["replicas"]["0"]["stale"] is True
+        assert _stale_value(fleet.fleet_metrics_text(), 0) == 1.0
+        incidents = [p for p in os.listdir(tmp_path)
+                     if p.startswith("skdist-incident-")]
+        assert incidents, "replica deaths left no incident file"
+        doc = json.loads(
+            (tmp_path / sorted(incidents)[-1]).read_text()
+        )
+        assert doc["schema"] == 1
+        assert doc["extra"]["replica"] == 0
+        assert "death_reason" in doc["extra"]
+        # the ring shows the fleet lifecycle that led here
+        assert any(e["kind"].startswith("fleet.")
+                   for e in doc["events"])
+        park_dumps = [p for p in incidents if "crash_loop_park" in p]
+        assert park_dumps, "the park itself did not dump"
+    finally:
+        fleet.close()
+
+
+def test_ops_endpoint_serves_fleet_views(tmp_path):
+    with _fleet("good", n=2, obs_port=0) as fleet:
+        assert fleet.ops_url is not None
+        body = urllib.request.urlopen(
+            fleet.ops_url + "/metrics", timeout=10
+        ).read().decode()
+        # the scrape triggered a refresh harvest: both replicas' merged
+        # counters and their stale=0 marks are in one exposition
+        for i in (0, 1):
+            assert f'replica="{i}"' in body
+        assert "skdist_serve_requests_total" in body
+        assert _stale_value(body, 0) == 0.0
+        with urllib.request.urlopen(
+                fleet.ops_url + "/healthz", timeout=10) as resp:
+            assert resp.status == 200
+            doc = json.load(resp)
+        assert doc["healthy"] is True and doc["live_replicas"] == 2
+        fr = json.load(urllib.request.urlopen(
+            fleet.ops_url + "/debug/flightrec", timeout=10
+        ))
+        assert "router" in fr and set(fr["replicas"]) == {"0", "1"}
+        url = fleet.ops_url
+    # after close the endpoint is down
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url + "/healthz", timeout=2)
+
+
+def test_harvest_kill_switch(monkeypatch):
+    monkeypatch.setenv("SKDIST_OBS_HARVEST", "0")
+    assert not harvest_enabled()
+    with _fleet("good") as fleet:
+        # manual harvest still works (the switch gates the PERIODIC
+        # supervisor harvest; operator APIs stay live)
+        assert fleet.stats()["harvest"]["enabled"] is False
+    monkeypatch.setenv("SKDIST_OBS_HARVEST", "1")
+    assert harvest_enabled()
+
+
+def test_worker_env_strips_obs_port(monkeypatch):
+    monkeypatch.setenv("SKDIST_OBS_PORT", "0")
+    with _fleet("good") as fleet:
+        # the fleet itself picked the env port up ...
+        assert fleet.ops_url is not None
+        # ... but did NOT hand it to workers (no bind fights): pin via
+        # the spawn env recipe
+        import skdist_tpu.serve.procfleet as pf
+
+        captured = {}
+        real_popen = pf.subprocess.Popen
+
+        def spy(argv, **kw):
+            captured["env"] = kw.get("env")
+            return real_popen(argv, **kw)
+
+        monkeypatch.setattr(pf.subprocess, "Popen", spy)
+        fleet.kill_replica(0)
+        fleet.replica(0).proc.wait(timeout=10)
+        fleet._declare_dead(fleet.replica(0), "test kill", kill=False)
+        assert fleet.heal() == 1
+        assert "SKDIST_OBS_PORT" not in captured["env"]
+        assert fleet.replica(0).alive
+
+
+def test_telemetry_schema_constant_matches_worker():
+    """The worker module and the supervisor must agree on the frame
+    schema (the mixed-version degradation path keys off it)."""
+    import skdist_tpu.serve.procworker as pw
+
+    src = open(pw.__file__).read()
+    assert "TELEMETRY_SCHEMA" in src
+    assert TELEMETRY_SCHEMA == 1
