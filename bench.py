@@ -1326,6 +1326,250 @@ def obs_fleet_aux(quick=True, repeats=2, trace_path=None,
         shutil.rmtree(aot_dir, ignore_errors=True)
 
 
+def wirespeed_aux(quick=True):
+    """Measured readout of the wire-speed transport (PR 17) on
+    ``ProcessReplicaSet`` fleets — the first entry in the transport
+    perf trajectory, recording the pickle baseline alongside:
+
+    - **overhead legs** (the >=5x gate): a 2-replica fleet serving
+      8 MiB request payloads (4096 rows x 512 f32 features — big
+      enough that memcpy dominates the single-core scheduler noise a
+      doorbell send pays on this box) under 3 threaded clients, once
+      on the shm plane and once with ``SKDIST_SHM=0``; the
+      supervisor-measured per-request transport overhead
+      (``stats()["transport"]``: serialize/send + reply decode + ring
+      memcpys) gives ``overhead_ratio``;
+    - **p99 legs**: identical threaded load offered to a 3-replica
+      fleet and to a single replica (small shm-riding requests);
+      client-side p99s give ``fleet_p99_over_single``;
+    - **autotune leg**: a 3-replica fleet under 96-row threaded load;
+      mid-load, a swapper thread fires ``fleet.autotune_now()`` once
+      enough per-worker samples exist — records the ladder swaps,
+      failed requests across the swap, and the post-swap HARVESTED
+      ``compiles_after_warmup`` (prewarm-before-swap must keep it 0);
+    - **SIGKILL leg**: /dev/shm segment census before/after a replica
+      SIGKILL + supervised respawn + fleet close (supervisor-owned
+      rings must never leak).
+
+    Best-effort: a dict with "error" on any failure."""
+    import glob as _glob
+    import shutil
+    import tempfile
+    import threading as _threading
+
+    from skdist_tpu.models import LogisticRegression
+    from skdist_tpu.serve import ProcessReplicaSet
+
+    rng = np.random.RandomState(0)
+    # small 8-feature model: the p99 / autotune / SIGKILL legs
+    Xs = np.vstack([
+        rng.normal(loc=c, scale=0.6, size=(60, 8)) for c in (-1.5, 1.5)
+    ]).astype(np.float32)
+    small = LogisticRegression(max_iter=20, engine="xla").fit(
+        Xs, np.repeat([0, 1], 60)
+    )
+    # wide 512-feature model: the 8 MiB transport-overhead legs
+    n_feat = 512
+    Xw = np.vstack([
+        rng.normal(loc=c, scale=0.6, size=(200, n_feat))
+        for c in (-1.5, 1.5)
+    ]).astype(np.float32)
+    wide = LogisticRegression(max_iter=10, engine="xla").fit(
+        Xw, np.repeat([0, 1], 200)
+    )
+    big = rng.normal(size=(4096, n_feat)).astype(np.float32)  # 8 MiB
+    aot_dir = tempfile.mkdtemp(prefix="skws-aot-")
+    prev_shm = os.environ.get("SKDIST_SHM")
+
+    def drive(fleet, x, n_threads, n_requests, timeout_s=60.0,
+              on_done=None):
+        """``n_threads`` sync clients x ``n_requests`` each; returns
+        (per-request client latencies, error reprs)."""
+        lats, errors = [], []
+        lock = _threading.Lock()
+
+        def client(tid):
+            for _ in range(n_requests):
+                t0 = time.perf_counter()
+                try:
+                    out = fleet.predict(x, model="clf",
+                                        timeout_s=timeout_s)
+                    dt = time.perf_counter() - t0
+                    assert np.asarray(out).shape[0] == x.shape[0]
+                    with lock:
+                        lats.append(dt)
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(exc))
+                if on_done is not None:
+                    on_done()
+
+        threads = [_threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats, errors
+
+    def seg_count():
+        return len(_glob.glob("/dev/shm/psm_*"))
+
+    try:
+        out = {}
+
+        # -- transport-overhead legs: shm plane vs pickle baseline -----
+        n_big = 8 if quick else 12
+        for plane, env in (("shm", "1"), ("pickle", "0")):
+            os.environ["SKDIST_SHM"] = env
+            with ProcessReplicaSet(
+                n_replicas=2, artifact_dir=aot_dir,
+                engine_kwargs={"max_batch_rows": 4096,
+                               "max_delay_ms": 1.0},
+                shm_slots=4, shm_slot_bytes=8 << 20,
+                heartbeat_interval_s=1.0, harvest_interval_s=0.0,
+            ) as fleet:
+                fleet.rollout("clf", wide, methods=("predict",))
+                for _ in range(3):
+                    fleet.predict(big, model="clf", timeout_s=120.0)
+                _, errors = drive(fleet, big, 3, n_big,
+                                  timeout_s=120.0)
+                if errors:
+                    return {"error":
+                            f"{plane} overhead leg: {errors[0]}"}
+                tr = fleet.stats()["transport"]
+            out[f"{plane}_requests"] = tr[f"{plane}_requests"]
+            out[f"{plane}_mean_overhead_s"] = (
+                tr[f"{plane}_mean_overhead_s"]
+            )
+            if plane == "shm":
+                # every payload must actually have ridden the ring
+                out["shm_leg_pickled_requests"] = tr["pickle_requests"]
+        out["payload_bytes"] = int(big.nbytes)
+        out["overhead_ratio"] = round(
+            out["pickle_mean_overhead_s"] / out["shm_mean_overhead_s"],
+            2,
+        )
+
+        # -- p99 legs: same offered load, 3 replicas vs 1. Requests
+        # fill the max bucket so a lone replica's batcher can't merge
+        # the whole thread herd into one flush (that asymmetry, not
+        # transport, would dominate the ratio on a small host) -------
+        os.environ["SKDIST_SHM"] = "1"
+        n_threads, n_requests = (12, 20) if quick else (12, 30)
+        x64 = rng.normal(size=(64, n_feat)).astype(np.float32)
+        for label, n_rep in (("fleet", 3), ("single", 1)):
+            with ProcessReplicaSet(
+                n_replicas=n_rep, artifact_dir=aot_dir,
+                engine_kwargs={"max_batch_rows": 64,
+                               "max_delay_ms": 1.0},
+                heartbeat_interval_s=1.0, harvest_interval_s=0.0,
+            ) as fleet:
+                fleet.rollout("clf", wide, methods=("predict",))
+                drive(fleet, x64, n_threads, 5)  # warm pass
+                lats, errors = drive(fleet, x64, n_threads,
+                                     n_requests)
+                if errors:
+                    return {"error": f"{label} p99 leg: {errors[0]}"}
+            out[f"{label}_p99_s"] = round(
+                float(np.percentile(np.array(lats), 99)), 5
+            )
+        out["fleet_p99_over_single"] = round(
+            out["fleet_p99_s"] / out["single_p99_s"], 3
+        )
+
+        # -- mid-load autotune ladder swap -----------------------------
+        sw_threads, sw_requests = 4, 40
+        total = sw_threads * sw_requests
+        swap_at = 112  # >= 32 request-size samples per worker by then
+        done = [0]
+        dlock = _threading.Lock()
+
+        def on_done():
+            with dlock:
+                done[0] += 1
+
+        x96 = rng.normal(size=(96, Xs.shape[1])).astype(np.float32)
+        swap_report = {}
+        with ProcessReplicaSet(
+            n_replicas=3, artifact_dir=aot_dir,
+            engine_kwargs={"max_batch_rows": 256, "max_delay_ms": 1.0},
+            heartbeat_interval_s=1.0, harvest_interval_s=0.0,
+        ) as fleet:
+            fleet.rollout("clf", small, methods=("predict",))
+            for _ in range(3):
+                fleet.predict(x96, model="clf", timeout_s=60.0)
+
+            def swapper():
+                while True:
+                    with dlock:
+                        if done[0] >= swap_at:
+                            break
+                    time.sleep(0.005)
+                swap_report.update(fleet.autotune_now())
+
+            sw = _threading.Thread(target=swapper)
+            sw.start()
+            lats, errors = drive(fleet, x96, sw_threads, sw_requests,
+                                 on_done=on_done)
+            sw.join()
+            # post-swap traffic must stay compile-free (the prewarmed
+            # ladder), then harvest the workers' own compile scopes
+            for _ in range(6):
+                fleet.predict(x96, model="clf", timeout_s=60.0)
+            fleet.harvest_now()
+            hv = fleet.stats()["harvest"]["replicas"]
+            out["autotune_requests"] = total
+            out["autotune_failed_requests"] = len(errors)
+            out["autotune_swaps"] = sum(
+                len(v.get("swapped", []))
+                for v in swap_report.values() if isinstance(v, dict)
+            )
+            out["autotune_buckets"] = sorted({
+                tuple(s["buckets"])
+                for v in swap_report.values() if isinstance(v, dict)
+                for s in v.get("swapped", [])
+            })
+            out["harvested_compiles_after_warmup"] = {
+                i: hv[i]["compiles_after_warmup"] for i in sorted(hv)
+            }
+            out["harvest_stale"] = {
+                i: hv[i]["stale"] for i in sorted(hv)
+            }
+
+        # -- SIGKILL mid-service: /dev/shm census ----------------------
+        base = seg_count()
+        with ProcessReplicaSet(
+            n_replicas=2, artifact_dir=aot_dir,
+            engine_kwargs={"max_batch_rows": 64, "max_delay_ms": 1.0},
+            heartbeat_interval_s=0.25, harvest_interval_s=0.0,
+        ) as fleet:
+            fleet.rollout("clf", small, methods=("predict",))
+            fleet.predict(Xs[:3], model="clf", timeout_s=60.0)
+            out["shm_segments_live"] = seg_count() - base
+            old_pid = fleet.replica(1).pid
+            fleet.kill_replica(1)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                r = fleet.replica(1)
+                if r.alive and r.pid not in (None, old_pid):
+                    break
+                time.sleep(0.1)
+            out["shm_segments_after_respawn"] = seg_count() - base
+            for _ in range(6):
+                fleet.predict(Xs[:3], model="clf", timeout_s=60.0)
+        out["shm_segments_after_close"] = seg_count() - base
+        return out
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    finally:
+        if prev_shm is None:
+            os.environ.pop("SKDIST_SHM", None)
+        else:
+            os.environ["SKDIST_SHM"] = prev_shm
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+
 def gbdt_workload(quick=True, seed=0):
     """Tabular multiclass problem for the GBDT readout (covtype-shaped:
     informative dense features + a non-linear term, 3 classes) plus a
@@ -2127,9 +2371,34 @@ def _obs_fleet_main(quick=True):
     return payload
 
 
+def _wirespeed_main(quick=True):
+    """Standalone capture of the wire-speed-transport readout →
+    ``BENCH_wirespeed_r17.json`` (shm vs pickle per-request transport
+    overhead on 8 MiB payloads — the pickle baseline is recorded
+    alongside as the perf trajectory's first entry — fleet-vs-single
+    p99 under identical offered load, mid-load autotune ladder swap
+    with harvested 0-compile evidence, and the /dev/shm segment census
+    across a replica SIGKILL)."""
+    import jax
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    payload = {
+        "metric": "wirespeed_transport",
+        "aux": wirespeed_aux(quick=quick),
+        "platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(payload, indent=1), flush=True)
+    with open(os.path.join(here, "BENCH_wirespeed_r17.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
 if __name__ == "__main__":
     if "--phase" in sys.argv:
         _phase_main(sys.argv)
+    elif "--wirespeed" in sys.argv:
+        _wirespeed_main(quick=("--full" not in sys.argv))
     elif "--obs-fleet" in sys.argv:
         _obs_fleet_main(quick=("--full" not in sys.argv))
     elif "--obs" in sys.argv:

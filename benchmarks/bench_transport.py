@@ -1,0 +1,149 @@
+"""
+Wire-speed transport sweep: ring slot count × rows-per-request ×
+payload width, for the autotune tuning tables.
+
+Two in-process measurements per cell (no fleet: this isolates the
+data-plane cost the supervisor's ``stats()["transport"]`` measures in
+situ, without scheduler noise from real worker processes):
+
+- **roundtrip**: one request's data-plane cost on each plane. shm =
+  caller-side ``ring.write`` (the one bounded memcpy) + worker-side
+  ``ring.view`` (zero-copy ingest) + result write-back into the same
+  slot + caller-side ``ring.read``. pickle = ``dumps``/``loads`` of
+  the request rows + ``dumps``/``loads`` of the result (protocol 5,
+  what the socket frames pay today).
+- **saturation**: ``clients`` threads hammer acquire/write/read/
+  release on one ring; the fallback rate (``acquire() -> None``) per
+  slot count shows how many slots a given concurrency needs before
+  requests start riding pickled frames.
+
+Output: one JSON dict with a row per (slots, rows, features) cell:
+``shm_roundtrip_us``, ``pickle_roundtrip_us``, ``ratio``, and the
+saturation table ``fallback_rate`` per slot count. Rings hold
+``slot_bytes = payload_bytes`` exactly, so every cell measures a
+fitting payload (the oversized path is a procfleet test concern, not
+a tuning table).
+
+Usage:
+    python benchmarks/bench_transport.py [--repeats 200] [--clients 8]
+"""
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from skdist_tpu.serve.shm import ShmRing
+
+SLOT_COUNTS = (2, 8, 16)
+ROWS = (16, 256, 2048)
+FEATURES = (8, 512)
+
+
+def roundtrip_cell(slots, rows, n_feat, repeats):
+    """Best-of-``repeats`` one-request data-plane cost on both planes
+    (best-of isolates the copy cost from scheduler preemption)."""
+    rng = np.random.RandomState(rows * n_feat % 9973)
+    X = rng.normal(size=(rows, n_feat)).astype(np.float32)
+    result = rng.normal(size=(rows,)).astype(np.float32)
+    best_shm = best_pickle = float("inf")
+    with ShmRing.create(slots=slots, slot_bytes=X.nbytes) as ring:
+        for _ in range(repeats):
+            slot = ring.acquire()
+            t0 = time.perf_counter()
+            desc = ring.write(slot, X)          # caller: bounded memcpy
+            seen = ring.view(desc)              # worker: zero-copy view
+            out_desc = ring.write(slot, result)  # worker: reply in place
+            out = ring.read(out_desc)           # caller: copy out
+            best_shm = min(best_shm, time.perf_counter() - t0)
+            ring.release(slot)
+            assert seen.shape == X.shape and out.shape == result.shape
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            wire = pickle.dumps(X, protocol=5)
+            pickle.loads(wire)
+            back = pickle.dumps(result, protocol=5)
+            pickle.loads(back)
+            best_pickle = min(best_pickle, time.perf_counter() - t0)
+    return {
+        "slots": slots, "rows": rows, "features": n_feat,
+        "payload_bytes": int(X.nbytes),
+        "shm_roundtrip_us": round(best_shm * 1e6, 2),
+        "pickle_roundtrip_us": round(best_pickle * 1e6, 2),
+        "ratio": round(best_pickle / best_shm, 2),
+    }
+
+
+def saturation_row(slots, clients, per_client, rows=256, n_feat=8):
+    """Fallback rate when ``clients`` threads contend for ``slots``
+    ring slots — the slots-vs-concurrency sizing table."""
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(rows, n_feat)).astype(np.float32)
+    fallbacks = [0]
+    lock = threading.Lock()
+    with ShmRing.create(slots=slots, slot_bytes=X.nbytes) as ring:
+        def client():
+            miss = 0
+            for _ in range(per_client):
+                slot = ring.acquire()
+                if slot is None:
+                    miss += 1  # would ride a pickled frame
+                    continue
+                try:
+                    desc = ring.write(slot, X)
+                    ring.read(desc)
+                finally:
+                    ring.release(slot)
+            with lock:
+                fallbacks[0] += miss
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    total = clients * per_client
+    return {
+        "slots": slots, "clients": clients, "requests": total,
+        "fallback_rate": round(fallbacks[0] / total, 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--repeats", type=int, default=200)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--per-client", type=int, default=2000)
+    args = ap.parse_args()
+
+    cells = []
+    for slots in SLOT_COUNTS:
+        for rows in ROWS:
+            for n_feat in FEATURES:
+                cells.append(roundtrip_cell(slots, rows, n_feat,
+                                            args.repeats))
+    saturation = [
+        saturation_row(slots, args.clients, args.per_client)
+        for slots in SLOT_COUNTS
+    ]
+    out = {
+        "metric": "shm_transport_sweep",
+        "roundtrip": cells,
+        "saturation": saturation,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+    }
+    print(json.dumps(out, indent=1))
+    return out
+
+
+if __name__ == "__main__":
+    main()

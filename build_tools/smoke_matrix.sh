@@ -131,6 +131,19 @@
 #                             harvest overhead <= 5% vs
 #                             SKDIST_OBS_HARVEST=0 (distributed
 #                             observability PR).
+#   wirespeed_smoke.py      — wire-speed transport: shm data plane's
+#                             supervisor-measured per-request transport
+#                             overhead >= 5x lower than the pickle
+#                             baseline (SKDIST_SHM=0) on identical
+#                             8 MiB threaded load, 3-replica fleet p99
+#                             <= 2x single-replica p99 at the same
+#                             offered load, mid-load autotune ladder
+#                             swap with 0 failed requests and 0
+#                             HARVESTED post-warmup compiles
+#                             (prewarm-before-swap), /dev/shm segment
+#                             census conserved across replica SIGKILL
+#                             + respawn and zero after close
+#                             (wire-speed transport PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
@@ -147,3 +160,4 @@ python build_tools/gbdt_smoke.py
 python build_tools/obs_smoke.py
 python build_tools/obs_fleet_smoke.py
 python build_tools/multitenant_smoke.py
+python build_tools/wirespeed_smoke.py

@@ -1,0 +1,134 @@
+"""Wire-speed transport smoke: the PR-17 acceptance gate, standalone
+on the CPU mesh.
+
+Runs ``bench.wirespeed_aux`` — saturating threaded load against
+``ProcessReplicaSet`` fleets — and asserts:
+
+- the supervisor-measured per-request transport overhead on the shm
+  plane is >= 5x lower than the pickle baseline (identical 8 MiB
+  payloads, identical threaded load, ``SKDIST_SHM=0`` for the
+  baseline leg), with every shm-leg payload actually riding the ring
+  (0 pickled requests on that leg);
+- a 3-replica fleet's client-side p99 stays <= 2x a single replica's
+  p99 under the same offered load (scaling the fleet must not blow up
+  the tail);
+- a mid-load ``fleet.autotune_now()`` ladder swap (96-row traffic
+  re-anchoring the default ladder) applies >= 1 swap, loses 0
+  requests, and the post-swap HARVESTED ``compiles_after_warmup`` is
+  0 on every replica — prewarm-before-swap means re-tuning never
+  compiles on the request path;
+- the /dev/shm segment census across a replica SIGKILL: one live
+  segment per replica while serving, the same count after the
+  supervised respawn (dead ring unlinked, fresh ring created), and 0
+  after ``close()`` — supervisor-owned rings can never leak.
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/wirespeed_smoke.py [--ratio 5.0] [--full]
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def main(argv):
+    ratio_gate = 5.0
+    if "--ratio" in argv:
+        ratio_gate = float(argv[argv.index("--ratio") + 1])
+
+    from bench import wirespeed_aux
+
+    aux = wirespeed_aux(quick=("--full" not in argv))
+    print(json.dumps(aux, indent=1))
+    if "error" in aux:
+        raise SystemExit(f"FAIL: wirespeed aux died: {aux['error']}")
+
+    failures = []
+    if aux["overhead_ratio"] < ratio_gate:
+        failures.append(
+            f"shm transport overhead only {aux['overhead_ratio']}x "
+            f"lower than the pickle baseline (want >= {ratio_gate}x: "
+            f"shm {aux['shm_mean_overhead_s']:.6f}s vs pickle "
+            f"{aux['pickle_mean_overhead_s']:.6f}s per request)"
+        )
+    if aux["shm_leg_pickled_requests"]:
+        failures.append(
+            f"{aux['shm_leg_pickled_requests']} requests on the shm "
+            "leg fell back to pickled frames (payloads must ride the "
+            "ring)"
+        )
+    if aux["fleet_p99_over_single"] > 2.0:
+        failures.append(
+            f"fleet p99 {aux['fleet_p99_s']}s is "
+            f"{aux['fleet_p99_over_single']}x the single-replica p99 "
+            f"{aux['single_p99_s']}s (want <= 2x)"
+        )
+    if aux["autotune_swaps"] < 1:
+        failures.append(
+            "the mid-load autotune pass applied no ladder swap "
+            f"(report buckets: {aux['autotune_buckets']})"
+        )
+    if aux["autotune_failed_requests"]:
+        failures.append(
+            f"{aux['autotune_failed_requests']} requests failed "
+            "across the mid-load ladder swap (want 0)"
+        )
+    for i, c in aux["harvested_compiles_after_warmup"].items():
+        if aux["harvest_stale"].get(i):
+            failures.append(f"replica {i} harvest is stale post-swap")
+        elif c != 0:
+            failures.append(
+                f"replica {i} HARVESTED compiles_after_warmup={c} != "
+                "0 (the swap must prewarm before cutover)"
+            )
+    if aux["shm_segments_live"] != 2:
+        failures.append(
+            f"{aux['shm_segments_live']} live /dev/shm segments for a "
+            "2-replica fleet (want 2: one ring per replica)"
+        )
+    if aux["shm_segments_after_respawn"] != 2:
+        failures.append(
+            f"{aux['shm_segments_after_respawn']} /dev/shm segments "
+            "after the SIGKILL + respawn (want 2: dead ring unlinked, "
+            "fresh ring created)"
+        )
+    if aux["shm_segments_after_close"] != 0:
+        failures.append(
+            f"{aux['shm_segments_after_close']} /dev/shm segments "
+            "leaked after close()"
+        )
+
+    if failures:
+        print("FAIL:\n  " + "\n  ".join(failures))
+        raise SystemExit(1)
+    print(
+        f"PASS: shm transport {aux['overhead_ratio']}x cheaper than "
+        f"pickle per request ({aux['shm_mean_overhead_s']:.6f}s vs "
+        f"{aux['pickle_mean_overhead_s']:.6f}s on "
+        f"{aux['payload_bytes']} B payloads), fleet p99 "
+        f"{aux['fleet_p99_over_single']}x single-replica p99, "
+        f"{aux['autotune_swaps']} ladder swap(s) mid-load with "
+        f"{aux['autotune_requests']}/{aux['autotune_requests']} "
+        "requests served and harvested compiles "
+        f"{aux['harvested_compiles_after_warmup']}, /dev/shm census "
+        f"{aux['shm_segments_live']}/"
+        f"{aux['shm_segments_after_respawn']}/"
+        f"{aux['shm_segments_after_close']} across "
+        "SIGKILL/respawn/close"
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -370,6 +370,12 @@ class MetricsRegistry:
                 raise TypeError(
                     f"metric {name!r} is a {m.kind}, not a {cls.kind}"
                 )
+            elif not m.help and kwargs.get("help"):
+                # help is sticky at the first NON-EMPTY registration: a
+                # bare counter(name) peek (tests, ad-hoc reads) must not
+                # strip the HELP line off the family's real
+                # registration site for the rest of the process
+                m.help = kwargs["help"]
             return m
 
     def counter(self, name, help=""):

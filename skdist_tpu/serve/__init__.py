@@ -53,6 +53,23 @@ over the same compiled block-inference programs the offline
   heartbeat liveness, process-group SIGKILL of wedged workers,
   bounded-backoff respawn with crash-loop parking, graceful SIGTERM
   drain, and zero-downtime ``rolling_restart()``.
+- **Wire-speed transport** (``serve.shm``): each (supervisor, replica)
+  pair shares a fixed-slot shared-memory ring; request rows and
+  replies ride raw slots while the unix socket carries only tiny
+  doorbell frames — zero-copy ingest worker-side, one bounded memcpy
+  caller-side, automatic pickled-frame fallback (ring full, oversized
+  payload, ``SKDIST_SHM=0``), and supervisor-owned segments so a
+  SIGKILLed worker can never leak ``/dev/shm``.
+- :class:`ServingAutotuner` (``serve.autotune``) — closes the loop
+  from the request-size histograms ``ServingStats`` records back into
+  the bucket ladder / bank ``rows_per_slot``: prewarm-before-swap,
+  bounded hysteresis, ``SKDIST_SERVE_AUTOTUNE=0`` kill switch.
+- **SLO-aware scheduling** — requests carry deadlines into the
+  batcher: flushes assemble earliest-deadline-first, and a
+  shed-before-queue admission gate rejects (typed
+  :class:`Overloaded`, ``serve.shed_deadline`` counter) when the
+  queue's projected service time already exceeds a newcomer's
+  deadline.
 
 Quickstart::
 
@@ -67,6 +84,7 @@ Quickstart::
     engine.close()                         # graceful drain
 """
 
+from .autotune import ServingAutotuner, autotune_enabled, derive_buckets
 from .bank import ParameterBank
 from .batcher import (
     BankedBatcher,
@@ -82,6 +100,7 @@ from .procfleet import ProcessReplicaSet
 from .quantize import SERVE_DTYPES
 from .registry import ModelEntry, ModelRegistry
 from .replicaset import AllReplicasUnhealthy, ReplicaSet
+from .shm import ShmRing, shm_enabled
 from .stats import ServingStats
 
 __all__ = [
@@ -101,4 +120,9 @@ __all__ = [
     "DeadlineExceeded",
     "CircuitOpen",
     "shape_buckets",
+    "ShmRing",
+    "shm_enabled",
+    "ServingAutotuner",
+    "autotune_enabled",
+    "derive_buckets",
 ]

@@ -1,0 +1,279 @@
+"""
+Telemetry-driven bucket auto-tuning: close the loop from the request
+histograms :class:`~skdist_tpu.serve.stats.ServingStats` already
+records back into the batcher geometry it feeds.
+
+The static ladder (``shape_buckets``) is a prior — doubling rungs from
+the mesh's task-slot floor to the HBM/max-rows cap — chosen before a
+single request arrived. Real traffic is rarely shaped like the prior:
+a fleet serving 96-row requests over a ladder anchored at 8 pads every
+flush up to 128, burning 25% of its device work on zeros. The tuner
+re-derives the ladder from the OBSERVED p50/p95 request sizes:
+
+- **unbanked entries**: a new bucket ladder anchored at the observed
+  p50 (rounded up to the task-slot floor), doubling to the ORIGINAL
+  cap, with a p95 rung spliced in. The cap is always kept, so no
+  request that was admissible before the swap becomes inadmissible
+  after it.
+- **banked entries**: ``rows_per_slot`` re-proposed as the power of
+  two nearest below p50 — the slot ladder's policy knob — then the
+  bank restacks and the shared :class:`BankedBatcher` re-stamps its
+  queue (``retune``).
+
+Every swap is **prewarm-before-swap**: the candidate geometry's
+programs are AOT-compiled through the existing tier (``prewarm`` /
+``ParameterBank._rebuild``) *before* the batcher atomically cuts over,
+so the swap never causes a steady-state compile — the wirespeed
+smoke's ``compiles_after_warmup == 0`` gate holds straight through a
+mid-load retune.
+
+Stability comes from **bounded hysteresis**: a new anchor within
+``hysteresis``× of the last applied one is ignored, and swaps are
+rate-limited per target (``min_swap_interval_s``) — traffic oscillating
+around a rung boundary must not make the ladder thrash.
+
+``SKDIST_SERVE_AUTOTUNE=0`` is the kill switch: the tuner still runs
+its loop but every pass is a no-op (cheap, and flipping the env var
+back re-enables without a restart).
+"""
+
+import os
+import threading
+import time
+
+from ..obs import metrics as obs_metrics
+from ..parallel import faults
+from .batcher import BankedBatcher
+
+__all__ = ["ServingAutotuner", "autotune_enabled", "derive_buckets",
+           "AUTOTUNE_ENV"]
+
+#: the kill switch (``=0`` disables every tuning pass)
+AUTOTUNE_ENV = "SKDIST_SERVE_AUTOTUNE"
+
+
+def autotune_enabled():
+    """Autotuning is ON by default; ``SKDIST_SERVE_AUTOTUNE=0``
+    freezes every ladder at its current geometry."""
+    return os.environ.get(AUTOTUNE_ENV, "").strip().lower() not in (
+        "0", "false", "no",
+    )
+
+
+def _round_up(n, multiple):
+    n = max(1, int(n))
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def derive_buckets(p50, p95, floor, cap):
+    """The ladder an observed (p50, p95) request-size pair wants:
+    anchored at p50 rounded up to ``floor`` (the task-slot count — the
+    prewarm path's ``bucket // n_slots`` must stay exact), doubling to
+    ``cap``, with a p95 rung spliced in and ``cap`` ALWAYS included so
+    nothing admissible under the old ladder is shed by the new one."""
+    floor = max(1, int(floor))
+    cap = max(floor, int(cap))
+    anchor = min(cap, _round_up(p50, floor))
+    rungs = {cap}
+    b = anchor
+    while b < cap:
+        rungs.add(b)
+        b *= 2
+    rungs.add(min(cap, _round_up(p95, floor)))
+    return sorted(rungs)
+
+
+def _pow2_at_most(n):
+    n = max(1, int(n))
+    return 1 << (n.bit_length() - 1)
+
+
+class ServingAutotuner:
+    """The feedback loop over one :class:`ServingEngine` (module
+    docstring). ``start()`` runs periodic passes on a daemon thread;
+    ``tune_now()`` is one synchronous pass (what the procfleet
+    ``autotune`` op calls on each replica)."""
+
+    def __init__(self, engine, interval_s=5.0, hysteresis=1.5,
+                 min_swap_interval_s=10.0, min_samples=32):
+        self.engine = engine
+        self.interval_s = None if interval_s is None else float(interval_s)
+        self.hysteresis = max(1.0, float(hysteresis))
+        self.min_swap_interval_s = float(min_swap_interval_s)
+        self.min_samples = int(min_samples)
+        self._state = {}   # target key -> {"anchor": int, "t": float}
+        self._passes = 0
+        self._swaps = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self):
+        if self.interval_s is None or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="skdist-serve-autotune",
+        )
+        self._thread.start()
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tune_now()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                faults.logger.exception("autotune pass failed")
+
+    def stats(self):
+        with self._lock:
+            return {
+                "enabled": autotune_enabled(),
+                "interval_s": self.interval_s,
+                "passes": self._passes,
+                "swaps": self._swaps,
+            }
+
+    # ------------------------------------------------------------------
+    # the pass
+    # ------------------------------------------------------------------
+    def tune_now(self):
+        """One tuning pass; returns what it did (and why it skipped
+        what it skipped) — the procfleet surfaces this per replica."""
+        with self._lock:
+            self._passes += 1
+        if not autotune_enabled():
+            return {"enabled": False, "swapped": []}
+        eng = self.engine
+        sstats = eng._stats
+        sizes = sstats.request_rows_window()
+        if len(sizes) < self.min_samples:
+            return {"enabled": True, "swapped": [],
+                    "reason": f"{len(sizes)}/{self.min_samples} samples"}
+        p50 = sstats.request_rows_percentile(0.5)
+        p95 = sstats.request_rows_percentile(0.95)
+        with eng._lock:
+            batchers = dict(eng._batchers)
+        swapped = []
+        for key, b in batchers.items():
+            try:
+                if isinstance(b, BankedBatcher):
+                    did = self._tune_banked(key, b, p50)
+                else:
+                    did = self._tune_unbanked(key, b, p50, p95)
+            except Exception:  # noqa: BLE001 - one sick target must
+                faults.logger.exception(   # not freeze the others
+                    "autotune swap for %s failed", key,
+                )
+                continue
+            if did:
+                swapped.append(did)
+        if swapped:
+            sstats.mark_warm()
+        return {"enabled": True, "p50": p50, "p95": p95,
+                "swapped": swapped}
+
+    def _allow(self, key, anchor):
+        """Bounded hysteresis + per-target swap rate limit."""
+        st = self._state.get(key)
+        now = time.monotonic()
+        if st is not None:
+            if now - st["t"] < self.min_swap_interval_s:
+                return False
+            lo = st["anchor"] / self.hysteresis
+            hi = st["anchor"] * self.hysteresis
+            if lo <= anchor <= hi:
+                return False
+        return True
+
+    def _mark(self, key, anchor):
+        self._state[key] = {"anchor": int(anchor),
+                            "t": time.monotonic()}
+        with self._lock:
+            self._swaps += 1
+        self.engine._stats._bound_child("serve.autotune_swaps").inc()
+
+    def _tune_unbanked(self, key, b, p50, p95):
+        """Re-derive one MicroBatcher's ladder; prewarm the candidate
+        programs through the registry's AOT tier, THEN atomically swap
+        the ladder under the batcher's lock."""
+        if not getattr(b, "_pad", False):
+            return None  # host-fallback batcher: no shape programs
+        name, version, method = key
+        try:
+            entry = self.engine.registry.get(name, version)
+        except KeyError:
+            return None  # unregistered under us
+        path = entry.methods.get(method)
+        if path is None or path.batched is None:
+            return None
+        floor = path.batched.n_task_slots
+        cap = b.max_rows
+        new = derive_buckets(p50, p95, floor, cap)
+        if new == sorted(b.buckets):
+            return None
+        if not self._allow(key, new[0]):
+            return None
+        # prewarm-before-swap: the candidate rungs compile through the
+        # same cache the register-time prewarm used — rungs the ladder
+        # already had are cache hits, new ones compile NOW, off the
+        # request path
+        with obs_metrics.compile_scope(self.engine._stats.scope):
+            self.engine.registry._prewarm_paths(
+                entry.methods, new, entry.n_features,
+            )
+        try:
+            old = b.retune(new)
+        except ValueError:
+            return None  # queued work wouldn't fit the new cap: skip
+        entry.buckets = list(new)
+        self._mark(key, new[0])
+        return {"target": f"{entry.spec}.{method}",
+                "buckets": new, "was": sorted(old)}
+
+    def _tune_banked(self, key, b, p50):
+        """Re-propose a bank's ``rows_per_slot`` (power of two nearest
+        below p50). The bank's ``retune`` restacks + prewarms the next
+        generation BEFORE its atomic swap; the shared batcher then
+        re-stamps its queue to the new geometry. A batcher refusal
+        (queued request no longer fits) reverts the bank."""
+        bank = b.bank
+        old_r = bank.rows_per_slot
+        new_r = _pow2_at_most(p50)
+        if new_r == old_r:
+            return None
+        if not self._allow(key, new_r):
+            return None
+        with obs_metrics.compile_scope(self.engine._stats.scope):
+            if not bank.retune(new_r):
+                return None
+        try:
+            b.retune(slot_buckets=None, rows_per_slot=new_r)
+        except ValueError:
+            with obs_metrics.compile_scope(self.engine._stats.scope):
+                bank.retune(old_r)
+            return None
+        # refresh every co-tenant entry's row ladder (future batcher
+        # rebuilds and stats read it)
+        reg = self.engine.registry
+        row_buckets = bank.row_buckets()
+        for nm in reg.names():
+            for v in reg.versions(nm):
+                try:
+                    e = reg.get(nm, v)
+                except KeyError:
+                    continue
+                if getattr(e, "bank", None) is bank:
+                    e.buckets = row_buckets
+        self._mark(key, new_r)
+        return {"target": f"{bank.name}.{key[2]}",
+                "rows_per_slot": new_r, "was": old_r}

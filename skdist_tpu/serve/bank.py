@@ -280,6 +280,35 @@ class ParameterBank:
                 return 0
             return self._prewarm_gen(gen)
 
+    def retune(self, rows_per_slot):
+        """Autotune's geometry swap: adopt a new ``rows_per_slot``,
+        recompute the per-method structural jit keys (the compiled
+        program family is per-geometry), and rebuild + PREWARM the
+        generation before the atomic swap — re-tuning never compiles on
+        the request path. The slot ladder is unchanged (its top rung
+        times the new ``rows_per_slot`` is the new row cap). Note the
+        bank's grouping ``key`` keeps recording the geometry it was
+        CREATED with — re-keying live banks would orphan the engine's
+        batcher map; ``rows_per_slot`` is the live value. Returns True
+        when the geometry actually changed."""
+        r = int(rows_per_slot)
+        if r < 1:
+            raise ValueError(f"rows_per_slot must be >= 1; got {r}")
+        with self._lock:
+            if r == self.rows_per_slot:
+                return False
+            self.rows_per_slot = r
+            self._jit_keys = {
+                m: compile_cache.structural_key(
+                    "predict_banked", p.cls, p.which, p.static,
+                    p.meta_sig, p.serve_dtype, r,
+                )
+                for m, p in self._ref_plans.items()
+            }
+            if self._members:
+                self._rebuild("retune")
+            return True
+
     def stats(self):
         with self._lock:
             return {

@@ -282,43 +282,105 @@ class MicroBatcher:
 
     def _collect(self):
         """Block until a flush is due (queued units >= largest bucket,
-        oldest request aged out, or shutdown), then pop the FIFO prefix
-        that fits the largest bucket. Returns (None, 0) when stopped
-        with an empty queue."""
+        oldest request aged out, or shutdown), then pop the prefix that
+        fits the largest bucket. Deadline-free queues board FIFO;
+        as soon as ANY queued request carries a deadline the flush
+        assembles earliest-deadline-first (SLO scheduling: the request
+        closest to its deadline must not wait behind later-deadline
+        arrivals that happened to enqueue sooner). Returns (None, 0)
+        when stopped with an empty queue."""
         with self._cond:
             while not self._queue:
                 if self._stop:
                     return None, 0
                 self._cond.wait(0.1)
-            deadline = self._queue[0].enq_t + self.max_delay_s
+            flush_at = self._queue[0].enq_t + self.max_delay_s
             while self._queued_units < self.max_units and not self._stop:
-                remaining = deadline - time.monotonic()
+                remaining = flush_at - time.monotonic()
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
             batch, units = [], 0
-            while self._queue:
-                u = self._units(self._queue[0])
-                if units + u > self.max_units:
-                    break
-                req = self._queue.popleft()
-                self._queued_units -= u
-                batch.append(req)
-                units += u
-            if not batch and self._queue:
+            if (len(self._queue) > 1
+                    and any(q.deadline is not None for q in self._queue)):
+                # EDF boarding: sort by (deadline, enqueue) — requests
+                # without a deadline board last, FIFO among themselves
+                order = sorted(
+                    self._queue,
+                    key=lambda q: (
+                        q.deadline if q.deadline is not None
+                        else float("inf"),
+                        q.enq_t,
+                    ),
+                )
+                for req in order:
+                    u = self._units(req)
+                    if units + u > self.max_units:
+                        break
+                    batch.append(req)
+                    units += u
+                if batch:
+                    taken = {id(req) for req in batch}
+                    self._queue = deque(
+                        q for q in self._queue if id(q) not in taken
+                    )
+                    self._queued_units -= units
+                head = order[0]
+            else:
+                while self._queue:
+                    u = self._units(self._queue[0])
+                    if units + u > self.max_units:
+                        break
+                    req = self._queue.popleft()
+                    self._queued_units -= u
+                    batch.append(req)
+                    units += u
+                head = self._queue[0] if self._queue else None
+            if not batch and head is not None:
                 # an unfittable head request (n > max_rows — the engine
                 # rejects these at submit; this is the backstop) must be
                 # failed and popped, or the loop would hot-spin on it
                 # and head-of-line-block everything behind it forever
-                req = self._queue.popleft()
-                self._queued_units -= self._units(req)
-                _complete(req.future, exc=ServingError(
-                    f"request of {req.n} rows can never fit the largest "
-                    f"bucket ({self.max_rows})"
-                ))
+                try:
+                    self._queue.remove(head)
+                except ValueError:  # pragma: no cover - head just left
+                    pass
+                else:
+                    self._queued_units -= self._units(head)
+                    _complete(head.future, exc=ServingError(
+                        f"request of {head.n} rows can never fit the "
+                        f"largest bucket ({self.max_rows})"
+                    ))
             if self.stats is not None:
                 self.stats.set_queue_depth(len(self._queue), key=self.name)
             return batch, units
+
+    def retune(self, buckets):
+        """Atomic bucket-ladder cutover (the autotuner's swap step).
+        This only moves pointers — the caller must have ALREADY
+        compiled/prewarmed every new rung (prewarm-before-swap), or
+        the next flush compiles on the request path. Refuses a ladder
+        whose cap would strand already-queued work (admitted requests
+        must stay servable across a swap). Returns the old ladder."""
+        buckets = sorted({int(b) for b in buckets})
+        if not buckets or buckets[0] < 1:
+            raise ValueError(
+                f"retune wants a non-empty positive ladder; got {buckets}"
+            )
+        with self._cond:
+            need = max((self._units(q) for q in self._queue), default=0)
+            if buckets[-1] < need:
+                raise ValueError(
+                    f"retune cap {buckets[-1]} is below queued work "
+                    f"({need} units) — admitted requests must stay "
+                    "servable"
+                )
+            old = self.buckets
+            self.buckets = buckets
+            self.max_rows = buckets[-1]
+            self.max_units = self._max_units()
+            self._cond.notify_all()
+        return old
 
     def _flush(self, batch, rows):
         now = time.monotonic()
@@ -342,7 +404,13 @@ class MicroBatcher:
         X = (live[0].X if len(live) == 1
              else np.concatenate([r.X for r in live], axis=0))
         if self._pad:
-            bucket = self.bucket_for(live_rows)
+            try:
+                bucket = self.bucket_for(live_rows)
+            except ValueError as exc:
+                # a ladder swap shrank the cap under an in-assembly
+                # batch: fail typed, never kill the dispatch loop
+                self._fail(live, exc)
+                return
             if bucket > live_rows:
                 pad_block = np.zeros(
                     (bucket - live_rows,) + X.shape[1:], X.dtype
@@ -473,6 +541,49 @@ class BankedBatcher(MicroBatcher):
             f"({self.slot_buckets[-1]})"
         )
 
+    def retune(self, slot_buckets=None, rows_per_slot=None):
+        """Atomic geometry cutover for the banked flush: a new
+        ``rows_per_slot`` (and/or slot ladder) takes effect for every
+        FUTURE flush — queued requests are re-accounted in the new
+        slot unit under the same lock, so the units ledger stays
+        consistent with what :meth:`_collect` will subtract. The
+        caller must have already rebuilt+prewarmed the bank's programs
+        for the new geometry (``ParameterBank.retune``). Refuses a
+        geometry that would strand queued work."""
+        with self._cond:
+            new_r = (self.rows_per_slot if rows_per_slot is None
+                     else int(rows_per_slot))
+            if new_r < 1:
+                raise ValueError(
+                    f"rows_per_slot must be >= 1; got {rows_per_slot}"
+                )
+            if slot_buckets is None:
+                new_sb = list(self.slot_buckets)
+            else:
+                new_sb = sorted({int(s) for s in slot_buckets})
+            if not new_sb or new_sb[0] < 1:
+                raise ValueError(
+                    f"retune wants a positive slot ladder; got {new_sb}"
+                )
+            need = max((q.n for q in self._queue), default=0)
+            if new_sb[-1] * new_r < need:
+                raise ValueError(
+                    f"retune capacity {new_sb[-1]}x{new_r} rows is below "
+                    f"a queued {need}-row request — admitted work must "
+                    "stay servable"
+                )
+            old = (list(self.slot_buckets), self.rows_per_slot)
+            self.rows_per_slot = new_r
+            self.slot_buckets = new_sb
+            self.buckets = [s * new_r for s in new_sb]
+            self.max_rows = self.buckets[-1]
+            self.max_units = self._max_units()
+            for q in self._queue:
+                q.n_slots = -(-q.n // new_r)
+            self._queued_units = sum(q.n_slots for q in self._queue)
+            self._cond.notify_all()
+        return old
+
     def _flush(self, batch, units):
         now = time.monotonic()
         live = []
@@ -506,10 +617,41 @@ class BankedBatcher(MicroBatcher):
         live = routed
         if not live:
             return
-        live_slots = sum(r.n_slots for r in live)
-        live_rows = sum(r.n for r in live)
-        S = self.slot_bucket_for(live_slots)
+        # the flush's geometry snapshot: one read each of the (possibly
+        # just-retuned) rows_per_slot and ladder; slot counts are
+        # RE-DERIVED from it so a retune between enqueue and flush is
+        # transparent (the ledger already re-accounted the queue)
         r = self.rows_per_slot
+        sb = self.slot_buckets
+        fits = []
+        for req in live:
+            k = -(-req.n // r)
+            if k > sb[-1]:
+                _complete(req.future, exc=ServingError(
+                    f"request of {req.n} rows no longer fits the bank's "
+                    f"retuned geometry ({sb[-1]}x{r} rows)"
+                ))
+                if self.stats is not None:
+                    self.stats.record_rejection("error")
+                continue
+            req.n_slots = k
+            fits.append(req)
+        live = fits
+        while live:
+            live_slots = sum(q.n_slots for q in live)
+            S = next((s for s in sb if s >= live_slots), None)
+            if S is not None:
+                break
+            # a shrink mid-assembly: the batch boarded under the old
+            # geometry — push the newest request back to the queue head
+            # instead of failing admitted work
+            back = live.pop()
+            with self._cond:
+                self._queue.appendleft(back)
+                self._queued_units += back.n_slots
+        if not live:
+            return
+        live_rows = sum(q.n for q in live)
         d = self.bank.n_features
         X = np.zeros((S, r, d), np.float32)
         tid = np.zeros((S,), np.int32)
@@ -553,7 +695,9 @@ class BankedBatcher(MicroBatcher):
                 tenants=len({req.spec for req in live}),
             )
         out = np.asarray(out)
-        r = self.rows_per_slot
+        # the flush's rows_per_slot travels WITH the tensor (axis 1) —
+        # a retune between launch and gather must not re-slice it
+        r = out.shape[1]
         trailing = out.shape[2:]
         for req in live:
             s, k = req.slot_start, req.n_slots

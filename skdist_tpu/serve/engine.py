@@ -95,7 +95,8 @@ class ServingEngine:
                  breaker_threshold=3, breaker_cooldown_s=30.0,
                  bank_models=None, bank_rows_per_slot=None,
                  max_queue_depth_per_tenant=None,
-                 fleet_rollup_only=None, max_model_splits=None):
+                 fleet_rollup_only=None, max_model_splits=None,
+                 autotune_interval_s=None):
         """Multi-tenant knobs on top of the classic ones:
         ``bank_models``/``bank_rows_per_slot`` configure the registry's
         parameter banking (``serve.bank``; default: the
@@ -105,7 +106,13 @@ class ServingEngine:
         of a banked catalog cannot starve its co-tenants' queue budget
         (None = engine-wide bound only); ``fleet_rollup_only`` /
         ``max_model_splits`` are the stats cardinality guards
-        (``serve.stats.ServingStats``)."""
+        (``serve.stats.ServingStats``).
+
+        ``autotune_interval_s`` starts the telemetry-driven bucket
+        autotuner (``serve.autotune``) on a background thread with
+        that period; ``None`` (default) leaves it off — one-shot
+        passes stay available through :meth:`autotune_now`, and
+        ``SKDIST_SERVE_AUTOTUNE=0`` kills both."""
         self.registry = registry if registry is not None else ModelRegistry(
             backend=backend, max_batch_rows=max_batch_rows,
             buckets=buckets, bank_models=bank_models,
@@ -149,13 +156,22 @@ class ServingEngine:
         self._tenant_lock = threading.Lock()
         self._lock = threading.Lock()
         self._closed = False
+        self._autotuner = None
+        if autotune_interval_s is not None:
+            from .autotune import ServingAutotuner
+
+            self._autotuner = ServingAutotuner(
+                self, interval_s=autotune_interval_s,
+            )
+            self._autotuner.start()
 
     # ------------------------------------------------------------------
     # registration
     # ------------------------------------------------------------------
     def register(self, name, model, methods=("predict",), version=None,
                  prewarm=True, serve_dtype="float32",
-                 quant_parity_bound=None, bank=None):
+                 quant_parity_bound=None, bank=None,
+                 bank_rows_per_slot=None):
         """Register (and prewarm) a fitted model; returns its entry.
         ``serve_dtype`` selects the stored-parameter precision tier
         (see ``ModelRegistry.register`` — int8/bf16 entries are
@@ -172,6 +188,7 @@ class ServingEngine:
                 name, model, methods=methods, version=version,
                 prewarm=prewarm, serve_dtype=serve_dtype,
                 quant_parity_bound=quant_parity_bound, bank=bank,
+                bank_rows_per_slot=bank_rows_per_slot,
             )
         if prewarm:
             self._stats.mark_warm()
@@ -250,6 +267,23 @@ class ServingEngine:
             )
         serve_dtype = getattr(entry, "serve_dtype", "float32")
         model_spec = entry.spec
+        timeout_s = (self.default_timeout_s if timeout_s is None
+                     else timeout_s)
+        if timeout_s is not None:
+            # shed-before-queue: when the queue's PROJECTED service
+            # time (observed completion rate x queued depth) already
+            # exceeds this request's deadline, queueing it only buys a
+            # guaranteed DeadlineExceeded at flush time — reject NOW,
+            # typed, while the caller can still retry elsewhere. No
+            # trustworthy rate (cold start, idle gap) leaves the gate
+            # open: admission control fails toward serving.
+            wait = self._stats.projected_wait_s(self.queue_depth())
+            if wait is not None and wait > timeout_s:
+                self._stats.record_rejection("shed_deadline")
+                raise Overloaded(
+                    f"projected queue wait {wait:.3f}s already exceeds "
+                    f"the {timeout_s}s deadline (shed before queue)"
+                )
         tenant_bound = self.max_queue_depth_per_tenant
         if tenant_bound is not None:
             # the per-tenant admission slice: a chatty tenant hits ITS
@@ -265,8 +299,6 @@ class ServingEngine:
                         f"={tenant_bound}; other tenants are unaffected"
                     )
                 self._tenant_pending[model_spec] = cur + 1
-        timeout_s = (self.default_timeout_s if timeout_s is None
-                     else timeout_s)
         enq_t = time.monotonic()
         # `is not None`, not truthiness: an explicit timeout_s=0
         # means "already due" (rejected at the next flush), not
@@ -288,7 +320,7 @@ class ServingEngine:
         # the flush that serves it can parent under the router's span
         req.trace_ctx = obs_trace.current_context()
         self._stats.record_submitted(serve_dtype=serve_dtype,
-                                     model=model_spec)
+                                     model=model_spec, rows=n)
         stats = self._stats
 
         def _done(fut):
@@ -372,7 +404,20 @@ class ServingEngine:
             out["max_queue_depth_per_tenant"] = (
                 self.max_queue_depth_per_tenant
             )
+        if self._autotuner is not None:
+            out["autotune"] = self._autotuner.stats()
         return out
+
+    def autotune_now(self):
+        """One synchronous bucket-autotune pass (``serve.autotune``) —
+        also what the procfleet ``autotune`` op runs on each replica.
+        Lazily builds a one-shot tuner when none is running
+        periodically."""
+        if self._autotuner is None:
+            from .autotune import ServingAutotuner
+
+            self._autotuner = ServingAutotuner(self, interval_s=None)
+        return self._autotuner.tune_now()
 
     @property
     def closed(self):
@@ -391,6 +436,8 @@ class ServingEngine:
     def close(self, drain=True, timeout=30.0):
         """Stop admissions; drain (default) or fail queued requests;
         join dispatch threads. Idempotent."""
+        if self._autotuner is not None:
+            self._autotuner.stop()
         with self._lock:
             self._closed = True
             batchers = list(self._batchers.values())

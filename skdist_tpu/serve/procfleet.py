@@ -102,6 +102,8 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FutureTimeout
 
+import numpy as np
+
 from ..obs import export as obs_export
 from ..obs import flightrec as obs_flightrec
 from ..obs import httpd as obs_httpd
@@ -116,6 +118,7 @@ from .batcher import (
     ServingError,
 )
 from .replicaset import AllReplicasUnhealthy, fleet_by_model
+from .shm import DEFAULT_SLOT_BYTES, DEFAULT_SLOTS, ShmRing, shm_enabled
 
 __all__ = [
     "ProcessReplicaSet",
@@ -141,6 +144,27 @@ def harvest_enabled():
     return os.environ.get("SKDIST_OBS_HARVEST", "").strip().lower() not in (
         "0", "false", "no",
     )
+
+
+#: HELP lines for the supervisor-side transport families — pinned by
+#: the obs conformance tests so the fleet exposition self-documents
+_TRANSPORT_HELP = {
+    "serve.shm_bytes": "payload bytes carried over shared-memory ring "
+                       "slots instead of pickled frames",
+    "serve.shm_fallbacks": "requests that wanted the ring but fell back "
+                           "to a pickled frame (ring full, payload over "
+                           "slot_bytes, or a pickled reply)",
+    "serve.frames_pickled": "request round trips whose payload rode the "
+                            "classic pickled frame (no ring, fallback, "
+                            "or non-numeric payload)",
+}
+
+
+def _transport_counter(name):
+    return obs_metrics.registry().counter(
+        name, help=_TRANSPORT_HELP.get(name, "")
+    )
+
 
 # ---------------------------------------------------------------------------
 # wire protocol: length-prefixed pickled frames
@@ -197,17 +221,27 @@ def send_frame(sock, obj):
 def recv_frame(sock):
     """Read one frame; raises :class:`WireError` on EOF mid-frame, an
     oversized length prefix, or an undecodable payload."""
+    return recv_frame_timed(sock)[0]
+
+
+def recv_frame_timed(sock):
+    """:func:`recv_frame` plus the TRANSPORT seconds it spent: the
+    body read + unpickle AFTER the 4-byte header arrived. The header
+    wait is the peer's compute time, deliberately excluded — this is
+    what the wirespeed smoke's transport-overhead gate measures."""
     (n,) = _FRAME_HEADER.unpack(_recv_exact(sock, _FRAME_HEADER.size))
     if n > MAX_FRAME_BYTES:
         raise WireError(
             f"frame length {n} exceeds the {MAX_FRAME_BYTES}-byte bound "
             "(corrupted header?)"
         )
+    t0 = time.perf_counter()
     payload = _recv_exact(sock, n)
     try:
-        return pickle.loads(payload)
+        obj = pickle.loads(payload)
     except Exception as exc:
         raise WireError(f"undecodable frame: {exc!r}") from exc
+    return obj, time.perf_counter() - t0
 
 
 def _recv_exact(sock, n):
@@ -294,11 +328,24 @@ class _ClientPool:
         """One RPC round trip. Returns the reply value or raises the
         decoded typed exception; transport failures raise
         :class:`ReplicaConnectionError`."""
+        reply, _wire_s = self.request_raw(op, payload, timeout_s)
+        if reply.get("ok"):
+            return reply.get("value")
+        raise decode_error(reply)
+
+    def request_raw(self, op, payload, timeout_s):
+        """One round trip returning ``(reply_dict, wire_seconds)`` —
+        the RAW reply frame (the shm data plane routes on its ``shm``
+        key before any value decode) plus the transport seconds spent
+        serializing/sending the request and reading/decoding the reply
+        body (the peer's compute wait excluded)."""
         conn = self._get()
         try:
             conn.settimeout(timeout_s)
+            t0 = time.perf_counter()
             send_frame(conn, (op, payload))
-            reply = recv_frame(conn)
+            send_s = time.perf_counter() - t0
+            reply, recv_s = recv_frame_timed(conn)
         except (OSError, WireError, EOFError) as exc:
             try:
                 conn.close()
@@ -312,9 +359,7 @@ class _ClientPool:
             raise ReplicaConnectionError(
                 f"replica RPC {op!r} returned a non-reply frame"
             )
-        if reply.get("ok"):
-            return reply.get("value")
-        raise decode_error(reply)
+        return reply, send_s + recv_s
 
     def close(self):
         with self._lock:
@@ -341,7 +386,7 @@ class _ProcReplica:
         "respawn_due_at", "death_reason", "intentional_stop",
         "flightrec_path", "telemetry_state", "telemetry_pid",
         "telemetry_compiles", "telemetry_stale", "trace_part",
-        "flightrec_events",
+        "flightrec_events", "ring",
     )
 
     def __init__(self, index):
@@ -379,6 +424,11 @@ class _ProcReplica:
         self.telemetry_stale = True
         self.trace_part = None
         self.flightrec_events = None
+        #: the shared-memory data plane of the CURRENT generation
+        #: (supervisor-owned ``serve.shm.ShmRing``); fresh per spawn,
+        #: closed+unlinked by the supervisor on every death — a
+        #: SIGKILLed worker can never leak /dev/shm
+        self.ring = None
 
     @property
     def pid(self):
@@ -410,7 +460,8 @@ class ProcessReplicaSet:
                  spawn_timeout_s=120.0, drain_timeout_s=15.0,
                  request_timeout_s=60.0, unhealthy_wait_s=30.0,
                  harvest_interval_s=2.0, obs_port=None,
-                 incident_dir=None):
+                 incident_dir=None, shm_slots=DEFAULT_SLOTS,
+                 shm_slot_bytes=DEFAULT_SLOT_BYTES):
         """Observability knobs on top of the fault-domain ones:
         ``harvest_interval_s`` paces the supervisor's periodic
         ``telemetry`` harvest (``SKDIST_OBS_HARVEST=0`` disables it;
@@ -419,7 +470,13 @@ class ProcessReplicaSet:
         opts into the ops endpoint; ``incident_dir`` overrides where
         incident files land (default ``SKDIST_FLIGHTREC_DIR`` /
         ``<tmp>/skdist-flightrec`` — deliberately OUTSIDE the fleet's
-        socket tempdir, which is removed on close)."""
+        socket tempdir, which is removed on close).
+
+        ``shm_slots`` × ``shm_slot_bytes`` size each replica's
+        shared-memory ring (``serve.shm`` — the zero-copy data plane;
+        the socket then carries only doorbell frames). ``shm_slots=0``
+        — or ``SKDIST_SHM=0`` — disables the ring: every payload rides
+        classic pickled frames."""
         if int(n_replicas) < 1:
             raise ValueError(f"n_replicas must be >= 1; got {n_replicas}")
         # resolve (and validate) the ops port BEFORE any worker spawns:
@@ -445,6 +502,13 @@ class ProcessReplicaSet:
         self.unhealthy_wait_s = float(unhealthy_wait_s)
         self.harvest_interval_s = float(harvest_interval_s)
         self.incident_dir = incident_dir
+        self.shm_slots = int(shm_slots)
+        self.shm_slot_bytes = int(shm_slot_bytes)
+        #: per-means transport overhead ledger: mean seconds of
+        #: serialize/send + reply read/decode + ring memcpys per
+        #: request, split by which plane carried the payload —
+        #: ``stats()["transport"]`` and the wirespeed smoke's >=5x gate
+        self._transport = {"shm": [0, 0.0], "pickle": [0, 0.0]}
 
         self._dir = tempfile.mkdtemp(prefix="skpf-")
         self._lock = threading.Lock()
@@ -531,6 +595,9 @@ class ProcessReplicaSet:
             # (set_enabled) — the spawn carries the decision so the
             # worker's track isn't empty in the stitched fleet trace
             "trace": bool(obs_trace.enabled()),
+            # the attach recipe for THIS generation's ring (None =
+            # pickled frames only); the worker maps it, never owns it
+            "shm": r.ring.describe() if r.ring is not None else None,
         })
         if self._worker_argv is not None:
             return list(self._worker_argv(r.index, sock_path, cfg))
@@ -546,6 +613,14 @@ class ProcessReplicaSet:
             self._dir, f"r{r.index}g{r.generation}.sock"
         )
         r.log_path = os.path.join(self._dir, f"r{r.index}.log")
+        # fresh ring per generation, created BEFORE the argv so the
+        # config carries its attach recipe; any previous generation's
+        # ring dies here even if the death path missed it
+        if r.ring is not None:
+            r.ring.close()
+            r.ring = None
+        if self.shm_slots > 0 and shm_enabled():
+            r.ring = ShmRing.create(self.shm_slots, self.shm_slot_bytes)
         env = dict(os.environ)
         # the ops endpoint is the SUPERVISOR's: an inherited
         # SKDIST_OBS_PORT would have every worker fight it (and each
@@ -786,7 +861,7 @@ class ProcessReplicaSet:
                 ):
                     if traced:
                         payload["_trace"] = obs_trace.current_context()
-                    out = r.pool.request("request", payload, rpc_timeout)
+                    out = self._request_on(r, payload, rpc_timeout)
                 with self._lock:
                     r.failures = 0
                 return out
@@ -797,6 +872,90 @@ class ProcessReplicaSet:
             finally:
                 with self._lock:
                     r.in_flight -= 1
+
+    def _request_on(self, r, payload, rpc_timeout):
+        """One ``request`` RPC on one replica, riding the shm data
+        plane when it can (module docstring: the socket is then only
+        the doorbell). The fallback matrix is counted, never an error:
+
+        ======================  =======================================
+        condition               payload rides
+        ======================  =======================================
+        ring attached + fits    shm slot (descriptor on the doorbell)
+        ring full               pickled frame (+``serve.shm_fallbacks``)
+        payload > slot_bytes    pickled frame (+fallback counter)
+        non-numeric payload     pickled frame
+        no ring / SKDIST_SHM=0  pickled frame
+        reply too big for slot  shm out, pickled reply (+fallback)
+        ======================  =======================================
+
+        Transport overhead — serialize/send + reply read/decode + the
+        two ring memcpys — is accumulated per plane in
+        ``self._transport`` (the wirespeed smoke's >=5x gate)."""
+        ring = r.ring
+        X = payload.get("X")
+        slot = None
+        used_shm = False
+        shm_s = 0.0
+        if (ring is not None and isinstance(X, np.ndarray)
+                and X.dtype.kind in "fiub" and not X.dtype.hasobject):
+            if ring.fits(X.nbytes):
+                slot = ring.acquire()
+                if slot is None:
+                    # ring full: more in-flight requests than slots —
+                    # counted, and this one rides the classic frame
+                    _transport_counter("serve.shm_fallbacks").inc()
+            else:
+                # oversized payload: routed around the ring, counted
+                _transport_counter("serve.shm_fallbacks").inc()
+        try:
+            if slot is not None:
+                t0 = time.perf_counter()
+                desc = ring.write(slot, X)
+                shm_s += time.perf_counter() - t0
+                payload = {k: v for k, v in payload.items() if k != "X"}
+                payload["shm"] = desc
+                used_shm = True
+            reply, wire_s = r.pool.request_raw(
+                "request", payload, rpc_timeout
+            )
+            if not reply.get("ok"):
+                raise decode_error(reply)
+            out_desc = reply.get("shm")
+            if out_desc is not None:
+                if slot is None:
+                    raise ReplicaConnectionError(
+                        "replica sent an shm reply to a pickled request"
+                    )
+                t0 = time.perf_counter()
+                out = ring.read(out_desc)
+                shm_s += time.perf_counter() - t0
+                _transport_counter("serve.shm_bytes").inc(
+                    int(X.nbytes) + int(out.nbytes)
+                )
+            else:
+                out = reply.get("value")
+                _transport_counter("serve.frames_pickled").inc()
+                if used_shm:
+                    # rows went over the ring but the reply came back
+                    # pickled (result outgrew the slot / non-numeric)
+                    _transport_counter("serve.shm_fallbacks").inc()
+            plane = "shm" if (used_shm and out_desc is not None) \
+                else "pickle"
+            with self._lock:
+                ent = self._transport[plane]
+                ent[0] += 1
+                ent[1] += wire_s + shm_s
+            return out
+        finally:
+            if slot is not None:
+                ring.release(slot)
+            if ring is not None:
+                obs_metrics.registry().gauge(
+                    "serve.shm_ring_occupancy",
+                    help="claimed ring slots per replica at the last "
+                         "routed request",
+                ).set(ring.occupancy(), replica=str(r.index))
 
     def _pick(self, exclude=()):
         """Least-loaded live replica not yet tried: parent-side
@@ -947,6 +1106,15 @@ class ProcessReplicaSet:
         """Crash-loop accounting + respawn scheduling (also the landing
         path for failed spawns)."""
         now = time.monotonic()
+        # the ring dies with its generation, HERE in the supervisor:
+        # the worker may have been SIGKILLed mid-ring-write and can
+        # free nothing. Occupancy is read first — the incident file
+        # records how many slots were claimed at the moment of death.
+        ring_occ = None
+        if r.ring is not None:
+            ring_occ = r.ring.occupancy()
+            r.ring.close()
+            r.ring = None
         with self._lock:
             r.alive = False
             r.draining = False
@@ -981,10 +1149,12 @@ class ProcessReplicaSet:
         # standing snapshot (written by its autodump thread — the only
         # telemetry a SIGKILLed process leaves behind)
         self._dump_replica_incident(
-            r, "crash_loop_park" if r.parked else "replica_death", reason
+            r, "crash_loop_park" if r.parked else "replica_death", reason,
+            ring_occupancy=ring_occ,
         )
 
-    def _dump_replica_incident(self, r, kind, reason):
+    def _dump_replica_incident(self, r, kind, reason,
+                               ring_occupancy=None):
         worker_snap = None
         try:
             if r.flightrec_path and os.path.exists(r.flightrec_path):
@@ -1003,6 +1173,9 @@ class ProcessReplicaSet:
                 "pid": r.pid,
                 "death_reason": str(reason),
                 "worker_flightrec": worker_snap,
+                # claimed shm slots at the moment of death: >0 means
+                # the worker died with requests in flight over the ring
+                "ring_occupancy": ring_occupancy,
             },
         )
         if path is not None:
@@ -1144,6 +1317,9 @@ class ProcessReplicaSet:
                     pass  # unkillable: abandon (childproc contract)
         if r.pool is not None:
             r.pool.close()
+        if r.ring is not None:
+            r.ring.close()  # owner close: unmap + unlink /dev/shm
+            r.ring = None
         return r
 
     def rolling_restart(self):
@@ -1185,6 +1361,12 @@ class ProcessReplicaSet:
                                       timeout=timeout)
                 except Exception as exc:
                     faults.log_suppressed("ProcessReplicaSet.close", exc)
+        for r in self._replicas:
+            # belt and braces: any ring the per-replica stop paths
+            # missed (never-spawned replica, racing death) unlinks here
+            if r.ring is not None:
+                r.ring.close()
+                r.ring = None
         self._executor.shutdown(wait=False)
         self._respawn_exec.shutdown(wait=False)
         import shutil
@@ -1405,7 +1587,41 @@ class ProcessReplicaSet:
                 for r in replicas
             },
         }
+        with self._lock:
+            tr = {k: list(v) for k, v in self._transport.items()}
+        out["transport"] = {
+            "enabled": self.shm_slots > 0 and shm_enabled(),
+            "shm_requests": tr["shm"][0],
+            "pickle_requests": tr["pickle"][0],
+            "shm_mean_overhead_s": (tr["shm"][1] / tr["shm"][0]
+                                    if tr["shm"][0] else None),
+            "pickle_mean_overhead_s": (tr["pickle"][1] / tr["pickle"][0]
+                                       if tr["pickle"][0] else None),
+        }
         return out
+
+    def autotune_now(self):
+        """Fan one synchronous autotune pass (``serve.autotune``) to
+        every routable replica; returns the per-replica results. The
+        mid-load ladder swap the wirespeed smoke drives — each worker
+        prewarms its candidate geometry before its atomic cutover, so
+        in-flight traffic never sees a compile."""
+        results = {}
+        for r in list(self._replicas):
+            if not r.alive or r.draining or r.pool is None:
+                continue
+            try:
+                results[r.index] = r.pool.request(
+                    "autotune", {}, self.spawn_timeout_s,
+                )
+            except Exception as exc:
+                faults.log_suppressed("ProcessReplicaSet.autotune", exc)
+                results[r.index] = {"error": repr(exc)}
+        self._event("autotune", None,
+                    swapped=sum(len(v.get("swapped", []))
+                                for v in results.values()
+                                if isinstance(v, dict)))
+        return results
 
     def replica(self, index):
         return self._replicas[int(index)]

@@ -97,6 +97,7 @@ def _dispatch(engine, state, op, payload):
             methods=tuple(payload.get("methods") or ("predict",)),
             version=payload.get("version"),
             serve_dtype=payload.get("serve_dtype", "float32"),
+            bank_rows_per_slot=payload.get("bank_rows_per_slot"),
         )
         return {"version": entry.version, "spec": entry.spec}
     if op == "unregister":
@@ -111,12 +112,30 @@ def _dispatch(engine, state, op, payload):
             raise ServingError("worker is draining (engine closed soon)")
         from skdist_tpu.obs import trace as obs_trace
 
+        desc = payload.get("shm")
+        if desc is not None:
+            # zero-copy ingest: the rows are a numpy view DIRECTLY over
+            # the ring slot the doorbell frame names; the engine's
+            # float32-contiguous normalisation of an already-f32 view
+            # is a no-op. The supervisor holds the slot until our reply
+            # lands, so the view outlives the flush that consumes it.
+            ring = state.get("ring")
+            if ring is None:
+                raise ValueError(
+                    "request carries an shm descriptor but this worker "
+                    "has no ring attached"
+                )
+            X = ring.view(desc)  # hostile/torn desc -> ValueError
+        else:
+            X = payload["X"]
         with obs_trace.use_context(payload.get("_trace")):
             return engine.predict(
-                payload["X"], model=payload.get("model"),
+                X, model=payload.get("model"),
                 method=payload.get("method", "predict"),
                 timeout_s=payload.get("timeout_s"),
             )
+    if op == "autotune":
+        return engine.autotune_now()
     if op == "stats":
         return engine.stats()
     if op == "telemetry":
@@ -151,6 +170,32 @@ def _dispatch(engine, state, op, payload):
     raise ValueError(f"unknown op {op!r}")
 
 
+def _shm_reply(state, payload, value):
+    """Write a raw-numeric result back into the SAME ring slot its
+    request arrived in and return the reply descriptor — the reply
+    frame then carries ``{"ok": True, "shm": desc}`` instead of the
+    pickled rows. ``None`` means "ride the classic pickled reply":
+    no ring, request came in pickled, non-numeric result, or the
+    result outgrows the slot. Never an error — degradation is the
+    fallback matrix's job, not the connection's."""
+    ring = state.get("ring")
+    if ring is None or not isinstance(payload, dict):
+        return None
+    desc = payload.get("shm")
+    if not isinstance(desc, dict):
+        return None
+    import numpy as np
+
+    if (not isinstance(value, np.ndarray) or value.dtype.hasobject
+            or value.dtype.kind not in "fiub"
+            or not ring.fits(value.nbytes)):
+        return None
+    try:
+        return ring.write(desc["slot"], value)
+    except (ValueError, TypeError):
+        return None
+
+
 def _serve_conn(engine, state, conn):
     from .procfleet import (
         FrameTooLarge, WireError, encode_error, recv_frame, send_frame,
@@ -167,8 +212,12 @@ def _serve_conn(engine, state, conn):
                         or not isinstance(frame[0], str)):
                     raise ValueError("malformed frame: want (op, payload)")
                 op, payload = frame
-                reply = {"ok": True,
-                         "value": _dispatch(engine, state, op, payload)}
+                value = _dispatch(engine, state, op, payload)
+                out_desc = (_shm_reply(state, payload, value)
+                            if op == "request" else None)
+                reply = ({"ok": True, "shm": out_desc}
+                         if out_desc is not None
+                         else {"ok": True, "value": value})
             except Exception as exc:  # noqa: BLE001 - crosses the wire
                 reply = encode_error(exc)
             try:
@@ -185,9 +234,11 @@ def _serve_conn(engine, state, conn):
                 return
 
 
-def serve_forever(engine, sock_path):
+def serve_forever(engine, sock_path, ring=None):
     """Bind the front door and serve until SIGTERM / ``drain``; then
-    stop admissions, drain the engine, exit 0."""
+    stop admissions, drain the engine, exit 0. ``ring`` is the
+    attached shared-memory data plane (``serve.shm.ShmRing``, worker
+    side) or ``None`` for pickled-frames-only serving."""
     listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     try:
         os.unlink(sock_path)
@@ -216,7 +267,7 @@ def serve_forever(engine, sock_path):
         except OSError:
             pass
 
-    state = {"draining": draining, "shutdown": shutdown}
+    state = {"draining": draining, "shutdown": shutdown, "ring": ring}
     signal.signal(signal.SIGTERM, lambda signum, frame: shutdown())
     while not draining.is_set():
         try:
@@ -228,6 +279,8 @@ def serve_forever(engine, sock_path):
             daemon=True, name="skdist-procworker-conn",
         ).start()
     engine.close(drain=True)
+    if ring is not None:
+        ring.close()  # unmap only: the SUPERVISOR owns the unlink
     try:
         os.unlink(sock_path)
     except FileNotFoundError:
@@ -271,7 +324,15 @@ def main(argv=None):
         # the supervisor's incident harvest (SIGTERM additionally dumps
         # synchronously inside serve_forever's shutdown path)
         rec.start_autodump(cfg["flightrec"])
-    return serve_forever(engine, args.socket)
+    ring = None
+    if cfg.get("shm"):
+        from skdist_tpu.serve.shm import ShmRing
+
+        try:
+            ring = ShmRing.attach(**cfg["shm"])
+        except Exception:  # noqa: BLE001 - a missing/raced segment
+            ring = None    # degrades to pickled frames, never aborts
+    return serve_forever(engine, args.socket, ring=ring)
 
 
 if __name__ == "__main__":

@@ -208,7 +208,8 @@ class ModelRegistry:
     # ------------------------------------------------------------------
     def register(self, name, model, methods=("predict",), version=None,
                  prewarm=None, serve_dtype="float32",
-                 quant_parity_bound=None, bank=None):
+                 quant_parity_bound=None, bank=None,
+                 bank_rows_per_slot=None):
         """Validate, stage, prewarm, and store; returns the entry.
 
         ``serve_dtype`` selects the stored-parameter precision tier
@@ -233,6 +234,14 @@ class ModelRegistry:
         prewarm + atomic generation swap, the other tenants still
         serving) → publish; a staging failure burns the reserved
         version number but publishes nothing.
+
+        ``bank_rows_per_slot`` overrides the registry-wide rows-per-
+        slot geometry for THIS model's bank: models that share a
+        rows_per_slot (and plan structure) share a bank, so the value
+        is part of the grouping key. It is validated against the
+        registry's capacity ladder — a rows_per_slot larger than
+        ``max_batch_rows`` could never fill a single slot and is
+        refused at registration rather than discovered at serve time.
         """
         check_is_fitted(model)
         if serve_dtype not in SERVE_DTYPES:
@@ -291,6 +300,7 @@ class ModelRegistry:
             return self._register_banked(
                 name, model, version, plans, serve_dtype,
                 quant_error, params_nbytes, do_prewarm,
+                rows_per_slot=bank_rows_per_slot,
             )
 
         paths = {}
@@ -328,7 +338,8 @@ class ModelRegistry:
     # banked registration
     # ------------------------------------------------------------------
     def _register_banked(self, name, model, version, plans, serve_dtype,
-                         quant_error, params_nbytes, do_prewarm):
+                         quant_error, params_nbytes, do_prewarm,
+                         rows_per_slot=None):
         """The tenant-banked publish: the version is reserved FIRST (so
         the spec — ``name@version`` — can join its bank before routing
         sees it), the bank stages + prewarms + swaps its next
@@ -339,7 +350,7 @@ class ModelRegistry:
             version = self._reserve_version_locked(name, version)
         spec = f"{name}@{version}"
         with self._banks_lock:
-            bank = self._bank_for(plans)
+            bank = self._bank_for(plans, rows_per_slot)
             bank.add_member(spec, plans, prewarm=do_prewarm)
         paths = {
             m: _MethodPath(model, m, plan=plan, bank=bank)
@@ -376,27 +387,40 @@ class ModelRegistry:
         assigned.add(version)
         return version
 
-    def _bank_for(self, plans):
+    def _bank_for(self, plans, rows_per_slot=None):
         """Resolve (or create) the bank a plans set belongs to. Caller
-        holds ``_banks_lock``."""
-        key = bank_group_key(plans, self.bank_rows_per_slot)
+        holds ``_banks_lock``. ``rows_per_slot`` defaults to the
+        registry-wide geometry; a per-model override is validated
+        against the capacity ladder here, once, so every bank the
+        registry ever creates can actually fill a batch."""
+        r = self.bank_rows_per_slot if rows_per_slot is None \
+            else int(rows_per_slot)
+        max_rows = self.max_batch_rows or _DEFAULT_MAX_BATCH_ROWS
+        if r < 1 or r > max_rows:
+            raise ValueError(
+                f"bank_rows_per_slot={r} falls outside the capacity "
+                f"ladder [1, {max_rows}] (max_batch_rows caps a single "
+                "slot's rows)"
+            )
+        key = bank_group_key(plans, r)
         bank = self._banks.get(key)
         if bank is None:
             bank = ParameterBank(
                 key, f"bank{self._bank_seq}", self.backend, plans,
-                self.bank_rows_per_slot,
-                self._bank_slot_buckets(plans),
+                r,
+                self._bank_slot_buckets(plans, r),
             )
             self._banks[key] = bank
             self._bank_seq += 1
         return bank
 
-    def _bank_slot_buckets(self, plans):
+    def _bank_slot_buckets(self, plans, rows_per_slot=None):
         """The slot-count ladder of a new bank: the row ladder's policy
         (doubling, floored at the mesh task slots) applied to SLOTS,
         with the HBM cap billed per slot (``rows_per_slot`` input rows
         + widest output rows + the tid scalar)."""
-        r = self.bank_rows_per_slot
+        r = (self.bank_rows_per_slot if rows_per_slot is None
+             else int(rows_per_slot))
         d = max(int(p.n_features) for p in plans.values())
         out_w = max(int(p.out_width) for p in plans.values())
         n_slots = getattr(self.backend, "n_task_slots", 1)

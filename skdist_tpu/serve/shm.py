@@ -1,0 +1,292 @@
+"""
+Shared-memory slot rings: the zero-copy data plane under
+:class:`~skdist_tpu.serve.procfleet.ProcessReplicaSet`.
+
+Every request to a process replica used to pay a full
+``pickle.dumps``/``loads`` round trip of its numpy payload over the
+unix socket. With a ring attached, the socket carries only a tiny
+doorbell frame — op, model id, and a slot descriptor ``{"slot",
+"shape", "dtype"}`` — while the rows themselves live in a fixed-slot
+shared-memory segment both processes map:
+
+- the SUPERVISOR owns the segment (``create``): it acquires a free
+  slot, memcpys the request rows in (the one bounded copy on the
+  caller side), and ships the descriptor instead of the array;
+- the WORKER attaches (``attach``) and builds a numpy view DIRECTLY
+  over the slot — no copy on the ingest path; the engine's
+  ``ascontiguousarray(float32)`` of an already-f32-contiguous view is
+  a no-op;
+- the worker writes the result back into the SAME slot when it fits
+  and replies with a descriptor; the supervisor copies it out and
+  releases the slot. One slot therefore serves exactly one request
+  round trip — the refcount is the slot state byte.
+
+Ownership is the leak-proofing: the supervisor creates AND unlinks
+every segment, so a replica SIGKILLed mid-ring-write can never leak
+``/dev/shm`` — its ring dies with the supervisor's ``close``/respawn
+bookkeeping, and a fresh generation gets a fresh ring. The worker only
+ever maps and unmaps. (On Python < 3.13 an *attach* still registers
+the segment with ``multiprocessing.resource_tracker``, whose cleanup
+would unlink the supervisor's live segment when the worker exits —
+bpo-38119; :meth:`ShmRing.attach` unregisters it again.)
+
+Degradation is never an error: ring full, payload over ``slot_bytes``,
+non-numeric dtype, or ``SKDIST_SHM=0`` all fall back to the classic
+pickled frame (counted by ``serve.shm_fallbacks`` /
+``serve.frames_pickled``). A torn or hostile descriptor arriving at
+:meth:`view` raises ``ValueError`` — a request-owned typed verdict
+that crosses the wire like any other, never an out-of-bounds read.
+
+Segment layout (``slots`` state bytes, then the slot data)::
+
+    +---------------------+-----------+-----------+-----+-----------+
+    | state[0..slots)     | slot 0    | slot 1    | ... | slot S-1  |
+    | 1 byte each: 0=free | slot_bytes| slot_bytes|     | slot_bytes|
+    +---------------------+-----------+-----------+-----+-----------+
+"""
+
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["ShmRing", "shm_enabled", "DEFAULT_SLOTS", "DEFAULT_SLOT_BYTES"]
+
+#: default ring geometry per (supervisor, replica) pair — 8 in-flight
+#: requests of up to 1 MiB of rows each before the pickle fallback
+DEFAULT_SLOTS = 8
+DEFAULT_SLOT_BYTES = 1 << 20
+
+#: dtype kinds a descriptor may name: float/int/uint/bool covers every
+#: serving payload (f32 rows, int8 quantized rows, int predictions);
+#: object/str/void dtypes never cross the ring (pickle fallback)
+_RING_DTYPE_KINDS = "fiub"
+#: a descriptor naming more dimensions than any sane tensor is torn
+_MAX_NDIM = 8
+
+#: segment names CREATED by this process: an attach to one of these is
+#: a same-process attach (tests, in-process mixed clients), where the
+#: bpo-38119 unregister below would instead corrupt the owner's own
+#: resource-tracker entry
+_OWNED_IN_PROCESS = set()
+
+
+def shm_enabled():
+    """The shared-memory data plane is ON by default; ``SKDIST_SHM=0``
+    is the kill switch (every payload then rides pickled frames, which
+    is also the wirespeed smoke's baseline leg)."""
+    return os.environ.get("SKDIST_SHM", "").strip().lower() not in (
+        "0", "false", "no",
+    )
+
+
+class ShmRing:
+    """One fixed-slot shared-memory ring (module docstring).
+
+    The supervisor side (``create``) owns the free-list and the
+    segment's lifetime; the worker side (``attach``) only maps it and
+    reads/writes slots named by descriptors it was handed. The state
+    bytes live in the segment so BOTH sides (and the incident file)
+    can read occupancy.
+    """
+
+    def __init__(self, seg, slots, slot_bytes, owner):
+        self._seg = seg
+        self.name = seg.name
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        self.owner = bool(owner)
+        self._lock = threading.Lock()
+        self._free = list(range(self.slots)) if owner else None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @classmethod
+    def create(cls, slots=DEFAULT_SLOTS, slot_bytes=DEFAULT_SLOT_BYTES):
+        """Supervisor side: create (and own) a fresh segment."""
+        from multiprocessing import shared_memory
+
+        slots = int(slots)
+        slot_bytes = int(slot_bytes)
+        if slots < 1 or slot_bytes < 1:
+            raise ValueError(
+                f"ring wants slots >= 1 and slot_bytes >= 1; got "
+                f"{slots} x {slot_bytes}"
+            )
+        seg = shared_memory.SharedMemory(
+            create=True, size=slots + slots * slot_bytes
+        )
+        seg.buf[:slots] = bytes(slots)  # all slots start free
+        _OWNED_IN_PROCESS.add(seg.name)
+        return cls(seg, slots, slot_bytes, owner=True)
+
+    @classmethod
+    def attach(cls, name, slots, slot_bytes):
+        """Worker side: map the supervisor's segment by name. The
+        worker never unlinks — only the owner's close() does — so it
+        must undo the resource tracker's attach-side registration
+        (bpo-38119: the tracker would otherwise unlink the LIVE
+        segment out from under the supervisor when this process
+        exits)."""
+        from multiprocessing import resource_tracker, shared_memory
+
+        seg = shared_memory.SharedMemory(name=name)
+        if seg.name not in _OWNED_IN_PROCESS:
+            try:
+                resource_tracker.unregister(seg._name, "shared_memory")
+            except Exception:  # noqa: BLE001 - exotic runtimes
+                pass
+        return cls(seg, slots, slot_bytes, owner=False)
+
+    def describe(self):
+        """The JSON-able attach recipe the spawn config ships."""
+        return {"name": self.name, "slots": self.slots,
+                "slot_bytes": self.slot_bytes}
+
+    # ------------------------------------------------------------------
+    # slot lifecycle (owner side)
+    # ------------------------------------------------------------------
+    def acquire(self):
+        """Claim a free slot; ``None`` when the ring is full (the
+        caller falls back to a pickled frame — never an error)."""
+        with self._lock:
+            if self._closed or not self._free:
+                return None
+            slot = self._free.pop()
+            self._seg.buf[slot] = 1
+        return slot
+
+    def release(self, slot):
+        """Return a slot to the free-list (reply consumed, or any
+        error after acquire). Idempotent per round trip by
+        construction: the caller releases exactly once, in a
+        ``finally``."""
+        with self._lock:
+            if self._closed:
+                return
+            self._seg.buf[slot] = 0
+            self._free.append(slot)
+
+    def occupancy(self):
+        """Slots currently claimed — read from the segment's state
+        bytes, so both sides (and the post-mortem incident file) see
+        the same number."""
+        with self._lock:
+            if self._closed:
+                return 0
+            return sum(self._seg.buf[:self.slots])
+
+    # ------------------------------------------------------------------
+    # data plane
+    # ------------------------------------------------------------------
+    def fits(self, nbytes):
+        return 0 <= int(nbytes) <= self.slot_bytes
+
+    def write(self, slot, arr):
+        """Copy ``arr`` into ``slot`` (the one bounded memcpy) and
+        return its wire descriptor."""
+        arr = np.ascontiguousarray(arr)
+        desc = {"slot": int(slot), "shape": tuple(arr.shape),
+                "dtype": arr.dtype.str}
+        off, dt, shape = self._validate(desc)
+        dst = np.ndarray(shape, dtype=dt, buffer=self._seg.buf, offset=off)
+        dst[...] = arr
+        return desc
+
+    def view(self, desc):
+        """A numpy view DIRECTLY over the slot a descriptor names —
+        the zero-copy ingest path. Hostile/torn descriptors raise
+        ``ValueError`` (request-owned, typed over the wire); nothing a
+        descriptor says can read outside its own slot."""
+        off, dt, shape = self._validate(desc)
+        return np.ndarray(shape, dtype=dt, buffer=self._seg.buf, offset=off)
+
+    def read(self, desc):
+        """Copy a slot's tensor out (caller side: the slot is about to
+        be released, so the result must not alias the ring)."""
+        return np.array(self.view(desc), copy=True)
+
+    def _validate(self, desc):
+        """The fuzz surface: every field of a descriptor is checked
+        against the ring geometry before any pointer math happens."""
+        if self._closed:
+            raise ValueError("shm ring is closed")
+        if not isinstance(desc, dict):
+            raise ValueError(
+                f"shm descriptor must be a dict; got {type(desc).__name__}"
+            )
+        slot = desc.get("slot")
+        if not isinstance(slot, int) or isinstance(slot, bool) \
+                or not (0 <= slot < self.slots):
+            raise ValueError(
+                f"shm descriptor slot {slot!r} outside ring "
+                f"[0, {self.slots})"
+            )
+        shape = desc.get("shape")
+        if (not isinstance(shape, (tuple, list))
+                or len(shape) > _MAX_NDIM
+                or not all(isinstance(d, int) and not isinstance(d, bool)
+                           and d >= 0 for d in shape)):
+            raise ValueError(f"shm descriptor shape {shape!r} is malformed")
+        try:
+            dt = np.dtype(desc.get("dtype"))
+        except Exception as exc:
+            raise ValueError(
+                f"shm descriptor dtype {desc.get('dtype')!r}: {exc}"
+            ) from exc
+        if dt.kind not in _RING_DTYPE_KINDS or dt.hasobject:
+            raise ValueError(
+                f"shm descriptor dtype {dt.str!r} is not a raw numeric "
+                "dtype (object payloads ride pickled frames)"
+            )
+        n = 1
+        for d in shape:
+            n *= d  # python ints: no overflow games with huge dims
+        nbytes = n * dt.itemsize
+        if nbytes > self.slot_bytes:
+            raise ValueError(
+                f"shm descriptor names {nbytes} bytes but slots hold "
+                f"{self.slot_bytes}"
+            )
+        return self.slots + slot * self.slot_bytes, dt, tuple(shape)
+
+    # ------------------------------------------------------------------
+    # teardown
+    # ------------------------------------------------------------------
+    def close(self, unlink=None):
+        """Unmap (and, on the owner, unlink) the segment. The unlink
+        always runs for the owner even if live views pin the mapping —
+        removing the name is what prevents the /dev/shm leak; the
+        pages themselves die with the last mapper."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._free = []
+        if unlink is None:
+            unlink = self.owner
+        try:
+            self._seg.close()
+        except BufferError:
+            # a still-referenced view pins the mapping; the unlink
+            # below is what matters for leak-proofing
+            pass
+        except OSError:
+            pass
+        if unlink:
+            try:
+                self._seg.unlink()
+            except FileNotFoundError:
+                pass
+            except OSError:
+                pass
+            _OWNED_IN_PROCESS.discard(self.name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -35,6 +35,7 @@ multi-tenant serving item:
 import itertools
 import os
 import threading
+import time
 from collections import deque
 
 from ..obs import metrics as obs_metrics
@@ -59,6 +60,32 @@ _DEFAULT_MODEL_SPLITS = 512
 #: seconds — the default latency ladder would collapse everything into
 #: the +Inf bucket)
 _TENANTS_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+#: request-size histogram ladder (rows per request, not seconds)
+_REQ_ROWS_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                     2048, 4096)
+
+#: completion timestamps kept for the service-rate estimator (the
+#: shed-before-queue gate's denominator)
+_RATE_MARKS = 256
+
+#: HELP lines for the serving families this module registers lazily
+#: via :meth:`ServingStats._bound_child` — first registration wins in
+#: the registry, and the fleet exposition's ``# HELP`` conformance
+#: test pins these exact strings surviving the telemetry merge
+_FAMILY_HELP = {
+    "serve.shed_deadline": (
+        "requests shed at admission because the queue's projected "
+        "service time already exceeded their deadline"
+    ),
+    "serve.autotune_swaps": (
+        "bucket-ladder / rows_per_slot retunes applied after "
+        "prewarm-before-swap"
+    ),
+    "serve.request_rows": (
+        "rows per submitted request (the autotuner's input histogram)"
+    ),
+}
 
 
 class ServingStats:
@@ -123,7 +150,14 @@ class ServingStats:
         self._rejected_overload = 0
         self._rejected_deadline = 0
         self._rejected_circuit = 0
+        self._rejected_shed = 0
         self._dispatch_errors = 0
+        #: rolling request sizes (rows) — the autotuner reads exact
+        #: p50/p95 from this ring; the registry-side histogram carries
+        #: the same signal across the process boundary
+        self._req_rows = deque(maxlen=window)
+        #: completion wall marks for the service-rate estimator
+        self._done_marks = deque(maxlen=_RATE_MARKS)
         self._queue_depths = {}  # per-batcher gauges; snapshot sums
         self._warm_scoped = None
 
@@ -150,12 +184,13 @@ class ServingStats:
         key = (family,) + tuple(sorted(extra.items()))
         b = self._bound.get(key)
         if b is None:
+            help_ = _FAMILY_HELP.get(family, "")
             if metric_kind == "histogram":
-                fam = obs_metrics.histogram(family)
+                fam = obs_metrics.histogram(family, help=help_)
             elif metric_kind == "gauge":
-                fam = obs_metrics.gauge(family)
+                fam = obs_metrics.gauge(family, help=help_)
             else:
-                fam = obs_metrics.counter(family)
+                fam = obs_metrics.counter(family, help=help_)
             b = fam.child(**self._reg_labels(**extra))
             with self._lock:
                 b = self._bound.setdefault(key, b)
@@ -210,9 +245,11 @@ class ServingStats:
                 r = self._bound.setdefault(key, r)
         return r
 
-    def record_submitted(self, serve_dtype=None, model=None):
+    def record_submitted(self, serve_dtype=None, model=None, rows=None):
         with self._lock:
             self._requests += 1
+            if rows is not None:
+                self._req_rows.append(int(rows))
             if serve_dtype is not None:
                 self._cell(self._by_dtype, serve_dtype)["requests"] += 1
             if model is not None:
@@ -220,12 +257,19 @@ class ServingStats:
                 if cell is not None:
                     cell["requests"] += 1
         self._route(model, serve_dtype)[0].inc()
+        if rows is not None:
+            obs_metrics.histogram(
+                "serve.request_rows",
+                help=_FAMILY_HELP["serve.request_rows"],
+                buckets=_REQ_ROWS_BUCKETS,
+            ).observe(int(rows), **self._reg_labels())
 
     def record_completed(self, latency_s, serve_dtype=None, model=None):
         latency_s = float(latency_s)
         with self._lock:
             self._completed += 1
             self._lat.append(latency_s)
+            self._done_marks.append(time.monotonic())
             if serve_dtype is not None:
                 cell = self._cell(self._by_dtype, serve_dtype)
                 cell["completed"] += 1
@@ -250,9 +294,15 @@ class ServingStats:
                 # NOT count as a dispatch error (the alerting signal
                 # for real device failures)
                 self._rejected_circuit += 1
+            elif kind == "shed_deadline":
+                # admission-gate shed: the queue's projected service
+                # time already exceeded the newcomer's deadline
+                self._rejected_shed += 1
             else:
                 self._dispatch_errors += 1
         self._bound_child("serve.rejections", kind=str(kind)).inc()
+        if kind == "shed_deadline":
+            self._bound_child("serve.shed_deadline").inc()
 
     def record_flush(self, rows, bucket, tenants=None):
         """``tenants`` (banked flushes) is how many DISTINCT models the
@@ -298,6 +348,48 @@ class ServingStats:
         check reads this instead of polling every batcher's lock."""
         with self._lock:
             return sum(self._queue_depths.values())
+
+    # ------------------------------------------------------------------
+    # autotune / shed-gate feeds
+    # ------------------------------------------------------------------
+    def request_rows_window(self):
+        """The rolling request sizes (rows per request) — the
+        autotuner's exact-percentile input."""
+        with self._lock:
+            return list(self._req_rows)
+
+    def request_rows_percentile(self, q):
+        with self._lock:
+            rows = sorted(self._req_rows)
+        return self._percentile(rows, q)
+
+    def completion_rate(self):
+        """Recent request completions per second, or None while the
+        window is too thin (cold start) or stale (the last completion
+        is older than the window it was measured over) — the shed gate
+        must not act on a rate it cannot trust."""
+        with self._lock:
+            marks = list(self._done_marks)
+        if len(marks) < 8:
+            return None
+        span = marks[-1] - marks[0]
+        if span <= 0:
+            return None
+        if time.monotonic() - marks[-1] > max(1.0, span):
+            return None
+        return (len(marks) - 1) / span
+
+    def projected_wait_s(self, queued):
+        """Expected time for ``queued`` requests to drain at the
+        recent service rate; None when no trustworthy rate exists
+        (then the shed gate stays open — admission control must fail
+        toward serving)."""
+        if queued <= 0:
+            return 0.0
+        rate = self.completion_rate()
+        if not rate:
+            return None
+        return queued / rate
 
     def mark_warm(self):
         """Snapshot this engine's scoped compile-miss counter;
@@ -362,6 +454,7 @@ class ServingStats:
                 "rejected_overloaded": self._rejected_overload,
                 "rejected_deadline": self._rejected_deadline,
                 "rejected_circuit": self._rejected_circuit,
+                "rejected_shed_deadline": self._rejected_shed,
                 "dispatch_errors": self._dispatch_errors,
                 "rows_served": self._rows_served,
                 "batch_fill_ratio": (
@@ -370,6 +463,14 @@ class ServingStats:
                 ),
                 "bucket_hits": dict(sorted(self._bucket_hits.items())),
             }
+            req_rows = sorted(self._req_rows)
+        if req_rows:
+            out["request_rows"] = {
+                "p50": self._percentile(req_rows, 0.50),
+                "p95": self._percentile(req_rows, 0.95),
+                "samples": len(req_rows),
+            }
+        with self._lock:
             if self._tenants_per_flush:
                 out["tenants_per_flush"] = dict(
                     sorted(self._tenants_per_flush.items())

@@ -1,0 +1,347 @@
+"""
+Telemetry-driven bucket autotuning + SLO-aware scheduling (PR 16):
+``derive_buckets`` ladder properties, hysteresis/rate-limit bounds,
+the MicroBatcher's atomic ``retune`` cutover and earliest-deadline-
+first flush assembly, the shed-before-queue admission gate, the
+registry's per-model ``bank_rows_per_slot`` validation, and one
+end-to-end ``autotune_now`` swap on a real engine.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from skdist_tpu.models import LogisticRegression
+from skdist_tpu.obs import metrics as obs_metrics
+from skdist_tpu.serve import (
+    MicroBatcher,
+    Overloaded,
+    ServingEngine,
+    ServingStats,
+    autotune_enabled,
+    derive_buckets,
+)
+from skdist_tpu.serve.autotune import ServingAutotuner, _pow2_at_most
+from skdist_tpu.serve.batcher import DeadlineExceeded, _Request
+
+
+# ---------------------------------------------------------------------------
+# derive_buckets: the ladder the observed traffic wants
+# ---------------------------------------------------------------------------
+
+def test_derive_buckets_anchors_at_observed_p50():
+    # 96-row traffic on an 8-slot mesh with a 1024 cap: anchored at 96,
+    # doubling, p95 rung spliced, cap kept
+    assert derive_buckets(96, 200, 8, 1024) == [96, 192, 200, 384, 768,
+                                                1024]
+
+
+def test_derive_buckets_floors_tiny_traffic_at_task_slots():
+    # sub-slot requests can't anchor below the mesh floor (the prewarm
+    # path's bucket // n_slots must stay exact)
+    assert derive_buckets(3, 3, 8, 64) == [8, 16, 32, 64]
+    for b in derive_buckets(5, 40, 6, 96):
+        assert b % 6 == 0 or b == 96
+
+
+def test_derive_buckets_always_keeps_the_cap():
+    # nothing admissible under the old ladder may be shed by the new
+    # one — the cap survives every derivation
+    for p50, p95 in ((1, 1), (100, 5000), (5000, 6000)):
+        assert derive_buckets(p50, p95, 8, 256)[-1] == 256
+    # p50 past the cap collapses to a single max-rows rung
+    assert derive_buckets(5000, 6000, 8, 256) == [256]
+
+
+def test_pow2_at_most():
+    assert _pow2_at_most(1) == 1
+    assert _pow2_at_most(96) == 64
+    assert _pow2_at_most(128) == 128
+    assert _pow2_at_most(0) == 1  # floor at 1, never 0 rows per slot
+
+
+def test_autotune_kill_switch(monkeypatch):
+    monkeypatch.delenv("SKDIST_SERVE_AUTOTUNE", raising=False)
+    assert autotune_enabled()
+    monkeypatch.setenv("SKDIST_SERVE_AUTOTUNE", "0")
+    assert not autotune_enabled()
+    # a disabled pass is a cheap no-op, not an error
+    tuner = ServingAutotuner(engine=None, interval_s=None)
+    assert tuner.tune_now() == {"enabled": False, "swapped": []}
+
+
+# ---------------------------------------------------------------------------
+# hysteresis + swap rate limit
+# ---------------------------------------------------------------------------
+
+def test_hysteresis_band_and_rate_limit():
+    tuner = ServingAutotuner(engine=None, interval_s=None,
+                             hysteresis=1.5, min_swap_interval_s=10.0)
+    key = ("m", 1, "predict")
+    assert tuner._allow(key, 96)  # no prior state: first swap allowed
+    tuner._state[key] = {"anchor": 96, "t": time.monotonic()}
+    # inside the rate-limit window NOTHING is allowed, however far off
+    assert not tuner._allow(key, 960)
+    # age the state past the window: the hysteresis band takes over
+    tuner._state[key]["t"] = time.monotonic() - 100.0
+    assert not tuner._allow(key, 96)      # identical anchor
+    assert not tuner._allow(key, 128)     # within 1.5x: oscillation
+    assert not tuner._allow(key, 64)      # within 1/1.5x
+    assert tuner._allow(key, 192)         # 2x: a real shift
+    assert tuner._allow(key, 32)          # 1/3x: a real shift
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher: EDF flush assembly + atomic retune
+# ---------------------------------------------------------------------------
+
+def _row_request(value, deadline=None):
+    x = np.full((1, 4), float(value), dtype=np.float32)
+    return _Request(x, 1, Future(), deadline=deadline)
+
+
+def test_flush_assembles_earliest_deadline_first():
+    seen = []
+
+    def dispatch(X):
+        seen.append(np.asarray(X)[:, 0].tolist())
+        return np.asarray(X)
+
+    b = MicroBatcher(dispatch, buckets=[8], max_delay_s=0.15, pad=False)
+    try:
+        now = time.monotonic()
+        # enqueue order 0,1,2,3 — deadlines demand 1,3,0 then the
+        # deadline-free 2 boards last
+        reqs = [_row_request(0, deadline=now + 30.0),
+                _row_request(1, deadline=now + 5.0),
+                _row_request(2),
+                _row_request(3, deadline=now + 10.0)]
+        with b._cond:  # enqueue atomically so one flush sees all four
+            for r in reqs:
+                b._queue.append(r)
+                b._queued_units += 1
+            b._cond.notify()
+        for r in reqs:
+            np.testing.assert_array_equal(r.future.result(timeout=10),
+                                          r.X)
+        assert seen[0] == [1.0, 3.0, 0.0, 2.0]
+    finally:
+        b.close()
+
+
+def test_flush_boards_fifo_without_deadlines():
+    seen = []
+
+    def dispatch(X):
+        seen.append(np.asarray(X)[:, 0].tolist())
+        return np.asarray(X)
+
+    b = MicroBatcher(dispatch, buckets=[4], max_delay_s=0.1, pad=False)
+    try:
+        reqs = [_row_request(i) for i in range(4)]
+        with b._cond:
+            for r in reqs:
+                b._queue.append(r)
+                b._queued_units += 1
+            b._cond.notify()
+        for r in reqs:
+            r.future.result(timeout=10)
+        assert seen[0] == [0.0, 1.0, 2.0, 3.0]
+    finally:
+        b.close()
+
+
+def test_past_deadline_work_is_rejected_not_dispatched():
+    b = MicroBatcher(lambda X: np.asarray(X), buckets=[4],
+                     max_delay_s=0.01, pad=False)
+    try:
+        req = _row_request(1, deadline=time.monotonic() - 0.5)
+        b.submit(req)
+        with pytest.raises(DeadlineExceeded):
+            req.future.result(timeout=10)
+    finally:
+        b.close()
+
+
+def test_retune_swaps_ladder_atomically():
+    b = MicroBatcher(lambda X: np.asarray(X), buckets=[8, 16],
+                     max_delay_s=5.0, pad=False)
+    try:
+        old = b.retune([4, 16, 32])
+        assert old == [8, 16]
+        assert b.buckets == [4, 16, 32]
+        assert b.max_rows == 32 and b.max_units == 32
+        with pytest.raises(ValueError, match="positive ladder"):
+            b.retune([])
+        with pytest.raises(ValueError, match="positive ladder"):
+            b.retune([0, 8])
+    finally:
+        b.close()
+
+
+def test_retune_refuses_to_strand_queued_work():
+    """Admitted requests must stay servable across a swap: a cap below
+    a queued request's rows is refused (the autotuner skips, never
+    sheds)."""
+    release = threading.Event()
+
+    def dispatch(X):
+        release.wait(10)
+        return np.asarray(X)
+
+    b = MicroBatcher(dispatch, buckets=[8, 16], max_delay_s=30.0,
+                     pad=False)
+    try:
+        req = _Request(np.zeros((12, 4), np.float32), 12, Future())
+        b.submit(req)
+        with pytest.raises(ValueError, match="12"):
+            b.retune([8])
+        assert b.buckets == [8, 16]  # refused swap left the old ladder
+        assert b.retune([12, 24]) == [8, 16]
+    finally:
+        release.set()
+        b.close()  # drain=True flushes the queued request
+    assert req.future.result(timeout=10).shape == (12, 4)
+
+
+# ---------------------------------------------------------------------------
+# shed-before-queue admission gate
+# ---------------------------------------------------------------------------
+
+def _seed_completion_rate(stats, per_second, n=9):
+    """Plant a trustworthy completion history: n marks ending now,
+    spaced for the given rate."""
+    now = time.monotonic()
+    with stats._lock:
+        stats._done_marks.clear()
+        stats._done_marks.extend(
+            now - (n - 1 - i) / per_second for i in range(n)
+        )
+
+
+def test_projected_wait_fails_open_without_history():
+    stats = ServingStats()
+    assert stats.completion_rate() is None
+    assert stats.projected_wait_s(100) is None  # gate stays open
+    assert stats.projected_wait_s(0) == 0.0
+
+
+def test_projected_wait_from_observed_rate():
+    stats = ServingStats()
+    _seed_completion_rate(stats, per_second=2.0)
+    rate = stats.completion_rate()
+    assert rate == pytest.approx(2.0, rel=0.05)
+    assert stats.projected_wait_s(10) == pytest.approx(5.0, rel=0.05)
+
+
+def test_stale_history_is_not_trusted():
+    stats = ServingStats()
+    now = time.monotonic()
+    with stats._lock:
+        stats._done_marks.extend(now - 500 + i for i in range(9))
+    assert stats.completion_rate() is None  # idle gap: rate is stale
+
+
+def test_shed_before_queue_rejects_doomed_request(tpu_backend):
+    rng = np.random.RandomState(0)
+    X = rng.randn(120, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=32,
+                        max_delay_ms=1.0)
+    try:
+        eng.register("m", LogisticRegression(max_iter=20).fit(X, y))
+        fam = obs_metrics.registry().counter("serve.shed_deadline")
+        before = fam.total()
+        # a healthy engine with no queue serves within any deadline
+        assert eng.predict(X[:8], timeout_s=30.0).shape == (8,)
+        # now the observed rate says 1 req/s and 50 requests are
+        # queued: a 2 s deadline is doomed — shed at submit, typed
+        _seed_completion_rate(eng._stats, per_second=1.0)
+        eng._stats.set_queue_depth(50, key="synthetic")
+        with pytest.raises(Overloaded, match="shed before queue"):
+            eng.submit(X[:8], timeout_s=2.0)
+        assert fam.total() == before + 1
+        snap = eng.stats()
+        assert snap["rejected_shed_deadline"] >= 1
+        # no deadline / generous deadline: the gate never fires
+        eng._stats.set_queue_depth(0, key="synthetic")
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# per-model rows_per_slot through the registry (banked capacity ladder)
+# ---------------------------------------------------------------------------
+
+def test_register_validates_bank_rows_per_slot(tpu_backend):
+    rng = np.random.RandomState(1)
+    X = rng.randn(120, 6).astype(np.float32)
+    y = (X[:, 1] > 0).astype(int)
+    model = LogisticRegression(max_iter=20).fit(X, y)
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=64,
+                        max_delay_ms=1.0, bank_models=True)
+    try:
+        with pytest.raises(ValueError, match="capacity ladder"):
+            eng.register("bad", model, bank_rows_per_slot=0)
+        with pytest.raises(ValueError, match="capacity ladder"):
+            eng.register("bad", model, bank_rows_per_slot=4096)
+        entry = eng.register("good", model, bank_rows_per_slot=16)
+        assert entry.bank is not None
+        assert entry.bank.rows_per_slot == 16
+        assert eng.predict(X[:8], model="good").shape == (8,)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# end to end: one observed-traffic swap on a live engine
+# ---------------------------------------------------------------------------
+
+def test_autotune_now_swaps_ladder_from_observed_sizes(tpu_backend):
+    rng = np.random.RandomState(2)
+    X = rng.randn(200, 6).astype(np.float32)
+    y = (X[:, 2] > 0).astype(int)
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=256,
+                        max_delay_ms=1.0)
+    try:
+        eng.register("m", LogisticRegression(max_iter=20).fit(X, y))
+        swaps_before = obs_metrics.registry().counter(
+            "serve.autotune_swaps"
+        ).total()
+        for _ in range(33):  # past the tuner's min_samples
+            assert eng.predict(X[:96]).shape == (96,)
+        report = eng.autotune_now()
+        assert report["enabled"] is True
+        assert report["p50"] == 96
+        assert len(report["swapped"]) == 1
+        swap = report["swapped"][0]
+        assert swap["buckets"][0] == 96          # anchored at p50
+        assert swap["buckets"][-1] == 256        # cap kept
+        entry = eng.registry.get("m")
+        assert entry.buckets == swap["buckets"]  # entry re-stamped
+        assert obs_metrics.registry().counter(
+            "serve.autotune_swaps"
+        ).total() == swaps_before + 1
+        # traffic keeps serving on the new ladder, compile-free at the
+        # anchored rung (it was prewarmed before the swap)
+        assert eng.predict(X[:96]).shape == (96,)
+        assert eng._stats.compiles_after_warmup() == 0
+        # an immediate second pass re-derives the SAME ladder: no swap
+        again = eng.autotune_now()
+        assert again["swapped"] == []
+        assert eng.stats()["autotune"]["swaps"] == 1
+    finally:
+        eng.close()
+
+
+def test_autotune_skips_thin_sample_windows(tpu_backend):
+    eng = ServingEngine(backend=tpu_backend, max_batch_rows=32)
+    try:
+        report = eng.autotune_now()
+        assert report["swapped"] == []
+        assert "samples" in report["reason"]
+    finally:
+        eng.close()

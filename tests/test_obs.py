@@ -65,6 +65,19 @@ class TestRegistry:
         with pytest.raises(TypeError):
             reg.gauge("x")
 
+    def test_help_upgrades_from_empty_only(self):
+        """A bare ``counter(name)`` peek must not strip the HELP line
+        off the family's real registration site (the fleet exposition
+        conformance tests read HELP through the harvest merge) — but
+        the first NON-empty help stays sticky."""
+        reg = MetricsRegistry()
+        fam = reg.counter("x.peeked")      # ad-hoc read, no help
+        assert fam.help == ""
+        reg.counter("x.peeked", help="the real help")
+        assert fam.help == "the real help"
+        reg.counter("x.peeked", help="a later, different help")
+        assert fam.help == "the real help"
+
     def test_thread_safety_concurrent_increments(self):
         """N threads x M increments over shared label children land
         exactly N*M — the lost-update test a bare dict += fails."""

@@ -59,6 +59,20 @@ def telemetry_reply():
             "kind": "gauge", "help": "",
             "children": {(("engine", "serve-0"),): 0},
         },
+        # PR-16 wire-speed counters: HELP text must survive the
+        # harvest merge into the fleet exposition
+        "serve.shed_deadline": {
+            "kind": "counter",
+            "help": "requests shed at admission because the projected "
+                    "queue wait exceeded their deadline",
+            "children": {(): 2},
+        },
+        "serve.autotune_swaps": {
+            "kind": "counter",
+            "help": "bucket-ladder / rows_per_slot swaps applied by "
+                    "the serving autotuner",
+            "children": {(): 1},
+        },
     }
     return {"ok": True, "value": {
         "schema": 1, "pid": os.getpid(), "state": state,
@@ -150,6 +164,37 @@ def test_harvest_merges_worker_state_with_fleet_labels():
         assert _stale_value(text, 1) == 0.0
 
 
+def test_wirespeed_counters_round_trip_with_help_lines():
+    """PR-16 telemetry conformance: the worker-side shed/autotune
+    counters and the supervisor-side transport counters all reach ONE
+    fleet exposition, each with its ``# HELP`` line."""
+    import numpy as np
+
+    with _fleet("good", n=1) as fleet:
+        # the fake worker answers ``request`` with a pickled value, so
+        # the supervisor counts a pickled round trip — and an shm
+        # fallback, since the rows DID go over the ring
+        fleet.predict(np.ones((4, 4), dtype=np.float32))
+        assert fleet.harvest_now() == 1
+        text = fleet.fleet_metrics_text()
+        for fam in ("skdist_serve_shed_deadline_total",
+                    "skdist_serve_autotune_swaps_total",
+                    "skdist_serve_frames_pickled_total",
+                    "skdist_serve_shm_fallbacks_total"):
+            assert f"# HELP {fam} " in text, f"no HELP for {fam}:\n{text}"
+            assert any(line.startswith(fam) and not line.startswith("#")
+                       for line in text.splitlines()), fam
+        # the harvested worker values carry the fleet labels
+        reg = fleet.fleet_registry()
+        pid = fleet.replica(0).telemetry_pid
+        assert reg.counter("serve.shed_deadline").get(
+            replica="0", pid=str(pid)) == 2
+        assert reg.counter("serve.autotune_swaps").get(
+            replica="0", pid=str(pid)) == 1
+        # the per-replica ring-occupancy gauge is in the exposition too
+        assert "skdist_serve_shm_ring_occupancy" in text
+
+
 def test_old_schema_degrades_to_stale_not_failure():
     with _fleet("old-schema") as fleet:
         assert fleet.harvest_now() == 0
@@ -217,6 +262,10 @@ def test_parked_replica_is_stale_and_death_dumps_incident(tmp_path):
         assert doc["schema"] == 1
         assert doc["extra"]["replica"] == 0
         assert "death_reason" in doc["extra"]
+        # the ring-occupancy gauge rides every incident: 0 claimed
+        # slots here (the worker died before any request was in
+        # flight over its ring)
+        assert doc["extra"]["ring_occupancy"] == 0
         # the ring shows the fleet lifecycle that led here
         assert any(e["kind"].startswith("fleet.")
                    for e in doc["events"])

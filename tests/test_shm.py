@@ -1,0 +1,500 @@
+"""
+Wire-speed transport (PR 16): the shared-memory slot ring
+(``serve.shm``), its descriptor fuzz surface, the worker's zero-copy
+ingest / same-slot reply protocol, and the fleet's fallback matrix —
+unit-tested with CHEAP fake workers (plain socket servers that attach
+the ring by path-importing ``shm.py``; no jax import per child),
+mirroring ``test_obs_fleet.py``'s idiom. The heavy end-to-end leg
+(real engines, the >=5x overhead gate, the mid-load autotune swap)
+lives in ``build_tools/wirespeed_smoke.py``.
+"""
+
+import glob
+import os
+import socket
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from skdist_tpu.obs import metrics as obs_metrics
+from skdist_tpu.serve import ProcessReplicaSet, ShmRing, shm_enabled
+from skdist_tpu.serve.procworker import _serve_conn
+from skdist_tpu.serve.shm import DEFAULT_SLOT_BYTES, DEFAULT_SLOTS
+
+_SHM_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "skdist_tpu", "serve", "shm.py",
+)
+
+
+def _dev_shm_count():
+    return len(glob.glob("/dev/shm/psm_*"))
+
+
+def _counter_total(name):
+    fam = obs_metrics.registry().get(name)
+    return 0 if fam is None else fam.total()
+
+
+# ---------------------------------------------------------------------------
+# ring unit tests
+# ---------------------------------------------------------------------------
+
+def test_ring_write_view_read_roundtrip():
+    with ShmRing.create(slots=4, slot_bytes=1 << 12) as ring:
+        assert ring.occupancy() == 0
+        slot = ring.acquire()
+        assert slot is not None
+        assert ring.occupancy() == 1
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        desc = ring.write(slot, x)
+        assert desc == {"slot": slot, "shape": (4, 6), "dtype": x.dtype.str}
+        view = ring.view(desc)
+        np.testing.assert_array_equal(view, x)
+        # view is the slot itself (zero-copy); read is a fresh copy
+        view[0, 0] = 99.0
+        assert ring.view(desc)[0, 0] == 99.0
+        out = ring.read(desc)
+        view[0, 0] = -1.0
+        assert out[0, 0] == 99.0  # the copy must not alias the ring
+        ring.release(slot)
+        assert ring.occupancy() == 0
+
+
+def test_ring_acquire_exhaustion_is_none_not_error():
+    with ShmRing.create(slots=2, slot_bytes=256) as ring:
+        a, b = ring.acquire(), ring.acquire()
+        assert a is not None and b is not None and a != b
+        assert ring.acquire() is None  # full: the pickle-fallback signal
+        ring.release(b)
+        assert ring.acquire() == b
+
+
+def test_ring_fits_boundary():
+    with ShmRing.create(slots=1, slot_bytes=64) as ring:
+        assert ring.fits(0) and ring.fits(64)
+        assert not ring.fits(65)
+        assert not ring.fits(-1)
+
+
+def test_ring_attach_shares_memory_and_owner_unlinks():
+    before = _dev_shm_count()
+    owner = ShmRing.create(slots=2, slot_bytes=512)
+    worker = ShmRing.attach(**owner.describe())
+    try:
+        assert _dev_shm_count() == before + 1
+        slot = owner.acquire()
+        desc = owner.write(slot, np.full((3, 3), 7, dtype=np.int32))
+        # the worker's view reads the owner's bytes with no copy ...
+        np.testing.assert_array_equal(worker.view(desc),
+                                      np.full((3, 3), 7, np.int32))
+        # ... and a worker-side write comes back to the owner (the
+        # same-slot reply protocol)
+        out_desc = worker.write(desc["slot"],
+                                np.ones((2, 2), dtype=np.float64))
+        np.testing.assert_array_equal(owner.read(out_desc),
+                                      np.ones((2, 2)))
+    finally:
+        # worker close only unmaps: the segment must survive it
+        worker.close()
+        assert _dev_shm_count() == before + 1
+        owner.close()
+    assert _dev_shm_count() == before
+
+
+def test_ring_geometry_validation():
+    with pytest.raises(ValueError, match="slots >= 1"):
+        ShmRing.create(slots=0)
+    with pytest.raises(ValueError, match="slot_bytes >= 1"):
+        ShmRing.create(slots=2, slot_bytes=0)
+
+
+@pytest.mark.parametrize("desc", [
+    None,
+    "slot 0",
+    [],
+    {},                                              # no slot at all
+    {"slot": -1, "shape": (1,), "dtype": "<f4"},     # below the ring
+    {"slot": 4, "shape": (1,), "dtype": "<f4"},      # past the ring
+    {"slot": True, "shape": (1,), "dtype": "<f4"},   # bool is not an index
+    {"slot": "0", "shape": (1,), "dtype": "<f4"},
+    {"slot": 0, "shape": None, "dtype": "<f4"},
+    {"slot": 0, "shape": (-1, 4), "dtype": "<f4"},   # negative dim
+    {"slot": 0, "shape": (True, 2), "dtype": "<f4"},
+    {"slot": 0, "shape": ("4",), "dtype": "<f4"},
+    {"slot": 0, "shape": (1,) * 9, "dtype": "<f4"},  # ndim bomb
+    {"slot": 0, "shape": (1,), "dtype": "not-a-dtype"},
+    {"slot": 0, "shape": (1,), "dtype": "O"},        # object payloads
+    {"slot": 0, "shape": (1,), "dtype": "<U8"},      # str payloads
+    {"slot": 0, "shape": (1 << 40,), "dtype": "<f4"},  # oversized read
+    {"slot": 0, "shape": (1 << 62, 1 << 62), "dtype": "<f8"},  # overflow
+])
+def test_descriptor_fuzz_raises_valueerror(desc):
+    """The fuzz surface mirroring the ``recv_frame`` fuzz battery:
+    every torn/hostile descriptor is a typed ``ValueError`` before any
+    pointer math — never a crash, never an out-of-slot read."""
+    with ShmRing.create(slots=4, slot_bytes=1 << 10) as ring:
+        with pytest.raises(ValueError):
+            ring.view(desc)
+        with pytest.raises(ValueError):
+            ring.read(desc)
+
+
+def test_closed_ring_rejects_everything_idempotently():
+    ring = ShmRing.create(slots=2, slot_bytes=128)
+    slot = ring.acquire()
+    ring.close()
+    ring.close()  # idempotent
+    assert ring.acquire() is None
+    assert ring.occupancy() == 0
+    ring.release(slot)  # a late release must not explode
+    with pytest.raises(ValueError, match="closed"):
+        ring.view({"slot": 0, "shape": (1,), "dtype": "<f4"})
+
+
+def test_shm_kill_switch(monkeypatch):
+    monkeypatch.delenv("SKDIST_SHM", raising=False)
+    assert shm_enabled()
+    monkeypatch.setenv("SKDIST_SHM", "0")
+    assert not shm_enabled()
+    monkeypatch.setenv("SKDIST_SHM", "false")
+    assert not shm_enabled()
+
+
+# ---------------------------------------------------------------------------
+# worker protocol, in-process: procworker._serve_conn over a socketpair
+# with a stub engine — the zero-copy ingest and same-slot reply paths
+# ---------------------------------------------------------------------------
+
+class _StubEngine:
+    """predict() doubles the rows; the shapes/dtypes are chosen per
+    test to steer the worker's reply between the shm and pickle
+    planes."""
+
+    def __init__(self, reply=None):
+        self._reply = reply
+
+    def queue_depth(self):
+        return 0
+
+    def predict(self, X, model=None, method="predict", timeout_s=None):
+        if self._reply is not None:
+            return self._reply
+        return np.asarray(X) * 2
+
+
+def _worker_conn(engine, ring):
+    """A live in-process worker connection: returns the caller-side
+    socket; the worker side runs ``_serve_conn`` on a thread with the
+    given ring attached (None = pickled frames only)."""
+    caller, worker = socket.socketpair()
+    state = {"draining": threading.Event(), "shutdown": lambda: None,
+             "ring": ring}
+    t = threading.Thread(target=_serve_conn, args=(engine, state, worker),
+                         daemon=True)
+    t.start()
+    return caller
+
+
+def _rpc(conn, op, payload, timeout=10.0):
+    from skdist_tpu.serve.procfleet import recv_frame, send_frame
+
+    conn.settimeout(timeout)
+    send_frame(conn, (op, payload))
+    return recv_frame(conn)
+
+
+def test_worker_shm_request_replies_in_same_slot():
+    sup = ShmRing.create(slots=2, slot_bytes=1 << 12)
+    wrk = ShmRing.attach(**sup.describe())
+    conn = _worker_conn(_StubEngine(), wrk)
+    try:
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        slot = sup.acquire()
+        desc = sup.write(slot, x)
+        reply = _rpc(conn, "request", {"shm": desc, "model": None,
+                                       "method": "predict"})
+        assert reply["ok"]
+        out_desc = reply.get("shm")
+        assert out_desc is not None and out_desc["slot"] == slot
+        np.testing.assert_array_equal(sup.read(out_desc), x * 2)
+        sup.release(slot)
+    finally:
+        conn.close()
+        wrk.close()
+        sup.close()
+
+
+def test_worker_oversized_result_falls_back_to_pickled_reply():
+    sup = ShmRing.create(slots=2, slot_bytes=256)
+    wrk = ShmRing.attach(**sup.describe())
+    big = np.ones((64, 64), dtype=np.float64)  # 32 KiB >> slot_bytes
+    conn = _worker_conn(_StubEngine(reply=big), wrk)
+    try:
+        slot = sup.acquire()
+        desc = sup.write(slot, np.zeros((4, 4), dtype=np.float32))
+        reply = _rpc(conn, "request", {"shm": desc})
+        assert reply["ok"] and reply.get("shm") is None
+        np.testing.assert_array_equal(reply["value"], big)
+        sup.release(slot)
+    finally:
+        conn.close()
+        wrk.close()
+        sup.close()
+
+
+def test_worker_non_numeric_result_rides_pickled_reply():
+    sup = ShmRing.create(slots=1, slot_bytes=1 << 10)
+    wrk = ShmRing.attach(**sup.describe())
+    conn = _worker_conn(_StubEngine(reply={"proba": [0.5]}), wrk)
+    try:
+        slot = sup.acquire()
+        desc = sup.write(slot, np.zeros((2, 2), dtype=np.float32))
+        reply = _rpc(conn, "request", {"shm": desc})
+        assert reply["ok"] and reply.get("shm") is None
+        assert reply["value"] == {"proba": [0.5]}
+        sup.release(slot)
+    finally:
+        conn.close()
+        wrk.close()
+        sup.close()
+
+
+def test_worker_without_ring_rejects_descriptor_as_typed_error():
+    conn = _worker_conn(_StubEngine(), ring=None)
+    try:
+        reply = _rpc(conn, "request",
+                     {"shm": {"slot": 0, "shape": (1,), "dtype": "<f4"}})
+        assert reply["ok"] is False
+        assert reply["etype"] == "ValueError"
+        assert "no ring attached" in reply["msg"]
+    finally:
+        conn.close()
+
+
+def test_worker_hostile_descriptor_keeps_connection_alive():
+    """A fuzzed descriptor over the wire is a per-request ValueError;
+    the connection (and ring) keep serving — mirroring the recv_frame
+    fuzz battery's abandon-one-request contract."""
+    sup = ShmRing.create(slots=2, slot_bytes=1 << 10)
+    wrk = ShmRing.attach(**sup.describe())
+    conn = _worker_conn(_StubEngine(), wrk)
+    try:
+        for bad in ({"slot": 99, "shape": (1,), "dtype": "<f4"},
+                    {"slot": 0, "shape": (1 << 40,), "dtype": "<f8"},
+                    {"slot": 0, "shape": (4,), "dtype": "O"}):
+            reply = _rpc(conn, "request", {"shm": bad})
+            assert reply["ok"] is False and reply["etype"] == "ValueError"
+        # mixed clients on ONE connection: a classic pickled frame
+        # still serves after the fuzz, and after an shm frame
+        x = np.ones((2, 3), dtype=np.float32)
+        reply = _rpc(conn, "request", {"X": x})
+        assert reply["ok"] and reply.get("shm") is None
+        np.testing.assert_array_equal(reply["value"], x * 2)
+        slot = sup.acquire()
+        desc = sup.write(slot, x)
+        reply = _rpc(conn, "request", {"shm": desc})
+        assert reply["ok"] and reply["shm"]["slot"] == slot
+        sup.release(slot)
+    finally:
+        conn.close()
+        wrk.close()
+        sup.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet degradation matrix: cheap fake workers attaching the real ring
+# ---------------------------------------------------------------------------
+
+#: a wire-conformant worker that path-imports shm.py (no package / jax
+#: import), attaches the ring from the spawn config, serves ``request``
+#: with zero-copy ingest + same-slot reply, and answers the harvest
+_SHM_WORKER = r"""
+import importlib.util, json, os, pickle, socket, struct, sys, threading
+import numpy as np
+sock_path, cfg_json, shm_py = sys.argv[1], sys.argv[2], sys.argv[3]
+cfg = json.loads(cfg_json)
+spec = importlib.util.spec_from_file_location("_shm_ut", shm_py)
+shm = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(shm)
+ring = shm.ShmRing.attach(**cfg["shm"]) if cfg.get("shm") else None
+H = struct.Struct(">I")
+def recv_exact(c, n):
+    b = b""
+    while len(b) < n:
+        chunk = c.recv(n - len(b))
+        if not chunk:
+            raise EOFError
+        b += chunk
+    return b
+def recv(c):
+    (n,) = H.unpack(recv_exact(c, 4))
+    return pickle.loads(recv_exact(c, n))
+def send(c, obj):
+    p = pickle.dumps(obj)
+    c.sendall(H.pack(len(p)) + p)
+def handle(op, payload):
+    if op == "ping":
+        return {"ok": True, "value": {"pid": os.getpid(),
+                                      "draining": False,
+                                      "queue_depth": 0}}
+    if op == "telemetry":
+        return {"ok": True, "value": {
+            "schema": 1, "pid": os.getpid(), "state": {},
+            "compiles_after_warmup": 0, "trace": None, "flightrec": []}}
+    if op == "request":
+        desc = payload.get("shm")
+        if desc is not None:
+            X = ring.view(desc)
+        else:
+            X = payload["X"]
+        out = np.asarray(X, dtype=np.float32) * 2
+        if desc is not None and ring.fits(out.nbytes):
+            return {"ok": True, "shm": ring.write(desc["slot"], out)}
+        return {"ok": True, "value": out}
+    return {"ok": True, "value": {}}
+def serve(c):
+    try:
+        while True:
+            op, payload = recv(c)
+            send(c, handle(op, payload))
+    except Exception:
+        pass
+ls = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+try:
+    os.unlink(sock_path)
+except FileNotFoundError:
+    pass
+ls.bind(sock_path)
+ls.listen(8)
+while True:
+    c, _ = ls.accept()
+    threading.Thread(target=serve, args=(c,), daemon=True).start()
+"""
+
+
+def _shm_argv(index, sock_path, cfg):
+    return [sys.executable, "-c", _SHM_WORKER, sock_path, cfg, _SHM_PY]
+
+
+def _fleet(n=1, **kwargs):
+    kwargs.setdefault("spawn_timeout_s", 15.0)
+    kwargs.setdefault("heartbeat_interval_s", 5.0)
+    kwargs.setdefault("harvest_interval_s", 0.0)
+    kwargs.setdefault("respawn_backoff_s", 30.0)
+    return ProcessReplicaSet(
+        n_replicas=n, worker_argv=_shm_argv, **kwargs
+    )
+
+
+def test_fleet_requests_ride_the_ring():
+    shm_before = _counter_total("serve.shm_bytes")
+    with _fleet(n=1) as fleet:
+        x = np.arange(20, dtype=np.float32).reshape(4, 5)
+        for _ in range(3):
+            np.testing.assert_array_equal(fleet.predict(x), x * 2)
+        tr = fleet.stats()["transport"]
+        assert tr["enabled"] is True
+        assert tr["shm_requests"] >= 3
+        assert tr["shm_mean_overhead_s"] is not None
+        assert _counter_total("serve.shm_bytes") >= shm_before + 3 * (
+            x.nbytes + x.nbytes  # reply is float32 of the same shape
+        )
+        # the per-replica occupancy gauge settles back to 0 after the
+        # round trips (slot released on reply)
+        occ = obs_metrics.registry().get("serve.shm_ring_occupancy")
+        assert occ is not None and occ.get(replica="0") == 0
+
+
+def test_fleet_ring_full_falls_back_to_pickled_frames():
+    with _fleet(n=1, shm_slots=1) as fleet:
+        r = fleet.replica(0)
+        slot = r.ring.acquire()  # squat the only slot
+        assert slot is not None
+        fb_before = _counter_total("serve.shm_fallbacks")
+        pk_before = _counter_total("serve.frames_pickled")
+        x = np.ones((2, 4), dtype=np.float32)
+        np.testing.assert_array_equal(fleet.predict(x), x * 2)
+        assert _counter_total("serve.shm_fallbacks") == fb_before + 1
+        assert _counter_total("serve.frames_pickled") == pk_before + 1
+        r.ring.release(slot)
+        # with the slot back, the next request rides the ring again
+        np.testing.assert_array_equal(fleet.predict(x), x * 2)
+        assert _counter_total("serve.shm_fallbacks") == fb_before + 1
+        tr = fleet.stats()["transport"]
+        assert tr["pickle_requests"] >= 1 and tr["shm_requests"] >= 1
+
+
+def test_fleet_oversized_payload_routes_around_the_ring():
+    with _fleet(n=1, shm_slot_bytes=64) as fleet:
+        fb_before = _counter_total("serve.shm_fallbacks")
+        big = np.ones((16, 16), dtype=np.float32)  # 1 KiB >> 64 B
+        np.testing.assert_array_equal(fleet.predict(big), big * 2)
+        assert _counter_total("serve.shm_fallbacks") == fb_before + 1
+        assert fleet.stats()["transport"]["pickle_requests"] >= 1
+
+
+def test_fleet_shm_kill_switch_serves_pickled_only(monkeypatch):
+    monkeypatch.setenv("SKDIST_SHM", "0")
+    with _fleet(n=1) as fleet:
+        assert fleet.replica(0).ring is None
+        x = np.ones((3, 3), dtype=np.float32)
+        np.testing.assert_array_equal(fleet.predict(x), x * 2)
+        tr = fleet.stats()["transport"]
+        assert tr["enabled"] is False
+        assert tr["shm_requests"] == 0 and tr["pickle_requests"] >= 1
+
+
+@pytest.fixture()
+def _fast_incidents():
+    from skdist_tpu.obs import flightrec as obs_flightrec
+
+    rec = obs_flightrec.recorder()
+    prev = rec.min_interval_s
+    rec.min_interval_s = 0.0
+    yield
+    rec.min_interval_s = prev
+
+
+@pytest.mark.skipif(not os.path.isdir("/dev/shm"),
+                    reason="no /dev/shm on this platform")
+def test_sigkill_mid_ring_write_leaks_no_dev_shm(tmp_path,
+                                                 _fast_incidents):
+    """The ISSUE's leak-proofing contract: SIGKILL a worker while its
+    ring has a claimed slot (the mid-ring-write state), respawn, close
+    — /dev/shm segment counts must return to the baseline because the
+    SUPERVISOR owns every unlink."""
+    baseline = _dev_shm_count()
+    fleet = _fleet(n=1, incident_dir=str(tmp_path),
+                   respawn_backoff_s=0.01)
+    try:
+        assert _dev_shm_count() == baseline + 1
+        r = fleet.replica(0)
+        first_ring = r.ring.name
+        slot = r.ring.acquire()  # a request is mid-flight in the ring
+        assert slot is not None
+        fleet.kill_replica(0)    # SIGKILL: the worker can't clean up
+        r.proc.wait(timeout=10)
+        fleet._declare_dead(r, "test kill", kill=False)
+        # the death path closed+unlinked the old ring even with the
+        # slot still claimed
+        assert not os.path.exists(f"/dev/shm/{first_ring}")
+        # the incident file recorded the claimed slot at death time
+        import json
+
+        incidents = sorted(p for p in os.listdir(tmp_path)
+                           if p.startswith("skdist-incident-"))
+        assert incidents, "the death left no incident file"
+        doc = json.loads((tmp_path / incidents[-1]).read_text())
+        assert doc["extra"]["ring_occupancy"] == 1
+        assert fleet.heal() == 1
+        # fresh generation, fresh ring: back to exactly one segment
+        assert _dev_shm_count() == baseline + 1
+        assert fleet.replica(0).ring.name != first_ring
+        x = np.ones((2, 2), dtype=np.float32)
+        np.testing.assert_array_equal(fleet.predict(x), x * 2)
+    finally:
+        fleet.close()
+    assert _dev_shm_count() == baseline
