@@ -2320,6 +2320,177 @@ def _multitenant_main(quick=False):
     return payload
 
 
+def catalog_aux(quick=False):
+    """Measured readout of the tenant-lifecycle plane (the living
+    catalog): bulk cold-load wall of a catalog onto a banked engine
+    (ONE placement, ONE bank generation) vs the per-tenant publish
+    loop (one register → one bank rebuild each, measured on a generous
+    subset and reported as a rate), plus serving latency percentiles
+    under threaded load WHILE a cohort is warm-refreshed and rolled
+    out mid-traffic vs the same load undisturbed, and the compile
+    invariant. Best-effort: a dict with "error" on any failure."""
+    import tempfile
+    import threading as _threading
+
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+        ))
+        from bench_multitenant import make_catalog
+
+        from skdist_tpu.catalog import CatalogStore, RefreshJob, \
+            cold_load, rollout_records
+        from skdist_tpu.data import ChunkedDataset
+        from skdist_tpu.obs import metrics as obs_metrics
+        from skdist_tpu.serve import ServingEngine
+
+        n_tenants = 300 if quick else 2000
+        subset = 32 if quick else 64
+        base, tenants, Xs = make_catalog(n_tenants)
+        tmp = tempfile.mkdtemp(prefix="skdist_bench_catalog_")
+        store = CatalogStore(os.path.join(tmp, "cat"))
+        t0 = time.perf_counter()
+        store.put_many([(f"t{i}", m) for i, m in enumerate(tenants)])
+        publish_wall = time.perf_counter() - t0
+
+        rebuilds = obs_metrics.registry().counter("serve.bank_rebuilds")
+        eng_kw = dict(max_batch_rows=128, max_delay_ms=1.0,
+                      max_queue_depth=4096, bank_models=True)
+
+        # -- bulk cold-load: the whole catalog, one placement ----------
+        engine = ServingEngine(**eng_kw)
+        before = rebuilds.total()
+        t0 = time.perf_counter()
+        cold_load(engine, store)
+        bulk_wall = time.perf_counter() - t0
+        bulk_generations = int(rebuilds.total() - before)
+
+        # -- per-tenant publish loop on a generous subset --------------
+        # (every register re-stages + prewarms its bank generation; a
+        # full-catalog loop would be quadratic in members — which is
+        # the point of the bulk path)
+        eng2 = ServingEngine(**eng_kw)
+        before = rebuilds.total()
+        t0 = time.perf_counter()
+        for i in range(subset):
+            eng2.register(f"t{i}", tenants[i])
+        loop_wall = time.perf_counter() - t0
+        loop_generations = int(rebuilds.total() - before)
+        eng2.close()
+        bulk_rate = n_tenants / max(bulk_wall, 1e-9)
+        loop_rate = subset / max(loop_wall, 1e-9)
+
+        # -- serving p99: undisturbed vs mid-refresh -------------------
+        probe = list(range(0, n_tenants, max(1, n_tenants // 24)))
+        n_clients, n_requests = (4, 40) if quick else (6, 60)
+
+        def load_leg(during=None):
+            lat, errors = [], []
+            lock = _threading.Lock()
+
+            def client(cid):
+                r = np.random.RandomState(500 + cid)
+                for _ in range(n_requests):
+                    t = probe[int(r.randint(0, len(probe)))]
+                    i = int(r.randint(0, Xs.shape[0] - 4))
+                    t1 = time.perf_counter()
+                    try:
+                        engine.predict(Xs[i:i + 4], model=f"t{t}",
+                                       timeout_s=30)
+                    except Exception as exc:  # noqa: BLE001
+                        with lock:
+                            errors.append(repr(exc))
+                        continue
+                    with lock:
+                        lat.append(time.perf_counter() - t1)
+
+            threads = [_threading.Thread(target=client, args=(c,))
+                       for c in range(n_clients)]
+            for th in threads:
+                th.start()
+            mid = during() if during is not None else None
+            for th in threads:
+                th.join()
+            q = np.percentile(np.asarray(lat) * 1e3, [50, 99])
+            return {"p50_ms": round(float(q[0]), 3),
+                    "p99_ms": round(float(q[1]), 3),
+                    "requests": len(lat), "errors": len(errors)}, mid
+
+        engine.predict(Xs[:4], model="t0", timeout_s=30)  # warm route
+        quiet, _ = load_leg()
+
+        Xf = np.vstack([
+            np.random.RandomState(77).normal(
+                loc=c, scale=0.8, size=(120, Xs.shape[1]))
+            for c in (-1.2, 1.2)
+        ]).astype(np.float32)
+        yf = np.repeat([0, 1], 120)
+        ds = ChunkedDataset.from_arrays(Xf, y=yf, block_rows=48)
+        job = RefreshJob(store, gate_tol=0.05)
+        cohort = probe[:8]
+
+        def do_refresh():
+            t0 = time.perf_counter()
+            results = job.refresh_cohort(
+                [(f"t{i}", ds) for i in cohort]
+            )
+            rolled = rollout_records(engine, store, results)
+            return {
+                "refresh_rollout_wall_s": round(
+                    time.perf_counter() - t0, 3),
+                "cohort": len(cohort),
+                "published": sum(
+                    1 for r in results
+                    if not isinstance(r, Exception) and r.published
+                ),
+                "rolled_out": len(rolled),
+            }
+
+        busy, refresh_info = load_leg(during=do_refresh)
+        st = engine.stats()
+        engine.close()
+        return {
+            "tenants": n_tenants,
+            "publish_wall_s": round(publish_wall, 3),
+            "bulk_cold_load_wall_s": round(bulk_wall, 3),
+            "bulk_bank_generations": bulk_generations,
+            "bulk_tenants_per_s": round(bulk_rate, 1),
+            "per_tenant_loop_subset": subset,
+            "per_tenant_loop_wall_s": round(loop_wall, 3),
+            "per_tenant_loop_generations": loop_generations,
+            "per_tenant_tenants_per_s": round(loop_rate, 1),
+            "bulk_speedup_vs_per_tenant": round(
+                bulk_rate / max(loop_rate, 1e-9), 2),
+            "serving_quiet": quiet,
+            "serving_mid_refresh": busy,
+            "mid_refresh": refresh_info,
+            "compiles_after_warmup": st["compiles_after_warmup"],
+        }
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _catalog_main(quick=False):
+    """Standalone capture of the tenant-lifecycle readout →
+    ``BENCH_catalog_r18.json`` (bulk cold-load wall + bank generations
+    vs the per-tenant publish loop, serving p50/p99 undisturbed vs
+    mid-refresh, refresh/rollout wall, compile invariant)."""
+    import jax
+
+    payload = {
+        "metric": "catalog_lifecycle",
+        "aux": catalog_aux(quick=quick),
+        "platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(payload, indent=1), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_catalog_r18.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
 def _obs_main(quick=True):
     """Standalone capture of the telemetry-plane readout →
     ``BENCH_obs_r13.json`` (tracing off/on warm walls + overhead
@@ -2415,5 +2586,7 @@ if __name__ == "__main__":
         _kernels_main(quick="--quick" in sys.argv)
     elif "--multitenant" in sys.argv:
         _multitenant_main(quick="--quick" in sys.argv)
+    elif "--catalog" in sys.argv:
+        _catalog_main(quick="--quick" in sys.argv)
     else:
         main(quick="--quick" in sys.argv)

@@ -1,0 +1,331 @@
+"""Catalog lifecycle smoke: the living-catalog gate.
+
+Exercises the full tenant lifecycle on the 8-vdev CPU mesh (the same
+harness every other smoke uses):
+
+1. publish a 10k-tenant catalog to a CatalogStore (atomic
+   dir-per-version, bulk ``put_many``), with crash debris (a torn
+   manifest) injected — it must be skipped, never fatal;
+2. cold-load the whole catalog onto a banked ServingEngine in ONE bulk
+   placement: ``serve.bank_rebuilds`` must grow by the number of bank
+   GROUPS (1), counter-asserted ≪ the number of tenants published;
+3. serve under threaded mixed-tenant load while a cohort is refreshed
+   MID-TRAFFIC via streamed warm-refit (``ChunkedDataset`` +
+   ``coef_init`` from the parent) and rolled out — 0 failed requests,
+   refreshed tenants route to the new version;
+4. the rejected path: a refresh fed garbage labels is gated out —
+   stored ``rejected``, invisible to ``latest()``, and the engine
+   keeps serving the parent version byte-for-byte;
+5. 0 compiles after warmup across the entire run (cold-load prewarm
+   covers refresh rollouts too — same bank group, same buckets);
+6. fleet leg: a 3-replica banked ReplicaSet takes a sharded
+   ``rollout_many`` (bank-aware routing) — each replica holds a strict
+   subset of the catalog, every tenant stays servable, and killing
+   every holder of a shard re-stages it on a survivor.
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/catalog_smoke.py [--tenants 10000] [--quick]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import numpy as np  # noqa: E402
+
+
+def fresh_traffic(n_features=16, rows=240, seed=1234):
+    """New draws from the same two-cluster distribution make_catalog
+    trains on — the 'yesterday's traffic' a refresh consumes."""
+    rng = np.random.RandomState(seed)
+    X = np.vstack([
+        rng.normal(loc=c, scale=0.8, size=(rows // 2, n_features))
+        for c in (-1.2, 1.2)
+    ]).astype(np.float32)
+    y = np.repeat([0, 1], rows // 2)
+    return X, y
+
+
+def lifecycle_leg(failures, n_tenants, clients=6, requests=40,
+                  cohort=8):
+    import tempfile
+
+    from bench_multitenant import make_catalog
+
+    from skdist_tpu.catalog import CatalogStore, RefreshJob, \
+        cold_load, rollout_records
+    from skdist_tpu.data import ChunkedDataset
+    from skdist_tpu.obs import metrics as obs_metrics
+    from skdist_tpu.serve import ServingEngine
+
+    out = {"tenants": n_tenants}
+    base, tenants, Xs = make_catalog(n_tenants)
+
+    # -- 1. publish the catalog ------------------------------------------
+    tmp = tempfile.mkdtemp(prefix="skdist_catalog_smoke_")
+    store = CatalogStore(os.path.join(tmp, "cat"))
+    t0 = time.perf_counter()
+    store.put_many(
+        [(f"t{i}", m) for i, m in enumerate(tenants)],
+        provenance={"job": "smoke_seed"},
+    )
+    out["publish_wall_s"] = round(time.perf_counter() - t0, 3)
+    # crash debris: a torn manifest must be skipped, never fatal
+    torn = os.path.join(tmp, "cat", "t0", "99")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "manifest.json"), "w") as f:
+        f.write('{"name": "t0", "ver')
+    if store.versions("t0") != [1]:
+        failures.append("torn manifest dir was not skipped")
+
+    # -- 2. cold-load: one bulk placement --------------------------------
+    rebuilds = obs_metrics.registry().counter("serve.bank_rebuilds")
+    engine = ServingEngine(
+        max_batch_rows=128, max_delay_ms=1.0, max_queue_depth=4096,
+        bank_models=True,
+    )
+    before = rebuilds.total()
+    t0 = time.perf_counter()
+    placed = cold_load(engine, store)
+    out["cold_load_wall_s"] = round(time.perf_counter() - t0, 3)
+    built = int(rebuilds.total() - before)
+    out["bank_generations_built"] = built
+    if len(placed) != n_tenants:
+        failures.append(
+            f"cold-load placed {len(placed)} of {n_tenants} tenants"
+        )
+    if built * 100 > n_tenants:
+        failures.append(
+            f"cold-load built {built} bank generations for "
+            f"{n_tenants} tenants — bulk placement is not bulk"
+        )
+
+    # -- 3. threaded load with a mid-traffic refresh + rollout ------------
+    probe = sorted(
+        {int(i) for i in np.random.RandomState(5).randint(
+            0, n_tenants, 48)}
+    )
+    expected = {i: tenants[i].predict(Xs) for i in probe}
+    errors = []
+    lock = threading.Lock()
+    refreshed_evt = threading.Event()
+    cohort_ids = probe[:cohort]
+
+    def client(cid):
+        r = np.random.RandomState(900 + cid)
+        for _ in range(requests):
+            t = probe[int(r.randint(0, len(probe)))]
+            n = int(r.randint(1, 4))
+            i = int(r.randint(0, Xs.shape[0] - n))
+            # pin the parent version: refreshed co-tenants roll to @2
+            # mid-load, and @1 must keep serving byte-identically
+            try:
+                got = engine.predict(Xs[i:i + n], model=f"t{t}@1",
+                                     timeout_s=30)
+                if not (np.asarray(got) == expected[t][i:i + n]).all():
+                    with lock:
+                        errors.append(("mismatch", t))
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(("error", repr(exc)))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for th in threads:
+        th.start()
+
+    # streamed warm-refit of the cohort, mid-traffic
+    Xf, yf = fresh_traffic()
+    ds = ChunkedDataset.from_arrays(Xf, y=yf, block_rows=48)
+    job = RefreshJob(store, gate_tol=0.05)
+    t0 = time.perf_counter()
+    results = job.refresh_cohort([(f"t{i}", ds) for i in cohort_ids])
+    out["refresh_wall_s"] = round(time.perf_counter() - t0, 3)
+    bad = [r for r in results
+           if isinstance(r, Exception) or not r.published]
+    if bad:
+        failures.append(f"refresh cohort failed the gate: {bad[:2]}")
+    warm_iters = [
+        r.record.manifest["provenance"]["n_iter"] for r in results
+        if not isinstance(r, Exception)
+    ]
+    out["warm_refit_iters"] = warm_iters
+    rolled = rollout_records(engine, store, results)
+    refreshed_evt.set()
+    for th in threads:
+        th.join()
+    if errors:
+        failures.append(
+            f"{len(errors)} failed/mismatched requests under load "
+            f"(first: {errors[:2]})"
+        )
+    out["requests_served"] = clients * requests
+    if len(rolled) != len(cohort_ids):
+        failures.append(
+            f"rollout placed {len(rolled)}/{len(cohort_ids)} refreshed"
+        )
+    for i in cohort_ids:
+        fresh_model, _ = store.get(f"t{i}")
+        got = engine.predict(Xs[:8], model=f"t{i}", timeout_s=30)
+        if not (np.asarray(got) == fresh_model.predict(Xs[:8])).all():
+            failures.append(
+                f"t{i} bare-name routing did not reach the refreshed "
+                "version"
+            )
+            break
+
+    # -- 4. the rejected path --------------------------------------------
+    victim = probe[-1]
+    res = job.refresh(
+        f"t{victim}", Xf, y=1 - yf,        # garbage labels
+        holdout=(Xs[:100], np.repeat([0, 1], 120)[:100]),
+    )
+    if res.published or res.record.status != "rejected":
+        failures.append("garbage refresh slipped past the gate")
+    if store.latest(f"t{victim}").version != 1:
+        failures.append("rejected version resolved as latest")
+    if rollout_records(engine, store, [res]):
+        failures.append("rollout_records shipped a rejected record")
+    got = engine.predict(Xs[:8], model=f"t{victim}", timeout_s=30)
+    if not (np.asarray(got) == expected[victim][:8]).all():
+        failures.append(
+            "serving output moved after a REJECTED refresh"
+        )
+    out["gate_rejects"] = int(
+        obs_metrics.registry().counter("catalog.gate_rejects").total()
+    )
+
+    # -- 5. zero compiles after warmup -----------------------------------
+    st = engine.stats()
+    out["compiles_after_warmup"] = st["compiles_after_warmup"]
+    if st["compiles_after_warmup"] != 0:
+        failures.append(
+            f"compiles_after_warmup = {st['compiles_after_warmup']} "
+            "(a refresh rollout escaped the prewarmed ladder)"
+        )
+    for cname in ("catalog.refits", "catalog.publishes",
+                  "catalog.bank_stagings"):
+        total = obs_metrics.registry().counter(cname).total()
+        out[cname] = int(total)
+        if total <= 0:
+            failures.append(f"counter {cname} never moved")
+    engine.close()
+    return out
+
+
+def fleet_leg(failures, n_tenants=60, n_replicas=3, n_shards=3):
+    """Bank-aware sharded routing: rollout_many across a ReplicaSet."""
+    from bench_multitenant import make_catalog
+
+    from skdist_tpu.serve import ReplicaSet
+
+    base, tenants, Xs = make_catalog(n_tenants)
+    models = [(f"s{i}", tenants[i]) for i in range(n_tenants)]
+    fleet = ReplicaSet(
+        n_replicas=n_replicas, max_batch_rows=128, max_delay_ms=1.0,
+        bank_models=True,
+    )
+    fleet.rollout_many(models, n_shards=n_shards, replication=1)
+    held = [len(r.engine.registry.names()) for r in fleet._replicas]
+    if max(held) >= n_tenants:
+        failures.append(
+            f"fleet leg: a replica holds the whole catalog ({held}) — "
+            "routing is not sharded"
+        )
+    if sum(held) != n_tenants:
+        failures.append(
+            f"fleet leg: {sum(held)} placements for {n_tenants} "
+            "tenants at replication=1"
+        )
+    for name, m in models[:: max(1, n_tenants // 16)]:
+        got = fleet.predict(Xs[:4], model=name, timeout_s=30)
+        if not (np.asarray(got) == m.predict(Xs[:4])).all():
+            failures.append(f"fleet leg: {name} misrouted")
+            break
+
+    # failover: kill every holder of shard 0, park the respawn, and
+    # the next request must re-stage the shard on a survivor
+    holders = fleet.stats()["shard_holders"].get(0) or []
+    for idx in holders:
+        fleet.kill_replica(idx, drain=False)
+    fleet._pending_respawn.clear()
+    shard0 = [n for n, _ in models if fleet._shard_of.get(n) == 0]
+    restaged = 0
+    for name in shard0:
+        m = dict(models)[name]
+        try:
+            got = fleet.predict(Xs[:4], model=name, timeout_s=30)
+        except Exception as exc:  # noqa: BLE001
+            failures.append(
+                f"fleet leg: {name} unservable after holder loss "
+                f"({exc!r})"
+            )
+            break
+        if not (np.asarray(got) == m.predict(Xs[:4])).all():
+            failures.append(f"fleet leg: {name} wrong after restage")
+            break
+        restaged += 1
+    new_holders = set(fleet.stats()["shard_holders"].get(0) or [])
+    if not (new_holders - set(holders)):
+        failures.append(
+            "fleet leg: shard 0 was never re-staged on a survivor"
+        )
+    fleet.close()
+    return {
+        "replicas": n_replicas, "tenants": n_tenants,
+        "held_per_replica": held, "shard0_holders": sorted(holders),
+        "shard0_restaged_requests": restaged,
+        "shard0_new_holders": sorted(new_holders),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tenants", type=int, default=10000)
+    ap.add_argument("--quick", action="store_true",
+                    help="1000-tenant variant for iteration")
+    args = ap.parse_args()
+    if args.quick:
+        args.tenants = min(args.tenants, 1000)
+
+    failures = []
+    out = lifecycle_leg(failures, args.tenants)
+    out["fleet_leg"] = fleet_leg(failures)
+    print(json.dumps(out))
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"catalog smoke OK: {out['tenants']} tenants cold-loaded in "
+        f"{out['bank_generations_built']} bank generation(s) "
+        f"({out['cold_load_wall_s']}s), mid-traffic streamed warm "
+        f"refresh (iters {out['warm_refit_iters'][:4]}...) + rollout "
+        f"with 0 failed requests, rejected path held, "
+        f"{out['compiles_after_warmup']} post-warmup compiles, "
+        f"sharded fleet held {out['fleet_leg']['held_per_replica']} "
+        f"with shard failover restage"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    t0 = time.perf_counter()
+    rc = main()
+    print(f"[catalog_smoke] wall {time.perf_counter() - t0:.1f}s")
+    sys.exit(rc)

@@ -144,6 +144,21 @@
 #                             census conserved across replica SIGKILL
 #                             + respawn and zero after close
 #                             (wire-speed transport PR).
+#   catalog_smoke.py        — tenant-lifecycle plane: a 10k-tenant
+#                             catalog published to a durable
+#                             CatalogStore (torn-manifest debris
+#                             skipped), cold-loaded onto a banked
+#                             engine in ONE bulk placement
+#                             (bank generations built counter-asserted
+#                             ≪ tenants), mid-traffic streamed
+#                             warm-refit cohort refresh + rollout with
+#                             0 failed requests, gate-rejected refresh
+#                             never reaches serving, 0 post-warmup
+#                             compiles, 3-replica bank-SHARDED
+#                             rollout_many (each replica holds a
+#                             strict catalog subset, every tenant
+#                             servable) with shard failover restage
+#                             (living-catalog PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
@@ -161,3 +176,4 @@ python build_tools/obs_smoke.py
 python build_tools/obs_fleet_smoke.py
 python build_tools/multitenant_smoke.py
 python build_tools/wirespeed_smoke.py
+python build_tools/catalog_smoke.py

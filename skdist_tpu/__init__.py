@@ -71,6 +71,8 @@ _EXPORTS = {
         "TPUBackend": "skdist_tpu.parallel",
         "ServingEngine": "skdist_tpu.serve",
         "ModelRegistry": "skdist_tpu.serve",
+        "CatalogStore": "skdist_tpu.catalog",
+        "RefreshJob": "skdist_tpu.catalog",
 }
 
 

@@ -1,0 +1,35 @@
+"""
+skdist_tpu.catalog: the tenant-lifecycle plane.
+
+Owns the loop the serving tier (PR-13/16) deliberately left out:
+train → publish → roll out → refresh → supersede, at catalog scale.
+
+- :mod:`~skdist_tpu.catalog.store` — :class:`CatalogStore`, the
+  durable, restart-survivable versioned model store (atomic
+  dir-per-version publishes, lineage manifests, torn-state tolerance,
+  pin/gc retention).
+- :mod:`~skdist_tpu.catalog.refresh` — :class:`RefreshJob`,
+  warm-started refits from fresh traffic published behind a quality
+  gate (a regressed refit is stored ``rejected``, never rolled out).
+- :mod:`~skdist_tpu.catalog.rollout` — :func:`cold_load` /
+  :func:`rollout_records`, bulk placement onto engines and fleets
+  (one bank generation per group, prewarm-before-swap, bank-aware
+  sharded routing on fleets).
+
+Lifecycle state machine (DESIGN.md "The living catalog"):
+``trained → gated → published → rolled-out → superseded``, with
+``rejected`` the gate's terminal siding.
+"""
+
+from .refresh import RefreshJob, RefreshResult
+from .rollout import cold_load, rollout_records
+from .store import CatalogRecord, CatalogStore
+
+__all__ = [
+    "CatalogStore",
+    "CatalogRecord",
+    "RefreshJob",
+    "RefreshResult",
+    "cold_load",
+    "rollout_records",
+]

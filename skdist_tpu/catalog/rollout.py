@@ -1,0 +1,135 @@
+"""
+Catalog → serving: bulk cold-load and mid-traffic rollout.
+
+The final leg of the lifecycle: published catalog versions become
+served tenants. Two entry points, both built on the serving tier's
+bulk staging (``register_many`` — K tenants behind ONE bank
+generation per bank group, prewarm-before-swap, atomic cutover):
+
+- :func:`cold_load` — bring a whole catalog (or a named subset) up on
+  an empty engine or fleet in one bulk placement per precision tier.
+  This is the restart path: a serving host reboots, the catalog
+  replays, ``serve.bank_rebuilds`` grows by the number of bank
+  GROUPS, not the number of tenants.
+
+- :func:`rollout_records` — push refreshed versions
+  (:class:`~skdist_tpu.catalog.refresh.RefreshResult` records, or raw
+  :class:`~skdist_tpu.catalog.store.CatalogRecord`) onto a serving
+  target mid-traffic. Rejected records are refused here AND invisible
+  to :meth:`CatalogStore.latest` — belt and braces: a gate-rejected
+  version cannot reach serving through any path in this module.
+
+Targets duck-type: a fleet exposing ``rollout_many`` (bank-aware
+sharded placement — ``ReplicaSet`` / ``ProcessReplicaSet``) or an
+engine/registry exposing ``register_many``. ``rollout_swap`` spans
+wrap every placement; ``catalog.bank_stagings`` counts the bulk
+stagings performed.
+"""
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+__all__ = ["cold_load", "rollout_records"]
+
+
+def _stagings_counter():
+    return obs_metrics.registry().counter(
+        "catalog.bank_stagings",
+        help="bulk bank stagings performed by catalog rollouts (one "
+             "per serve_dtype group per target placement — vs one per "
+             "TENANT on the per-model register path)",
+    )
+
+
+def cold_load(target, store, names=None, methods=("predict",),
+              serve_dtype=None, **rollout_kwargs):
+    """Bulk-load the newest published version of every catalog tenant
+    (or the ``names`` subset) onto ``target``. Models group by their
+    manifest's precision tier (``serve_dtype`` overrides it fleet-wide)
+    and each tier stages as ONE bulk placement. Extra keyword
+    arguments (``n_shards=``, ``replication=``) pass through to a
+    fleet's ``rollout_many``. Returns ``{name: result}`` where result
+    is the target's per-model handle (entry or version)."""
+    models = store.load_models(names=names)
+    if not models:
+        return {}
+    tiers = {}
+    for name, model in models:
+        tier = serve_dtype
+        if tier is None:
+            rec = store.latest(name)
+            tier = (rec.manifest.get("serve_dtype", "float32")
+                    if rec is not None else "float32")
+        tiers.setdefault(tier, []).append((name, model))
+    out = {}
+    for tier, group in sorted(tiers.items()):
+        out.update(_stage(target, group, methods, tier,
+                          **rollout_kwargs))
+    return out
+
+
+def rollout_records(target, store, records, methods=("predict",),
+                    **rollout_kwargs):
+    """Roll explicit catalog records (refresh results included) onto
+    ``target`` mid-traffic. Records whose status is not ``published``
+    are skipped — the gate already stored them as rejected, and this
+    is the second lock on the door. Returns ``{spec: result}`` for
+    the records actually rolled out."""
+    recs = []
+    for r in records:
+        rec = getattr(r, "record", r)   # RefreshResult -> its record
+        if rec is None or isinstance(rec, Exception):
+            continue
+        if rec.status != "published":
+            continue
+        recs.append(rec)
+    if not recs:
+        return {}
+    tiers = {}
+    for rec in recs:
+        model, _ = store.get(rec.name, rec.version)
+        tiers.setdefault(
+            rec.manifest.get("serve_dtype", "float32"), []
+        ).append((rec.name, model))
+    out = {}
+    for tier, group in sorted(tiers.items()):
+        staged = _stage(target, group, methods, tier, **rollout_kwargs)
+        for name, result in staged.items():
+            out[name] = result
+    return out
+
+
+def _stage(target, models, methods, serve_dtype, **rollout_kwargs):
+    """One bulk placement of ``[(name, model), ...]`` on ``target``,
+    dispatching on its surface; returns ``{name: result}``."""
+    rollout_many = getattr(target, "rollout_many", None)
+    if callable(rollout_many):
+        # fleets emit their own rollout_swap span (it wraps the
+        # per-replica placements individually)
+        results = rollout_many(models, methods=methods,
+                               serve_dtype=serve_dtype,
+                               **rollout_kwargs)
+        _stagings_counter().inc()
+        return {name: res for (name, _), res in zip(models, results)}
+    register_many = getattr(target, "register_many", None)
+    if callable(register_many):
+        if rollout_kwargs:
+            raise TypeError(
+                f"{type(target).__name__}.register_many takes no "
+                f"placement options {sorted(rollout_kwargs)} — those "
+                "are fleet (rollout_many) arguments"
+            )
+        with obs_trace.span(
+            "rollout_swap",
+            {"models": len(models), "serve_dtype": str(serve_dtype)}
+            if obs_trace.enabled() else None,
+        ):
+            entries = register_many(models, methods=methods,
+                                    serve_dtype=serve_dtype)
+        _stagings_counter().inc()
+        return {name: e for (name, _), e in zip(models, entries)}
+    raise TypeError(
+        f"{type(target).__name__} exposes neither rollout_many nor "
+        "register_many — pass a ServingEngine, ModelRegistry, "
+        "ReplicaSet, or ProcessReplicaSet"
+    )

@@ -1,0 +1,361 @@
+"""
+Durable versioned model store: the catalog's source of truth.
+
+The serving tier (PR-13/16) made "thousands of tenants on one mesh"
+cheap to SERVE; this module makes the tenant population durable. The
+reference world kept its periodically-retrained sk-dist models in a
+blob store keyed by model name and date partition — restartable by
+convention, not by contract. Here the contract is explicit:
+
+- **dir-per-version, atomic publish**: a version is
+  ``catalog_dir/<name>/<version>/`` holding ``model.pkl`` and
+  ``manifest.json``. Both are written into a staging directory first
+  and moved into place with one ``os.replace`` — a version either
+  exists completely or not at all. SIGKILL mid-``put`` leaves only a
+  staging orphan (swept by :meth:`CatalogStore.gc`), never a
+  half-published version.
+
+- **torn state is skipped, not fatal**: a version directory whose
+  manifest is missing, truncated, or unparseable (a non-atomic copy,
+  a bad disk, an interrupted backup restore) is invisible to
+  :meth:`versions`/:meth:`get`/:meth:`latest`. A 100k-tenant catalog
+  must cold-load past one corrupt tenant, not die on it.
+
+- **manifest carries lineage**: params digest (sha256 of the pickled
+  model, verified on :meth:`get`), serving precision tier, training
+  provenance, the parent version a refresh warm-started from, and a
+  ``status`` — ``published`` versions are servable; ``rejected``
+  versions (a refresh that failed its quality gate) are stored for
+  forensics but never resolved by :meth:`latest`/:meth:`get`-latest,
+  so they can never reach a serving fleet through the rollout path.
+
+- **retention is explicit**: :meth:`pin` exempts a version from
+  :meth:`gc(keep_n) <gc>`, which otherwise keeps the newest ``keep_n``
+  published versions per tenant and deletes the rest.
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+
+__all__ = ["CatalogStore", "CatalogRecord", "MANIFEST_FORMAT"]
+
+#: manifest schema version — bump on incompatible layout changes
+MANIFEST_FORMAT = 1
+
+_MANIFEST = "manifest.json"
+_MODEL = "model.pkl"
+_PINNED = "PINNED"
+_STAGING = ".staging"
+
+
+class CatalogRecord:
+    """One published (or rejected) version: name, version, manifest,
+    and the directory that holds it."""
+
+    __slots__ = ("name", "version", "path", "manifest")
+
+    def __init__(self, name, version, path, manifest):
+        self.name = name
+        self.version = int(version)
+        self.path = path
+        self.manifest = manifest
+
+    @property
+    def spec(self):
+        return f"{self.name}@{self.version}"
+
+    @property
+    def status(self):
+        return self.manifest.get("status", "published")
+
+    def __repr__(self):
+        return (f"CatalogRecord({self.spec!r}, "
+                f"status={self.status!r})")
+
+
+class CatalogStore:
+    """Durable, restart-survivable versioned model store (module
+    docstring). Safe for concurrent writers in one process; atomic
+    renames keep concurrent READERS safe across processes too."""
+
+    def __init__(self, catalog_dir):
+        self.catalog_dir = str(catalog_dir)
+        os.makedirs(os.path.join(self.catalog_dir, _STAGING),
+                    exist_ok=True)
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+    def put(self, name, model, version=None, parent_version=None,
+            serve_dtype="float32", provenance=None, status="published"):
+        """Publish one version atomically; returns its
+        :class:`CatalogRecord`. ``version=None`` assigns the next
+        number after every version currently on disk (valid or
+        pinned); an explicit version that already exists raises —
+        versions are immutable, like the serving registry's."""
+        name = self._check_name(name)
+        if status not in ("published", "rejected"):
+            raise ValueError(
+                f"status must be 'published' or 'rejected'; got "
+                f"{status!r}"
+            )
+        blob = pickle.dumps(model, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = "sha256:" + hashlib.sha256(blob).hexdigest()
+        if version is None:
+            have = self._version_dirs(name)
+            version = (max(have) + 1) if have else 1
+        version = int(version)
+        final = self._vdir(name, version)
+        if os.path.exists(final):
+            raise ValueError(
+                f"{name}@{version} already exists in the catalog; "
+                "versions are immutable — put a new one"
+            )
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "name": name,
+            "version": version,
+            "digest": digest,
+            "serve_dtype": serve_dtype,
+            "status": status,
+            "parent_version": (None if parent_version is None
+                               else int(parent_version)),
+            "provenance": dict(provenance or {}),
+            "created_at": time.time(),
+        }
+        stage = tempfile.mkdtemp(
+            prefix=f"{name}@{version}.",
+            dir=os.path.join(self.catalog_dir, _STAGING),
+        )
+        try:
+            with open(os.path.join(stage, _MODEL), "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            with open(os.path.join(stage, _MANIFEST), "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.makedirs(os.path.dirname(final), exist_ok=True)
+            # the atomic publish: the version appears complete or not
+            # at all (os.replace of a directory is atomic on POSIX)
+            os.replace(stage, final)
+        except BaseException:
+            shutil.rmtree(stage, ignore_errors=True)
+            raise
+        return CatalogRecord(name, version, final, manifest)
+
+    def put_many(self, models, **common):
+        """Bulk :meth:`put` of ``(name, model)`` pairs (or a dict)
+        with shared keyword arguments; returns the records in input
+        order. Each version still publishes atomically — a failure
+        mid-batch leaves the earlier versions published (they are
+        independently valid), and raises."""
+        items = list(models.items()) if isinstance(models, dict) \
+            else list(models)
+        return [self.put(name, model, **common) for name, model in items]
+
+    def pin(self, name, version):
+        """Exempt ``name@version`` from :meth:`gc` (marker file — the
+        manifest stays immutable)."""
+        path = self._vdir(self._check_name(name), int(version))
+        if self._load_manifest(path) is None:
+            raise KeyError(f"{name}@{version} is not in the catalog")
+        with open(os.path.join(path, _PINNED), "w") as f:
+            f.write(str(time.time()))
+
+    def unpin(self, name, version):
+        path = self._vdir(self._check_name(name), int(version))
+        try:
+            os.unlink(os.path.join(path, _PINNED))
+        except FileNotFoundError:
+            pass
+
+    def pinned(self, name, version):
+        return os.path.exists(
+            os.path.join(self._vdir(name, int(version)), _PINNED)
+        )
+
+    def gc(self, keep_n=3, name=None):
+        """Retention: per tenant, keep the newest ``keep_n`` PUBLISHED
+        versions plus every pinned version; delete the rest (old
+        published versions, stale rejected versions, and torn version
+        directories that never finished publishing). Also sweeps
+        staging orphans from killed writers. Returns the removed
+        ``(name, version)`` pairs."""
+        keep_n = max(0, int(keep_n))
+        removed = []
+        for n in ([self._check_name(name)] if name is not None
+                  else self.names(all_statuses=True)):
+            base = os.path.join(self.catalog_dir, n)
+            published = []
+            others = []
+            for v in self._version_dirs(n):
+                man = self._load_manifest(self._vdir(n, v))
+                if man is not None and man.get("status",
+                                               "published") == "published":
+                    published.append(v)
+                else:
+                    others.append(v)  # rejected or torn
+            published.sort(reverse=True)
+            keep = set(published[:keep_n])
+            for v in sorted(published[keep_n:] + others):
+                if v in keep or self.pinned(n, v):
+                    continue
+                shutil.rmtree(self._vdir(n, v), ignore_errors=True)
+                removed.append((n, v))
+            if not self._version_dirs(n):
+                shutil.rmtree(base, ignore_errors=True)
+        staging = os.path.join(self.catalog_dir, _STAGING)
+        for ent in os.listdir(staging):
+            shutil.rmtree(os.path.join(staging, ent),
+                          ignore_errors=True)
+        return removed
+
+    # ------------------------------------------------------------------
+    # read path
+    # ------------------------------------------------------------------
+    def names(self, all_statuses=False):
+        """Tenant names with at least one published version (every
+        valid version with ``all_statuses=True``), sorted."""
+        out = []
+        try:
+            entries = sorted(os.listdir(self.catalog_dir))
+        except FileNotFoundError:
+            return []
+        for n in entries:
+            if n == _STAGING:
+                continue
+            if self.versions(n, all_statuses=all_statuses):
+                out.append(n)
+        return out
+
+    def versions(self, name, all_statuses=True):
+        """Valid version numbers for ``name``, ascending. Directories
+        with a missing/torn/unparseable manifest are skipped — torn
+        state is invisible, never fatal. ``all_statuses=False``
+        restricts to published versions."""
+        out = []
+        for v in self._version_dirs(name):
+            man = self._load_manifest(self._vdir(name, v))
+            if man is None:
+                continue
+            if (not all_statuses
+                    and man.get("status", "published") != "published"):
+                continue
+            out.append(v)
+        return sorted(out)
+
+    def latest(self, name):
+        """The newest PUBLISHED record for ``name`` (rejected versions
+        never resolve here — the gate's storage-only verdict), or
+        ``None``."""
+        vs = self.versions(name, all_statuses=False)
+        if not vs:
+            return None
+        return self.record(name, vs[-1])
+
+    def record(self, name, version):
+        """The :class:`CatalogRecord` for one exact version (any
+        status); raises ``KeyError`` if absent or torn."""
+        path = self._vdir(self._check_name(name), int(version))
+        man = self._load_manifest(path)
+        if man is None:
+            raise KeyError(f"{name}@{version} is not in the catalog")
+        return CatalogRecord(name, int(version), path, man)
+
+    def get(self, name, version=None, verify=True):
+        """Load ``(model, record)``. ``version=None`` resolves the
+        newest published version; an explicit version loads any
+        status (forensics on rejected versions included). ``verify``
+        checks the pickled bytes against the manifest digest — a
+        silently corrupted blob must not deserialize into serving."""
+        if version is None:
+            rec = self.latest(name)
+            if rec is None:
+                raise KeyError(
+                    f"{name} has no published version in the catalog"
+                )
+        else:
+            rec = self.record(name, version)
+        with open(os.path.join(rec.path, _MODEL), "rb") as f:
+            blob = f.read()
+        if verify:
+            digest = "sha256:" + hashlib.sha256(blob).hexdigest()
+            if digest != rec.manifest.get("digest"):
+                raise ValueError(
+                    f"{rec.spec}: model blob digest {digest} does not "
+                    f"match its manifest "
+                    f"({rec.manifest.get('digest')}) — the stored "
+                    "params are corrupt; restore from a replica or gc "
+                    "the version"
+                )
+        return pickle.loads(blob), rec
+
+    def load_models(self, names=None):
+        """``[(name, model), ...]`` for the newest published version
+        of each tenant — the bulk cold-load feed for
+        :func:`~skdist_tpu.catalog.rollout.cold_load`. Tenants with no
+        published version are skipped."""
+        out = []
+        for n in (self.names() if names is None else names):
+            try:
+                model, _ = self.get(n)
+            except KeyError:
+                continue
+            out.append((n, model))
+        return out
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _check_name(self, name):
+        name = str(name)
+        if (not name or name.startswith(".") or "/" in name
+                or "\\" in name or "@" in name):
+            raise ValueError(
+                f"catalog name {name!r} must be non-empty and contain "
+                "no '/', '\\\\', '@', or leading '.'"
+            )
+        return name
+
+    def _vdir(self, name, version):
+        return os.path.join(self.catalog_dir, name, str(int(version)))
+
+    def _version_dirs(self, name):
+        """Every numeric version directory on disk (valid or torn)."""
+        base = os.path.join(self.catalog_dir, str(name))
+        try:
+            entries = os.listdir(base)
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+        out = []
+        for ent in entries:
+            try:
+                out.append(int(ent))
+            except ValueError:
+                continue
+        return out
+
+    @staticmethod
+    def _load_manifest(path):
+        """The torn-state gate: any failure to read/parse/validate the
+        manifest makes the version invisible (``None``), never an
+        exception — crash debris must not take the catalog down."""
+        try:
+            with open(os.path.join(path, _MANIFEST)) as f:
+                man = json.load(f)
+            if not isinstance(man, dict):
+                return None
+            if int(man.get("format", -1)) > MANIFEST_FORMAT:
+                return None  # from a future writer we cannot trust
+            if not os.path.exists(os.path.join(path, _MODEL)):
+                return None
+            return man
+        except (OSError, ValueError):
+            return None

@@ -389,7 +389,17 @@ class _LinearModelBase(BaseEstimator):
     _stream_fit_kind = None
 
     # ---- host-facing API -------------------------------------------------
-    def fit(self, X, y=None, sample_weight=None):
+    def fit(self, X, y=None, sample_weight=None, coef_init=None,
+            intercept_init=None):
+        """Fit. ``coef_init``/``intercept_init`` (sklearn shapes — a
+        parent fit's ``coef_``/``intercept_``) warm-start the
+        iterative families' solver carry: the L-BFGS and SGD solves
+        start from the seed instead of zeros, so a refit on drifted
+        data converges in a fraction of the cold iterations (the
+        catalog refresh loop's public seeding surface). Closed-form
+        families (the ridge/OLS direct solve) accept the seeds and
+        ignore them — a direct solve has no iterate to seed, and
+        accepting keeps cohort refresh generic across families."""
         from ..data import is_chunked
 
         if is_chunked(X):
@@ -398,7 +408,10 @@ class _LinearModelBase(BaseEstimator):
             # (or come explicitly) as O(n) host vectors
             from .streaming import stream_fit_estimator
 
-            return stream_fit_estimator(self, X, y, sample_weight)
+            return stream_fit_estimator(
+                self, X, y, sample_weight,
+                coef_init=coef_init, intercept_init=intercept_init,
+            )
         if y is None:
             raise TypeError(
                 f"{type(self).__name__}.fit requires y (only a "
@@ -414,16 +427,99 @@ class _LinearModelBase(BaseEstimator):
             X = as_dense_f32(X)
         else:
             X = prepare_fit_X(X, type(self))
+        warm = coef_init is not None or intercept_init is not None
         if not isinstance(X, PackedX) and self._resolve_host_engine():
+            if warm:
+                # the host engines already honour a flat `_warm_w0`
+                # seed (the warm C-path runner's seam); scoped so a
+                # later cold fit never inherits this one's seed
+                self._warm_w0 = self._warm_w0_flat(
+                    X.shape[1], self._warm_n_out(y),
+                    coef_init, intercept_init,
+                ).astype(np.float64)
+                try:
+                    return self._host_fit(X, y, sample_weight)
+                finally:
+                    del self._warm_w0
             return self._host_fit(X, y, sample_weight)
         data, meta = self._prep_fit_data(X, y, sample_weight)
         static = self._static_config(meta)
         hyper = {k: jnp.asarray(hyper_float(getattr(self, k)))
                  for k in self._hyper_names}
         kernel = get_kernel(type(self), "fit", meta, _freeze(static))
-        params = kernel(data["X"], data["y"], data["sw"], hyper)
+        if warm:
+            k = meta.get("n_classes", 2)
+            w0 = self._warm_w0_flat(
+                meta["n_features"], 1 if k <= 2 else k,
+                coef_init, intercept_init,
+            )
+            params = kernel(data["X"], data["y"], data["sw"], hyper,
+                            {"w0": jnp.asarray(w0)})
+        else:
+            params = kernel(data["X"], data["y"], data["sw"], hyper)
         self._set_fitted(params, meta)
         return self
+
+    def _warm_n_out(self, y):
+        """Solver output columns for warm-seed shaping, before meta
+        exists: classifiers fold binary to one column (the families'
+        flat layout), regressors are single-output."""
+        if isinstance(self, ClassifierMixin):
+            k = int(np.unique(np.asarray(y)).size)
+            return 1 if k <= 2 else k
+        return 1
+
+    def _warm_w0_flat(self, d, n_out, coef_init, intercept_init):
+        """Map sklearn-shaped warm-start seeds (a parent fit's
+        ``coef_``/``intercept_``) onto the family's flat solver
+        layout: ``W`` is ``(p, n_out)`` with rows ``[:d]`` the
+        coefficients and row ``d`` the intercept (when fitted),
+        flattened to ``(p,)`` single-output / ``(p*n_out,)``
+        multiclass — exactly the layout ``unpack`` reshapes and the
+        host engines' ``x0`` consumes."""
+        fit_intercept = self._fit_intercept_flag()
+        d = int(d)
+        n_out = int(n_out)
+        p = d + (1 if fit_intercept else 0)
+        W = np.zeros((p, n_out), np.float32)
+        if coef_init is not None:
+            coef = np.asarray(coef_init, np.float32)
+            if n_out == 1:
+                coef = coef.reshape(-1)
+                if coef.shape[0] != d:
+                    raise ValueError(
+                        f"coef_init has {coef.shape[0]} features; the "
+                        f"fit data has {d}"
+                    )
+                W[:d, 0] = coef
+            elif coef.shape == (n_out, d):
+                W[:d] = coef.T
+            elif coef.shape == (d, n_out):
+                W[:d] = coef
+            else:
+                raise ValueError(
+                    f"coef_init shape {coef.shape} does not match "
+                    f"({n_out}, {d}) (classes x features)"
+                )
+        if intercept_init is not None:
+            b = np.asarray(intercept_init, np.float32).reshape(-1)
+            if not fit_intercept:
+                if np.any(b != 0):
+                    raise ValueError(
+                        "intercept_init is nonzero but "
+                        "fit_intercept=False — this family fits no "
+                        "intercept to seed"
+                    )
+            else:
+                if b.shape[0] == 1 and n_out > 1:
+                    b = np.repeat(b, n_out)
+                if b.shape[0] != n_out:
+                    raise ValueError(
+                        f"intercept_init has {b.shape[0]} entries; "
+                        f"expected {n_out}"
+                    )
+                W[d] = b
+        return W.reshape(-1) if n_out > 1 else W[:, 0]
 
     def _resolve_host_engine(self):
         """True when this host-side fit should run the f64 BLAS engine
@@ -683,6 +779,10 @@ class _LbfgsFitMixin:
 
         def kernel(X, y_idx, sw, hyper, aux=None):
             loss, w0, unpack = problem(X, y_idx, sw, hyper)
+            if aux is not None and "w0" in aux:
+                # warm start: the solve begins at the caller's seed
+                # (a parent fit's coefficients in the flat layout)
+                w0 = jnp.asarray(aux["w0"], w0.dtype).reshape(w0.shape)
             w, n_iter = lbfgs_minimize(loss, w0, max_iter=max_iter,
                                        tol=hyper["tol"], history=hist)
             return unpack(w, n_iter)
@@ -1411,8 +1511,12 @@ class SGDClassifier(_LinearClassifierBase):
 
         def kernel(X, y_idx, sw, hyper, aux=None):
             pb = problem(X, y_idx, sw, hyper)
+            W0 = pb["W0"]
+            if aux is not None and "w0" in aux:
+                # warm start: epochs begin at the caller's seed
+                W0 = jnp.asarray(aux["w0"], W0.dtype).reshape(W0.shape)
             W, n_epochs = sgd_minimize(
-                pb["grad_fn"], pb["W0"], pb["n"], pb["key"], max_iter,
+                pb["grad_fn"], W0, pb["n"], pb["key"], max_iter,
                 batch_size, pb["lr_fn"], shuffle=shuffle,
                 loss_fn=pb["loss_fn"],
                 tol=hyper["tol"], n_iter_no_change=n_iter_no_change,
@@ -1602,9 +1706,11 @@ class LinearRegression(Ridge):
         self.fit_intercept = fit_intercept
         self.alpha = 0.0
 
-    def fit(self, X, y=None, sample_weight=None):
+    def fit(self, X, y=None, sample_weight=None, coef_init=None,
+            intercept_init=None):
         self.alpha = 0.0
-        return super().fit(X, y, sample_weight)
+        return super().fit(X, y, sample_weight, coef_init=coef_init,
+                           intercept_init=intercept_init)
 
     @classmethod
     def _build_fit_kernel(cls, meta, static):

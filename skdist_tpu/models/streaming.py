@@ -483,7 +483,8 @@ def _zero_block_dev(plan, dataset, row_arrays, extra_scalars=()):
 
 
 def _fit_lbfgs_stream(backend, est_cls, meta, static, dataset, row_arrays,
-                      task_args, derive, stats, sync, key_extra=()):
+                      task_args, derive, stats, sync, key_extra=(),
+                      w_init=None):
     st = dict(static)
     max_iter, history = int(st["max_iter"]), int(st["history"])
     width = est_cls._flat_w_width(meta, static)
@@ -540,6 +541,11 @@ def _fit_lbfgs_stream(backend, est_cls, meta, static, dataset, row_arrays,
         return np.asarray(acc["f"]) + np.asarray(reg["f"])
 
     w0 = np.zeros((Tp, width), np.float32)
+    if w_init is not None:
+        # warm start: real lanes begin at the caller's (T, width)
+        # seeds; padded lanes stay zero (sliced off below either way)
+        wi = np.asarray(w_init, np.float32).reshape(T, width)
+        w0[:T] = wi
     tol = np.asarray(task_args["hyper"]["tol"], np.float32)
     W, n_iter, _done = lbfgs_stream(
         eval_fg, eval_f, w0, tol, max_iter, history=history,
@@ -551,9 +557,12 @@ def _fit_lbfgs_stream(backend, est_cls, meta, static, dataset, row_arrays,
 
 
 def _fit_gram_stream(backend, est_cls, meta, static, dataset, row_arrays,
-                     task_args, derive, stats, sync, key_extra=()):
+                     task_args, derive, stats, sync, key_extra=(),
+                     w_init=None):
     """Block-accumulated normal equations for the ridge family: stream
-    ``(XᵀSX, XᵀST)`` partials, finish with one solve per task."""
+    ``(XᵀSX, XᵀST)`` partials, finish with one solve per task.
+    ``w_init`` is accepted and ignored — a direct solve has no
+    iterate to seed."""
     from .linear import (
         _apply_class_weight, _linear_op, maybe_exact_matmuls,
     )
@@ -637,7 +646,8 @@ def _fit_gram_stream(backend, est_cls, meta, static, dataset, row_arrays,
 
 
 def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
-                    task_args, derive, stats, sync, key_extra=()):
+                    task_args, derive, stats, sync, key_extra=(),
+                    w_init=None):
     """Epochs as block streams: visit blocks in order, advance the
     mini-batch carry through the resident scan's exact update
     (``solvers.sgd_batch_scan``), apply the epoch-end early-stopping
@@ -758,8 +768,12 @@ def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
                    np.zeros((Tp, width), np.float32))
     else:
         pstate0 = ()
+    w0 = np.zeros((Tp, width), np.float32)
+    if w_init is not None:
+        # warm start: epochs begin at the caller's (T, width) seeds
+        w0[:T] = np.asarray(w_init, np.float32).reshape(T, width)
     carry = plan.put_task({
-        "w": np.zeros((Tp, width), np.float32),
+        "w": w0,
         "pstate": pstate0,
         "step": np.zeros(Tp, np.int32),
         "acc": np.zeros(Tp, np.float32),
@@ -906,14 +920,17 @@ def _stack_params(params_list):
 
 def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
                      task_args, derive=None, sync=None, stats=None,
-                     key_extra=()):
+                     key_extra=(), w_init=None):
     """Fit a batch of tasks over a ChunkedDataset with the family's
     streamed driver. ``row_arrays`` maps per-row vector names (``y``
     encoded labels, ``sw`` weights, ``fold`` CV fold ids, ...) to
     ``(n_rows,)`` host arrays sliced per block; ``derive(block, task)
     -> (Xb, yb, swb, hyper)`` adapts a placed block + one task lane to
     the family's fit problem (fold masking, OvR binarisation).
-    Returns a dict of stacked ``(T, ...)`` fitted params."""
+    ``w_init`` (``(T, width)`` flat-layout seeds) warm-starts the
+    iterative drivers' solver carries (the gram driver's direct solve
+    ignores it). Returns a dict of stacked ``(T, ...)`` fitted
+    params."""
     kind = getattr(est_cls, "_stream_fit_kind", None)
     if kind is None:
         raise TypeError(
@@ -932,7 +949,8 @@ def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
     }[kind]
     stats["tasks"] = stats.get("tasks", 0) + _n_tasks(task_args)
     out = driver(backend, est_cls, meta, static, dataset, row_arrays,
-                 task_args, derive, stats, sync, key_extra=key_extra)
+                 task_args, derive, stats, sync, key_extra=key_extra,
+                 w_init=w_init)
     # delta-publication (publish_round_stats): safe on a shared/
     # re-published dict — the CV driver hands this same dict to
     # stream_scores, whose own publish folds only the scoring pass
@@ -1039,11 +1057,14 @@ def stream_scores(backend, est_cls, meta, static, dataset, row_arrays,
 # ---------------------------------------------------------------------------
 
 def stream_fit_estimator(est, dataset, y=None, sample_weight=None,
-                         backend=None):
+                         backend=None, coef_init=None,
+                         intercept_init=None):
     """``estimator.fit(ChunkedDataset)``: the out-of-core fit of one
     estimator — labels/weights from the dataset (or passed explicitly),
     blocks streamed through the double-buffered pipeline, fitted state
-    set exactly like a resident fit."""
+    set exactly like a resident fit. ``coef_init``/``intercept_init``
+    (sklearn shapes) warm-start the iterative families' solver
+    carries — the catalog refresh loop's streamed warm-refit seam."""
     from ..parallel import resolve_backend
     from .linear import _freeze, hyper_float
 
@@ -1073,8 +1094,16 @@ def stream_fit_estimator(est, dataset, y=None, sample_weight=None,
             [hyper_float(est.alpha)], np.float32
         )
     row_arrays = {"y": y_enc, "sw": sw}
+    w_init = None
+    if coef_init is not None or intercept_init is not None:
+        k = meta.get("n_classes", 2)
+        w_init = est._warm_w0_flat(
+            meta["n_features"], 1 if k <= 2 else k,
+            coef_init, intercept_init,
+        )[None]
     params = stream_fit_tasks(
         backend, est_cls, meta, static, dataset, row_arrays, task_args,
+        w_init=w_init,
     )
     est._set_fitted(
         {k: np.asarray(v)[0] for k, v in params.items()}, meta

@@ -264,6 +264,8 @@ FAULT_COUNTERS = (
     "elastic_tasks_salvaged",  # tasks NOT re-run across an elastic
                                # shrink (journaled/gathered prefix)
     "replica_failovers",    # requests re-routed off a sick replica
+    "shard_restages",       # catalog shards re-staged on a new holder
+                            # after every assigned holder went down
     "replica_respawns",     # serving replicas drained + respawned
     "replica_proc_restarts",  # replica CHILD PROCESSES respawned by
                               # the procfleet supervisor

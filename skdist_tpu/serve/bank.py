@@ -223,6 +223,60 @@ class ParameterBank:
                 raise
             return slot
 
+    def add_members(self, items, prewarm=True):
+        """Bulk staging: stage ``items`` (an iterable of ``(spec,
+        plans)`` pairs) behind ONE generation build + swap, however
+        many tenants arrive. This is the catalog cold-load/refresh
+        path — ``add_member`` in a loop builds (and prewarms) K
+        generations for K tenants; this builds exactly one, so a
+        10k-tenant catalog costs one stack, one placement, one
+        prewarm. Returns ``{spec: slot}``. All-or-nothing: a staging
+        failure rolls every member of the batch back."""
+        items = list(items)
+        if not items:
+            return {}
+        with self._lock:
+            seen = set()
+            for spec, _ in items:
+                if spec in self._members:
+                    raise ValueError(f"{spec} is already in {self.name}")
+                if spec in seen:
+                    raise ValueError(
+                        f"{spec} appears twice in one add_members batch"
+                    )
+                seen.add(spec)
+            snapshot = (self.capacity, self._high, list(self._free))
+            slots = {}
+            grew = False
+            for spec, plans in items:
+                if self._free:
+                    slot = self._free.pop(0)
+                else:
+                    slot = self._high
+                    self._high += 1
+                grew = grew or slot >= self.capacity
+                self._members[spec] = slot
+                self._member_plans[spec] = dict(plans)
+                slots[spec] = slot
+            if grew:
+                self.capacity = _capacity_for(self._high)
+            try:
+                self._rebuild(
+                    "bulk" if len(items) > 1
+                    else ("grow" if grew else "publish"),
+                    prewarm=prewarm,
+                    changed_specs=None if grew else tuple(slots),
+                )
+            except BaseException:
+                for spec in slots:
+                    self._members.pop(spec, None)
+                    self._member_plans.pop(spec, None)
+                self.capacity, self._high, self._free = (
+                    snapshot[0], snapshot[1], snapshot[2],
+                )
+                raise
+            return slots
+
     def remove_member(self, spec):
         """Drop ``spec``: its slot becomes a hole (params unreachable —
         device bytes release at the next compaction), and a generation
@@ -348,23 +402,27 @@ class ParameterBank:
                 dst[slot] = np.asarray(src)
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    def _rebuild(self, reason, prewarm=True, changed_spec=None):
+    def _rebuild(self, reason, prewarm=True, changed_spec=None,
+                 changed_specs=None):
         """Build + publish the next generation: stack at the current
         capacity, place on device, prewarm every slot bucket, swap.
-        Caller holds the bank lock. When only ``changed_spec`` differs
-        from the previous generation at UNCHANGED capacity, the stack
-        is the previous host arrays copied with that one slot
-        rewritten (O(capacity) bytes, no per-member walk); capacity
-        changes and compactions restack every member. Same-capacity
-        rebuilds are compile-free by construction (the jit entry is
-        memoised on the structural banked key; the AOT executables key
-        on shapes that did not change)."""
+        Caller holds the bank lock. When only ``changed_spec`` (or the
+        ``changed_specs`` batch) differs from the previous generation
+        at UNCHANGED capacity, the stack is the previous host arrays
+        copied with those slots rewritten (O(capacity + K) bytes, no
+        per-member walk); capacity changes and compactions restack
+        every member. Same-capacity rebuilds are compile-free by
+        construction (the jit entry is memoised on the structural
+        banked key; the AOT executables key on shapes that did not
+        change)."""
         import jax
 
         slot_of = dict(self._members)
         prev = self.current
+        if changed_spec is not None:
+            changed_specs = (changed_spec,)
         incremental = (
-            changed_spec is not None and prev is not None
+            changed_specs is not None and prev is not None
             and prev.capacity == self.capacity
             and prev.host_stacked is not None
         )
@@ -375,18 +433,18 @@ class ParameterBank:
 
         for method in self._ref_plans:
             if incremental:
-                slot = slot_of[changed_spec]
                 leaves, treedef = jax.tree_util.tree_flatten(
                     prev.host_stacked[method]
                 )
-                member = jax.tree_util.tree_leaves(
-                    self._member_plans[changed_spec][method].params
-                )
-                out = []
-                for dst, src in zip(leaves, member):
-                    dst = dst.copy()  # copy-on-publish: the previous
-                    dst[slot] = np.asarray(src)  # gen stays immutable
-                    out.append(dst)
+                # copy-on-publish: the previous gen stays immutable
+                out = [dst.copy() for dst in leaves]
+                for spec in changed_specs:
+                    slot = slot_of[spec]
+                    member = jax.tree_util.tree_leaves(
+                        self._member_plans[spec][method].params
+                    )
+                    for dst, src in zip(out, member):
+                        dst[slot] = np.asarray(src)
                 stacked = jax.tree_util.tree_unflatten(treedef, out)
             else:
                 stacked = self._stack(method, slot_of)

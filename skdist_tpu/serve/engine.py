@@ -194,6 +194,27 @@ class ServingEngine:
             self._stats.mark_warm()
         return entry
 
+    def register_many(self, models, methods=("predict",), prewarm=True,
+                      serve_dtype="float32", quant_parity_bound=None,
+                      bank_rows_per_slot=None, versions=None):
+        """Bulk registration: K models staged behind ONE bank
+        generation per bank group instead of K (see
+        ``ModelRegistry.register_many``) — the catalog cold-load /
+        refresh-rollout path. Runs under this engine's compile scope
+        and moves the warm mark once, after the whole batch's prewarm.
+        Returns the published entries in input order."""
+        with obs_metrics.compile_scope(self._stats.scope):
+            entries = self.registry.register_many(
+                models, methods=methods, prewarm=prewarm,
+                serve_dtype=serve_dtype,
+                quant_parity_bound=quant_parity_bound,
+                bank_rows_per_slot=bank_rows_per_slot,
+                versions=versions,
+            )
+        if prewarm:
+            self._stats.mark_warm()
+        return entries
+
     def unregister(self, name, version=None, drain=True, timeout=30.0):
         """Unload a model version (all versions with ``version=None``):
         closes (draining by default) and discards its batchers, then

@@ -117,7 +117,12 @@ from .batcher import (
     Overloaded,
     ServingError,
 )
-from .replicaset import AllReplicasUnhealthy, fleet_by_model
+from .replicaset import (
+    AllReplicasUnhealthy,
+    _rendezvous_holders,
+    _stable_hash,
+    fleet_by_model,
+)
 from .shm import DEFAULT_SLOT_BYTES, DEFAULT_SLOTS, ShmRing, shm_enabled
 
 __all__ = [
@@ -520,6 +525,11 @@ class ProcessReplicaSet:
         #: versions as the PARENT assigned them, replayed verbatim into
         #: every respawned generation
         self._published = {}
+        #: bank-aware routing map, same contract as
+        #: ReplicaSet._shard_of/_shard_holders (see rollout_many)
+        self._shard_of = {}
+        self._shard_holders = {}
+        self._n_shards = 0
         self.events = []
         self._replicas = [_ProcReplica(i) for i in range(int(n_replicas))]
         self._executor = ThreadPoolExecutor(
@@ -725,11 +735,136 @@ class ProcessReplicaSet:
                 raise
             with self._lock:
                 self._published.setdefault(name, []).append(rec)
+                # a fleet-wide rollout puts the name on EVERY replica,
+                # so any earlier shard restriction no longer applies
+                self._shard_of.pop(name, None)
         self._event("rollout", None, name=name, version=version,
                     serve_dtype=serve_dtype)
         return version
 
     register = rollout
+
+    def rollout_many(self, models, methods=("predict",),
+                     serve_dtype="float32", n_shards=None,
+                     replication=1):
+        """Bulk catalog rollout with bank-aware sharding, the
+        cross-process mirror of ``ReplicaSet.rollout_many``: the
+        PARENT assigns version numbers and the tenant→shard→holders
+        map (stable-hash shards, rendezvous-hashed holders), and each
+        holder WORKER stages its whole subset behind one bank
+        generation per bank group (the ``register_many`` worker op —
+        one RPC carrying the cohort, not one per tenant). Routing for
+        sharded models restricts to holders; a shard whose holders are
+        all down is re-staged on another live worker
+        (:meth:`_restage_shard`) while the supervisor respawns the
+        holders with their original subsets. ``n_shards=None``
+        defaults to one shard per live replica; ``n_shards=1``
+        degenerates to replicate-everywhere bulk load. Returns the
+        fleet-assigned versions in input order; a worker failing
+        mid-rollout rolls back the staged workers and raises without
+        publishing."""
+        if self._closed:
+            raise ServingError("replica set is closed")
+        items = list(models.items()) if isinstance(models, dict) \
+            else list(models)
+        if not items:
+            return []
+        methods = (methods,) if isinstance(methods, str) \
+            else tuple(methods)
+        with self._respawn_lock:
+            live = [r for r in self._replicas
+                    if r.alive and not r.draining]
+            if not live:
+                raise AllReplicasUnhealthy(
+                    "no live replica to roll out onto; wait for the "
+                    "supervisor's respawns (or unpark)"
+                )
+            if n_shards is None:
+                n_shards = len(live)
+            n_shards = max(1, int(n_shards))
+            replication = max(1, min(int(replication), len(live)))
+            with self._lock:
+                nxt = {}
+                vers = []
+                for name, _ in items:
+                    base = nxt.get(name)
+                    if base is None:
+                        prior = [rec["version"]
+                                 for rec in self._published.get(name, ())]
+                        base = max(prior) + 1 if prior else 1
+                    vers.append(base)
+                    nxt[name] = base + 1
+            if n_shards <= 1:
+                shard_of = None
+                holders = {}
+                per_replica = {
+                    r.index: (list(items), list(vers)) for r in live
+                }
+            else:
+                shard_of = {name: _stable_hash(name) % n_shards
+                            for name, _ in items}
+                live_idx = [r.index for r in live]
+                holders = {
+                    s: _rendezvous_holders(s, live_idx, replication)
+                    for s in set(shard_of.values())
+                }
+                per_replica = {}
+                for (name, model), v in zip(items, vers):
+                    for ri in holders[shard_of[name]]:
+                        sub, sv = per_replica.setdefault(ri, ([], []))
+                        sub.append((name, model))
+                        sv.append(v)
+            by_index = {r.index: r for r in live}
+            done = []
+            try:
+                with obs_trace.span(
+                    "rollout_swap",
+                    {"models": len(items), "shards": int(n_shards),
+                     "replication": int(replication)}
+                    if obs_trace.enabled() else None,
+                ):
+                    for ri in sorted(per_replica):
+                        sub, sv = per_replica[ri]
+                        self._register_many_on(
+                            by_index[ri], sub, sv, methods, serve_dtype
+                        )
+                        done.append((by_index[ri], sub, sv))
+            except Exception:
+                # roll the orphans back (same reasoning as rollout():
+                # versions are immutable worker-side, so an orphaned
+                # registration would poison every retry)
+                for r, sub, sv in done:
+                    for (name, _), v in zip(sub, sv):
+                        try:
+                            r.pool.request(
+                                "unregister",
+                                {"name": name, "version": v},
+                                self.heartbeat_timeout_s * 4,
+                            )
+                        except Exception as exc:
+                            faults.log_suppressed(
+                                "ProcessReplicaSet.rollout_many.rollback",
+                                exc,
+                            )
+                raise
+            with self._lock:
+                for (name, model), v in zip(items, vers):
+                    rec = {"name": name, "model": model,
+                           "methods": methods, "version": v,
+                           "serve_dtype": serve_dtype}
+                    if shard_of is not None:
+                        rec["shard"] = shard_of[name]
+                        self._shard_of[name] = shard_of[name]
+                    else:
+                        self._shard_of.pop(name, None)
+                    self._published.setdefault(name, []).append(rec)
+                for s, hs in holders.items():
+                    self._shard_holders[s] = list(hs)
+                if shard_of is not None:
+                    self._n_shards = max(self._n_shards, n_shards)
+        self._event("rollout_many", None, n=len(items),
+                    n_shards=int(n_shards), replication=int(replication))
+        return vers
 
     def unregister(self, name, version=None):
         """Fleet-wide unload: drop ``name@version`` (every version with
@@ -741,9 +876,12 @@ class ProcessReplicaSet:
         lists."""
         if self._closed:
             raise ServingError("replica set is closed")
+        # a sharded model lives only on its holders; unload there
+        _, holders = self._route_for(name)
         with self._respawn_lock:
             live = [r for r in self._replicas
-                    if r.alive and not r.draining]
+                    if r.alive and not r.draining
+                    and (holders is None or r.index in holders)]
             removed = []
             for r in live:
                 try:
@@ -769,6 +907,8 @@ class ProcessReplicaSet:
                                    if rec["version"] != int(version)]
                         if not recs:
                             del self._published[name]
+                if name not in self._published:
+                    self._shard_of.pop(name, None)
         self._event("unregister", None, name=name, version=version)
         return removed
 
@@ -776,6 +916,101 @@ class ProcessReplicaSet:
         # registration compiles (or loads AOT artifacts) — give it the
         # spawn budget, not the request budget
         return r.pool.request("register", dict(rec), self.spawn_timeout_s)
+
+    def _register_many_on(self, r, items, versions, methods,
+                          serve_dtype):
+        """One bulk ``register_many`` RPC: the worker stages the whole
+        subset behind one bank generation per bank group. The budget
+        scales past the single-spawn budget — a 10k-tenant cohort is
+        one pickle + one staging, but not a 60-second one."""
+        return r.pool.request(
+            "register_many",
+            {"models": list(items), "versions": list(versions),
+             "methods": tuple(methods), "serve_dtype": serve_dtype},
+            max(self.spawn_timeout_s * 4, 120.0),
+        )
+
+    def _route_for(self, model):
+        """``(shard, holder-index set)`` for a sharded model;
+        ``(None, None)`` for replicate-everywhere routing."""
+        if model is None:
+            return None, None
+        name = str(model).split("@", 1)[0]
+        with self._lock:
+            s = self._shard_of.get(name)
+            if s is None:
+                return None, None
+            return s, set(self._shard_holders.get(s, ()))
+
+    def _records_for_replica(self, index):
+        """The published records worker ``index`` must hold: every
+        unsharded record plus the shards the holder map assigns it."""
+        with self._lock:
+            return [
+                dict(rec)
+                for recs in self._published.values() for rec in recs
+                if rec.get("shard") is None
+                or index in self._shard_holders.get(rec["shard"], ())
+            ]
+
+    def _replay_records(self, r, recs):
+        """Re-register ``recs`` on worker ``r``, bulk per
+        (methods, serve_dtype) group with versions pinned — a respawn
+        or re-stage costs one bank generation per group."""
+        groups = {}
+        for rec in recs:
+            k = (tuple(rec["methods"]),
+                 rec.get("serve_dtype", "float32"))
+            groups.setdefault(k, []).append(rec)
+        for (methods, sdt), grp in groups.items():
+            if len(grp) == 1:
+                self._register_on(r, grp[0])
+            else:
+                self._register_many_on(
+                    r, [(g["name"], g["model"]) for g in grp],
+                    [g["version"] for g in grp], methods, sdt,
+                )
+
+    def _restage_shard(self, shard, exclude):
+        """Failover past every holder of ``shard``: re-stage the
+        shard's ENTIRE record set on another live worker (one bulk
+        staging), republish the holder map, return the new holder —
+        or ``None`` when no live worker remains."""
+        with self._lock:
+            names = [n for n, s in self._shard_of.items() if s == shard]
+            recs = [dict(rec) for n in names
+                    for rec in self._published.get(n, ())]
+            cands = sorted(
+                (r for r in self._replicas
+                 if r.alive and not r.draining
+                 and r.index not in exclude),
+                key=lambda r: r.in_flight + r.queue_depth,
+            )
+        if not recs:
+            return None
+        for r in cands:
+            try:
+                self._replay_records(r, recs)
+            except Exception as exc:
+                faults.log_suppressed(
+                    "ProcessReplicaSet._restage_shard", exc
+                )
+                continue
+            with self._lock:
+                hold = self._shard_holders.setdefault(shard, [])
+                if r.index not in hold:
+                    hold.append(r.index)
+            faults.record("shard_restages")
+            obs_trace.instant(
+                "shard_restage",
+                {"shard": int(shard), "replica": int(r.index),
+                 "models": len(recs)}
+                if obs_trace.enabled() else None,
+            )
+            self._event("restage", r.index, shard=shard,
+                        models=len(recs))
+            return r
+        return None
 
     # ------------------------------------------------------------------
     # request path
@@ -814,9 +1049,19 @@ class ProcessReplicaSet:
     def _routed_request(self, X, model, method, timeout_s):
         tried = set()
         last = None
+        # bank-aware routing: a sharded model routes only to holders
+        shard, holders = self._route_for(model)
         give_up_at = time.monotonic() + self.unhealthy_wait_s
         while True:
-            r = self._pick(tried)
+            r = self._pick(tried, allowed=holders)
+            if r is None and holders is not None:
+                # every holder down/refused: re-stage the shard on
+                # another live worker rather than waiting out the
+                # supervisor's respawn backoff
+                restaged = self._restage_shard(shard, tried | holders)
+                if restaged is not None:
+                    holders.add(restaged.index)
+                    continue
             if r is None:
                 with self._lock:
                     all_parked = all(p.parked for p in self._replicas)
@@ -957,14 +1202,16 @@ class ProcessReplicaSet:
                          "routed request",
                 ).set(ring.occupancy(), replica=str(r.index))
 
-    def _pick(self, exclude=()):
-        """Least-loaded live replica not yet tried: parent-side
+    def _pick(self, exclude=(), allowed=None):
+        """Least-loaded live replica not yet tried (restricted to
+        ``allowed`` holder indices for sharded models): parent-side
         in-flight plus the child's queue depth from its last
         heartbeat, ties round-robin."""
         with self._lock:
             live = [r for r in self._replicas
                     if r.alive and not r.draining
-                    and r.index not in exclude]
+                    and r.index not in exclude
+                    and (allowed is None or r.index in allowed)]
             self._rr += 1
             rr = self._rr
             if not live:
@@ -1208,13 +1455,12 @@ class ProcessReplicaSet:
                     old_pool.close()
                 try:
                     self._spawn(r)
-                    with self._lock:
-                        published = [
-                            dict(rec) for recs in self._published.values()
-                            for rec in recs
-                        ]
-                    for rec in published:
-                        self._register_on(r, rec)
+                    # replay what THIS worker holds: unsharded records
+                    # plus its shard-map subset, bulk-staged (one bank
+                    # generation per group, versions pinned)
+                    self._replay_records(
+                        r, self._records_for_replica(r.index)
+                    )
                 except Exception as exc:
                     # ANY failure — spawn OSError, a decoded
                     # registration ValueError, transport death — is a
@@ -1551,6 +1797,12 @@ class ProcessReplicaSet:
                                     if not r.alive and not r.parked],
                 "parked": [r.index for r in replicas if r.parked],
                 "events": [dict(e) for e in self.events],
+                "n_shards": self._n_shards,
+                "sharded_models": len(self._shard_of),
+                "shard_holders": {
+                    int(s): list(h)
+                    for s, h in self._shard_holders.items()
+                },
             }
         per = []
         for r in replicas:

@@ -100,6 +100,16 @@ def _dispatch(engine, state, op, payload):
             bank_rows_per_slot=payload.get("bank_rows_per_slot"),
         )
         return {"version": entry.version, "spec": entry.spec}
+    if op == "register_many":
+        entries = engine.register_many(
+            list(payload["models"]),
+            methods=tuple(payload.get("methods") or ("predict",)),
+            serve_dtype=payload.get("serve_dtype", "float32"),
+            bank_rows_per_slot=payload.get("bank_rows_per_slot"),
+            versions=payload.get("versions"),
+        )
+        return {"specs": [e.spec for e in entries],
+                "versions": [e.version for e in entries]}
     if op == "unregister":
         removed = engine.unregister(
             payload["name"], version=payload.get("version"),

@@ -243,56 +243,10 @@ class ModelRegistry:
         ``max_batch_rows`` could never fill a single slot and is
         refused at registration rather than discovered at serve time.
         """
-        check_is_fitted(model)
-        if serve_dtype not in SERVE_DTYPES:
-            raise ValueError(
-                f"serve_dtype must be one of {SERVE_DTYPES}; got "
-                f"{serve_dtype!r}"
-            )
-        methods = (methods,) if isinstance(methods, str) else tuple(methods)
-        for m in methods:
-            if m not in ("predict", "predict_proba", "decision_function"):
-                raise ValueError(f"unsupported serving method {m!r}")
-            if not hasattr(model, m):
-                raise ValueError(
-                    f"model {type(model).__name__} has no {m!r} method"
-                )
         do_prewarm = self.prewarm_default if prewarm is None else prewarm
-        plans = {}
-        quant_error = None
-        params_nbytes = None
-        for m in methods:
-            plan = device_predict_plan(model, m, serve_dtype=serve_dtype)
-            if plan is None:
-                if serve_dtype != "float32":
-                    raise ValueError(
-                        f"serve_dtype={serve_dtype!r} needs the device "
-                        "path (staged parameters to quantize); "
-                        f"{type(model).__name__} serves through the "
-                        "host fallback, which is float32-only"
-                    )
-            else:
-                if serve_dtype != "float32":
-                    err = self._quant_parity_probe(model, m, plan)
-                    bound = (DEFAULT_QUANT_PARITY_BOUND
-                             if quant_parity_bound is None
-                             else float(quant_parity_bound))
-                    if err > bound:
-                        raise ValueError(
-                            f"{serve_dtype} parity probe for "
-                            f"{type(model).__name__}.{m} deviates "
-                            f"{err:.4g} from the f32 reference "
-                            f"(bound {bound:g}); this model's weights "
-                            "do not quantize to this tier — serve it "
-                            "float32 or raise quant_parity_bound if "
-                            "screening traffic tolerates it"
-                        )
-                    quant_error = max(quant_error or 0.0, err)
-                    params_nbytes = (
-                        (params_nbytes or 0)
-                        + quantized_nbytes(plan.params)
-                    )
-            plans[m] = plan
+        methods, plans, quant_error, params_nbytes = self._plan_model(
+            model, methods, serve_dtype, quant_parity_bound
+        )
 
         banked = ((self.bank_models if bank is None else bool(bank))
                   and all(p is not None for p in plans.values()))
@@ -334,6 +288,65 @@ class ModelRegistry:
             self._models.setdefault(name, {})[version] = entry
         return entry
 
+    def _plan_model(self, model, methods, serve_dtype,
+                    quant_parity_bound):
+        """The validation + plan-construction half of registration,
+        shared by :meth:`register` and :meth:`register_many`: fitted
+        check, method check, one :class:`DevicePredictPlan` per method
+        (host-fallback methods plan as ``None``), and the quantized
+        parity probe for non-f32 tiers. Returns ``(methods, plans,
+        quant_error, params_nbytes)``."""
+        check_is_fitted(model)
+        if serve_dtype not in SERVE_DTYPES:
+            raise ValueError(
+                f"serve_dtype must be one of {SERVE_DTYPES}; got "
+                f"{serve_dtype!r}"
+            )
+        methods = (methods,) if isinstance(methods, str) else tuple(methods)
+        for m in methods:
+            if m not in ("predict", "predict_proba", "decision_function"):
+                raise ValueError(f"unsupported serving method {m!r}")
+            if not hasattr(model, m):
+                raise ValueError(
+                    f"model {type(model).__name__} has no {m!r} method"
+                )
+        plans = {}
+        quant_error = None
+        params_nbytes = None
+        for m in methods:
+            plan = device_predict_plan(model, m, serve_dtype=serve_dtype)
+            if plan is None:
+                if serve_dtype != "float32":
+                    raise ValueError(
+                        f"serve_dtype={serve_dtype!r} needs the device "
+                        "path (staged parameters to quantize); "
+                        f"{type(model).__name__} serves through the "
+                        "host fallback, which is float32-only"
+                    )
+            else:
+                if serve_dtype != "float32":
+                    err = self._quant_parity_probe(model, m, plan)
+                    bound = (DEFAULT_QUANT_PARITY_BOUND
+                             if quant_parity_bound is None
+                             else float(quant_parity_bound))
+                    if err > bound:
+                        raise ValueError(
+                            f"{serve_dtype} parity probe for "
+                            f"{type(model).__name__}.{m} deviates "
+                            f"{err:.4g} from the f32 reference "
+                            f"(bound {bound:g}); this model's weights "
+                            "do not quantize to this tier — serve it "
+                            "float32 or raise quant_parity_bound if "
+                            "screening traffic tolerates it"
+                        )
+                    quant_error = max(quant_error or 0.0, err)
+                    params_nbytes = (
+                        (params_nbytes or 0)
+                        + quantized_nbytes(plan.params)
+                    )
+            plans[m] = plan
+        return methods, plans, quant_error, params_nbytes
+
     # ------------------------------------------------------------------
     # banked registration
     # ------------------------------------------------------------------
@@ -366,6 +379,125 @@ class ModelRegistry:
         with self._lock:
             self._models.setdefault(name, {})[version] = entry
         return entry
+
+    def register_many(self, models, methods=("predict",), prewarm=None,
+                      serve_dtype="float32", quant_parity_bound=None,
+                      bank_rows_per_slot=None, versions=None):
+        """Bulk catalog registration: validate + plan every model,
+        group the bankable ones by bank, and stage each bank's whole
+        cohort behind ONE generation build + atomic swap
+        (:meth:`ParameterBank.add_members`) — K tenants cost one
+        stack/placement/prewarm per bank instead of K. This is the
+        catalog cold-load and refresh-rollout path; ``register`` in a
+        loop builds one generation per tenant (the 10k-tenant scaling
+        wall).
+
+        ``models`` is an iterable of ``(name, model)`` pairs (or a
+        dict). Versions auto-assign unless ``versions`` (a sequence
+        aligned with the input order, ``None`` entries auto-assign)
+        pins them — the fleet respawn path re-registers a replica's
+        shard under the ORIGINAL numbers so version-pinned routing
+        resolves identically on every generation. Models that cannot
+        bank (host-fallback, or a registry with ``bank_models=False``)
+        fall back to per-model :meth:`register`. Returns the published
+        entries in input order.
+
+        Failure semantics: validation/planning failures raise before
+        anything stages. A staging failure mid-batch rolls back the
+        banks already staged in this call (their members are removed
+        again; reserved version numbers are burned, as for any failed
+        banked registration) and re-raises — all-or-nothing."""
+        items = list(models.items()) if isinstance(models, dict) \
+            else list(models)
+        if versions is None:
+            versions = [None] * len(items)
+        else:
+            versions = list(versions)
+            if len(versions) != len(items):
+                raise ValueError(
+                    f"versions has {len(versions)} entries for "
+                    f"{len(items)} models"
+                )
+        do_prewarm = self.prewarm_default if prewarm is None else prewarm
+        planned = []  # (name, model, plans, qerr, nbytes, bankable)
+        for name, model in items:
+            _, plans, qerr, nbytes = self._plan_model(
+                model, methods, serve_dtype, quant_parity_bound
+            )
+            bankable = (self.bank_models
+                        and all(p is not None for p in plans.values()))
+            planned.append((name, model, plans, qerr, nbytes, bankable))
+
+        entries = [None] * len(planned)
+        # unbanked stragglers keep the per-model path (a mixed catalog
+        # banks what it can)
+        for i, (name, model, plans, qerr, nbytes, bankable) \
+                in enumerate(planned):
+            if not bankable:
+                entries[i] = self.register(
+                    name, model, methods=methods, prewarm=prewarm,
+                    version=versions[i], serve_dtype=serve_dtype,
+                    quant_parity_bound=quant_parity_bound, bank=False,
+                )
+
+        # reserve every banked version in one lock acquisition, then
+        # group specs by bank key so each bank stages its cohort once
+        banked_idx = [i for i, p in enumerate(planned) if p[5]]
+        if not banked_idx:
+            return entries
+        with self._lock:
+            specs = {}
+            for i in banked_idx:
+                name = planned[i][0]
+                v = self._reserve_version_locked(name, versions[i])
+                specs[i] = (v, f"{name}@{v}")
+        groups = {}  # bank_group_key -> [idx, ...]
+        r = self.bank_rows_per_slot if bank_rows_per_slot is None \
+            else int(bank_rows_per_slot)
+        for i in banked_idx:
+            groups.setdefault(
+                bank_group_key(planned[i][2], r), []
+            ).append(i)
+        staged = []  # (bank, [spec, ...]) for mid-batch rollback
+        banks = {}
+        try:
+            with self._banks_lock:
+                for key, idxs in groups.items():
+                    bank = self._bank_for(planned[idxs[0]][2],
+                                          bank_rows_per_slot)
+                    bank.add_members(
+                        [(specs[i][1], planned[i][2]) for i in idxs],
+                        prewarm=do_prewarm,
+                    )
+                    staged.append((bank, [specs[i][1] for i in idxs]))
+                    for i in idxs:
+                        banks[i] = bank
+        except BaseException:
+            with self._banks_lock:
+                for bank, ss in staged:
+                    for s in ss:
+                        bank.remove_member(s)
+                    if not bank.members():
+                        self._banks.pop(bank.key, None)
+            raise
+        with self._lock:
+            for i in banked_idx:
+                name, model, plans, qerr, nbytes, _ = planned[i]
+                bank = banks[i]
+                paths = {
+                    m: _MethodPath(model, m, plan=plan, bank=bank)
+                    for m, plan in plans.items()
+                }
+                ref = next(iter(plans.values()))
+                entry = ModelEntry(
+                    name, specs[i][0], model, paths,
+                    bank.row_buckets(), int(ref.n_features),
+                    serve_dtype=serve_dtype, quant_error=qerr,
+                    params_nbytes=nbytes, bank=bank,
+                )
+                self._models.setdefault(name, {})[specs[i][0]] = entry
+                entries[i] = entry
+        return entries
 
     def _reserve_version_locked(self, name, version):
         """Version numbering under the registry lock: monotonic per

@@ -54,6 +54,7 @@ exact, replayable scenario — the assertion surface of the router
 failover test and ``build_tools/elastic_smoke.py``.
 """
 
+import hashlib
 import threading
 import time
 from concurrent.futures import Future
@@ -134,6 +135,12 @@ class ReplicaSet:
         #: under its original number, so version-pinned routing
         #: (name@v) resolves identically on every generation
         self._published = {}
+        #: bank-aware routing (see :meth:`rollout_many`): model name ->
+        #: shard ordinal, and shard ordinal -> holder replica indices.
+        #: Models absent from the map keep replicate-everywhere routing.
+        self._shard_of = {}
+        self._shard_holders = {}
+        self._n_shards = 0
         self._requests = 0
         self._rr = 0
         self._closed = False
@@ -178,12 +185,140 @@ class ReplicaSet:
                 {"model": model, "methods": methods, "version": assigned,
                  "serve_dtype": serve_dtype}
             )
+            # a fleet-wide rollout puts the name on EVERY replica, so
+            # any earlier shard restriction no longer applies
+            self._shard_of.pop(name, None)
         self._event("rollout", None, name=name, version=assigned,
                     serve_dtype=serve_dtype)
         return entries
 
     # an alias matching the single-engine verb
     register = rollout
+
+    def rollout_many(self, models, methods=("predict",),
+                     serve_dtype="float32", n_shards=None,
+                     replication=1, prewarm=True):
+        """Bulk catalog rollout with **bank-aware sharding** (ROADMAP
+        1c): instead of replicating every tenant onto every replica —
+        N× the device memory of the whole catalog — the cohort is
+        partitioned into ``n_shards`` shards (stable hash of the model
+        name), each shard is placed on ``replication`` replicas chosen
+        by rendezvous hashing, and each holder stages its whole subset
+        behind ONE bank generation per bank group
+        (``ServingEngine.register_many``). The router keeps a
+        tenant→shard→holders map and restricts routing for sharded
+        models to their holders; unbanked/unsharded models keep
+        replicate-everywhere. When every holder of a shard is down,
+        failover **re-stages** the shard on another live replica (the
+        map republishes) rather than failing the request.
+
+        ``n_shards=None`` defaults to one shard per live replica;
+        ``n_shards=1`` degenerates to replicate-everywhere bulk load.
+        Versions are fleet-assigned and pinned on every holder, so the
+        routing map and version-pinned requests agree on every replica
+        generation. Returns one canonical entry per input model (from
+        the first holder that staged it), in input order. A holder
+        failing mid-rollout fails the rollout loudly — nothing
+        publishes to routing."""
+        if self._closed:
+            raise ServingError("replica set is closed")
+        items = list(models.items()) if isinstance(models, dict) \
+            else list(models)
+        if not items:
+            return []
+        methods = (methods,) if isinstance(methods, str) \
+            else tuple(methods)
+        live = self._live()
+        if not live:
+            raise AllReplicasUnhealthy(
+                "no live replica to roll out onto; call heal() first"
+            )
+        if n_shards is None:
+            n_shards = len(live)
+        n_shards = max(1, int(n_shards))
+        replication = max(1, min(int(replication), len(live)))
+
+        # fleet-assigned version numbers, pinned on every holder
+        with self._lock:
+            nxt = {}
+            vers = []
+            for name, _ in items:
+                base = nxt.get(name)
+                if base is None:
+                    prior = [rec["version"]
+                             for rec in self._published.get(name, ())]
+                    base = max(prior) + 1 if prior else 1
+                vers.append(base)
+                nxt[name] = base + 1
+
+        if n_shards <= 1:
+            entries = None
+            with obs_trace.span(
+                "rollout_swap",
+                {"models": len(items), "shards": 1}
+                if obs_trace.enabled() else None,
+            ):
+                for r in live:
+                    es = r.engine.register_many(
+                        items, methods=methods, prewarm=prewarm,
+                        serve_dtype=serve_dtype, versions=vers,
+                    )
+                    entries = entries if entries is not None else es
+            with self._lock:
+                for (name, model), v in zip(items, vers):
+                    self._published.setdefault(name, []).append(
+                        {"model": model, "methods": methods,
+                         "version": v, "serve_dtype": serve_dtype}
+                    )
+                    self._shard_of.pop(name, None)
+            self._event("rollout_many", None, n=len(items), n_shards=1)
+            return entries
+
+        shard_of = {name: _stable_hash(name) % n_shards
+                    for name, _ in items}
+        live_idx = [r.index for r in live]
+        holders = {
+            s: _rendezvous_holders(s, live_idx, replication)
+            for s in set(shard_of.values())
+        }
+        per_replica = {}   # index -> ([(name, model)...], [version...])
+        for (name, model), v in zip(items, vers):
+            for ri in holders[shard_of[name]]:
+                sub, sv = per_replica.setdefault(ri, ([], []))
+                sub.append((name, model))
+                sv.append(v)
+        by_index = {r.index: r for r in live}
+        canonical = {}
+        with obs_trace.span(
+            "rollout_swap",
+            {"models": len(items), "shards": n_shards,
+             "replication": replication}
+            if obs_trace.enabled() else None,
+        ):
+            for ri in sorted(per_replica):
+                sub, sv = per_replica[ri]
+                es = by_index[ri].engine.register_many(
+                    sub, methods=methods, prewarm=prewarm,
+                    serve_dtype=serve_dtype, versions=sv,
+                )
+                for (name, _), v, e in zip(sub, sv, es):
+                    canonical.setdefault((name, v), e)
+        # publish: spec store + routing map move together, one lock
+        with self._lock:
+            for (name, model), v in zip(items, vers):
+                self._published.setdefault(name, []).append(
+                    {"model": model, "methods": methods, "version": v,
+                     "serve_dtype": serve_dtype,
+                     "shard": shard_of[name]}
+                )
+                self._shard_of[name] = shard_of[name]
+            for s, hs in holders.items():
+                self._shard_holders[s] = list(hs)
+            self._n_shards = max(self._n_shards, n_shards)
+        self._event("rollout_many", None, n=len(items),
+                    n_shards=n_shards, replication=replication)
+        return [canonical[(name, v)]
+                for (name, _), v in zip(items, vers)]
 
     def unregister(self, name, version=None, drain=True):
         """Fleet-wide unload: drop ``name@version`` (every version with
@@ -196,8 +331,12 @@ class ReplicaSet:
         50% occupancy) while its co-tenants keep serving."""
         if self._closed:
             raise ServingError("replica set is closed")
+        # a sharded model lives only on its holders; unload there
+        _, holders = self._route_for(name)
         removed = []
         for r in self._live():
+            if holders is not None and r.index not in holders:
+                continue
             # per-replica tolerance (mirrors ProcessReplicaSet): a
             # replica that cannot unload now (dying, already missing
             # the name) must not strand the spec-store cleanup — its
@@ -221,6 +360,8 @@ class ReplicaSet:
                                if rec["version"] != int(version)]
                     if not recs:
                         del self._published[name]
+            if name not in self._published:
+                self._shard_of.pop(name, None)
         self._event("unregister", None, name=name, version=version)
         return removed
 
@@ -240,9 +381,19 @@ class ReplicaSet:
         self._tick()
         outer = Future()
         tried = set()
+        # bank-aware routing: a sharded model routes only to its
+        # holders; holders is None for replicate-everywhere models
+        shard, holders = self._route_for(model)
 
         def attempt(last_exc=None):
-            r = self._pick(exclude=tried)
+            r = self._pick(exclude=tried, allowed=holders)
+            if r is None and holders is not None:
+                # every holder is down/refused — re-stage the shard on
+                # another live replica and republish the map, so a
+                # holder outage costs a re-stage, not an error
+                r = self._restage_shard(shard, tried | holders)
+                if r is not None:
+                    holders.add(r.index)
             if r is None:
                 # flight-recorder post-mortem: the ring shows the
                 # failovers/respawns that exhausted the fleet (throttled
@@ -371,19 +522,14 @@ class ReplicaSet:
                         "ReplicaSet._respawn.close", exc
                     )
                 engine = self._factory()
-                with self._lock:
-                    published = [
-                        (name, list(recs))
-                        for name, recs in self._published.items()
-                    ]
-                for name, recs in published:
-                    for rec in recs:
-                        engine.register(
-                            name, rec["model"], methods=rec["methods"],
-                            version=rec["version"],
-                            serve_dtype=rec.get("serve_dtype",
-                                                "float32"),
-                        )
+                # re-register what THIS replica holds: every unsharded
+                # record, plus only the shards the routing map assigns
+                # it — a respawned member of a sharded fleet comes back
+                # with its subset (one bulk bank staging), not the
+                # whole catalog
+                self._bulk_register(
+                    engine, self._records_for_replica(r.index)
+                )
                 r.engine = engine
                 r.failures = 0
                 r.generation += 1
@@ -429,6 +575,12 @@ class ReplicaSet:
                 "published": sorted(self._published),
                 "pending_respawn": list(self._pending_respawn),
                 "events": [dict(e) for e in self.events],
+                "n_shards": self._n_shards,
+                "sharded_models": len(self._shard_of),
+                "shard_holders": {
+                    int(s): list(h)
+                    for s, h in self._shard_holders.items()
+                },
             }
         per = []
         for r in replicas:
@@ -482,12 +634,14 @@ class ReplicaSet:
                 self.kill_replica(idx, drain=False)
         return ordinal
 
-    def _pick(self, exclude=()):
-        """Least-loaded live replica not yet tried for this request;
+    def _pick(self, exclude=(), allowed=None):
+        """Least-loaded live replica not yet tried for this request
+        (restricted to ``allowed`` holder indices for sharded models);
         ties break round-robin so equal-depth replicas share load."""
         with self._lock:
             live = [r for r in self._replicas
-                    if r.alive and r.index not in exclude]
+                    if r.alive and r.index not in exclude
+                    and (allowed is None or r.index in allowed)]
             self._rr += 1
             rr = self._rr
         if not live:
@@ -497,6 +651,101 @@ class ReplicaSet:
             key=lambda r: (r.engine.queue_depth(),
                            (r.index - rr) % (len(self._replicas) or 1)),
         )
+
+    def _route_for(self, model):
+        """Routing view for one request: ``(shard, holder-index set)``
+        for a sharded model, ``(None, None)`` for replicate-everywhere
+        (including ``model=None`` bare routing)."""
+        if model is None:
+            return None, None
+        name = str(model).split("@", 1)[0]
+        with self._lock:
+            s = self._shard_of.get(name)
+            if s is None:
+                return None, None
+            return s, set(self._shard_holders.get(s, ()))
+
+    def _restage_shard(self, shard, exclude):
+        """Failover past every holder of ``shard``: pick another live
+        replica, bulk-register the shard's ENTIRE published record set
+        on it (versions pinned — one bank staging, prewarmed), add it
+        to the holder map, and return it. The whole shard moves, not
+        just the failing tenant, so the republished map never routes a
+        co-tenant to a replica that does not hold it. Returns ``None``
+        when no live replica remains or the shard has no records."""
+        with self._lock:
+            names = [n for n, s in self._shard_of.items() if s == shard]
+            recs = [(n, dict(rec)) for n in names
+                    for rec in self._published.get(n, ())]
+        if not recs:
+            return None
+        cands = sorted(
+            (r for r in self._live() if r.index not in exclude),
+            key=lambda r: r.engine.queue_depth(),
+        )
+        for r in cands:
+            try:
+                self._bulk_register(r.engine, recs)
+            except Exception as exc:
+                faults.log_suppressed("ReplicaSet._restage_shard", exc)
+                continue
+            with self._lock:
+                hold = self._shard_holders.setdefault(shard, [])
+                if r.index not in hold:
+                    hold.append(r.index)
+            faults.record("shard_restages")
+            obs_trace.instant(
+                "shard_restage",
+                {"shard": int(shard), "replica": int(r.index),
+                 "models": len(recs)}
+                if obs_trace.enabled() else None,
+            )
+            self._event("restage", r.index, shard=shard,
+                        models=len(recs))
+            return r
+        return None
+
+    def _records_for_replica(self, index):
+        """The published records replica ``index`` must hold: every
+        unsharded record plus the shards the holder map assigns it."""
+        with self._lock:
+            out = []
+            for name, recs in self._published.items():
+                for rec in recs:
+                    s = rec.get("shard")
+                    if s is None or index in self._shard_holders.get(
+                            s, ()):
+                        out.append((name, dict(rec)))
+            return out
+
+    @staticmethod
+    def _bulk_register(engine, recs):
+        """Register ``[(name, record), ...]`` on ``engine`` in one
+        bulk call per (methods, serve_dtype) group with versions
+        pinned — a respawn/re-stage costs one bank generation per
+        group, not one per tenant. Engines without ``register_many``
+        (factory-injected test doubles) fall back to per-record
+        ``register``."""
+        reg_many = getattr(engine, "register_many", None)
+        if not callable(reg_many) or len(recs) <= 1:
+            for name, rec in recs:
+                engine.register(
+                    name, rec["model"], methods=rec["methods"],
+                    version=rec["version"],
+                    serve_dtype=rec.get("serve_dtype", "float32"),
+                )
+            return
+        groups = {}
+        for name, rec in recs:
+            k = (tuple(rec["methods"]),
+                 rec.get("serve_dtype", "float32"))
+            groups.setdefault(k, []).append((name, rec))
+        for (methods, sdt), grp in groups.items():
+            reg_many(
+                [(n, rec["model"]) for n, rec in grp],
+                methods=methods, serve_dtype=sdt,
+                versions=[rec["version"] for _, rec in grp],
+            )
 
     def _failover_worthy(self, r, exc):
         """Whether ``exc`` from replica ``r`` should re-route the
@@ -560,6 +809,25 @@ def fleet_by_model(per_replica_entries):
             agg["requests"] += cell.get("requests", 0)
             agg["completed"] += cell.get("completed", 0)
     return by_model
+
+
+def _stable_hash(s):
+    """Process-stable 64-bit hash (``hash()`` is salted per process —
+    useless for a map that must agree across respawns and workers)."""
+    digest = hashlib.blake2b(str(s).encode("utf-8"),
+                             digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+def _rendezvous_holders(shard, indices, k):
+    """Highest-random-weight (rendezvous) choice of ``k`` holder
+    replicas for ``shard``: each (shard, replica) pair scores
+    independently, so adding/removing a replica only moves the shards
+    it wins/loses — no global reshuffle on fleet resize."""
+    ranked = sorted(indices,
+                    key=lambda i: _stable_hash(f"{shard}:{i}"),
+                    reverse=True)
+    return sorted(ranked[:max(1, int(k))])
 
 
 def _bind_replica_label(replica):
