@@ -2299,6 +2299,49 @@ def multitenant_aux(quick=False):
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+def streamed_asha_aux(quick=False):
+    """Measured readout of the streamed adaptive search: a
+    ``DistGridSearchCV(adaptive=HalvingSpec(...))`` race over a
+    disk-backed ``ChunkedDataset`` >= 4x an enforced peak-RSS budget
+    on a 2D (task x data) mesh — warm walls vs the exhaustive
+    streamed search, best-candidate identity, survivor parity,
+    passes/bytes-saved rung accounting, the compile invariant, and
+    the mid-rung elastic-shrink resume leg — the evidence behind the
+    streamed-ASHA smoke's gates. Best-effort: a dict with "error" on
+    any failure."""
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+        ))
+        from bench_streamed_asha import run_streamed_asha_bench
+
+        return run_streamed_asha_bench(quick=quick)
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _streamed_asha_main(quick=False):
+    """Standalone capture of the streamed adaptive-search readout →
+    ``BENCH_streamed_asha_r19.json`` (adaptive vs exhaustive streamed
+    warm walls over the out-of-core dataset, best-candidate identity,
+    survivor parity, rung accounting, peak-RSS delta vs budget,
+    compile invariant, elastic mid-rung resume)."""
+    import jax
+
+    payload = {
+        "metric": "streamed_asha_search",
+        "aux": streamed_asha_aux(quick=quick),
+        "platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(payload, indent=1), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_streamed_asha_r19.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
 def _multitenant_main(quick=False):
     """Standalone capture of the multi-tenant banked-serving readout →
     ``BENCH_multitenant_r14.json`` (banked vs per-model aggregate
@@ -2578,6 +2621,8 @@ if __name__ == "__main__":
         _gbdt_main(quick="--quick" in sys.argv)
     elif "--sparse" in sys.argv:
         _sparse_main(quick="--quick" in sys.argv)
+    elif "--streamed-asha" in sys.argv:
+        _streamed_asha_main(quick="--quick" in sys.argv)
     elif "--asha" in sys.argv:
         _asha_main(quick="--quick" in sys.argv)
     elif "--streaming" in sys.argv:

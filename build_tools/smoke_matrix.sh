@@ -159,6 +159,19 @@
 #                             strict catalog subset, every tenant
 #                             servable) with shard failover restage
 #                             (living-catalog PR).
+#   streamed_asha_smoke.py  — terabyte-scale adaptive search: a
+#                             streamed ASHA race over a disk-backed
+#                             ChunkedDataset >= 4x an enforced
+#                             peak-RSS budget on a 2D (task x data)
+#                             mesh, rungs at block-pass boundaries,
+#                             >= 2x warm wall vs the exhaustive
+#                             streamed search with the SAME best
+#                             candidate, survivor parity <= 1e-5,
+#                             passes/bytes-saved accounting > 0,
+#                             0 post-warmup compiles, and a mid-rung
+#                             elastic shrink that RESUMES the race
+#                             (same kill record and winner) on the
+#                             halved mesh (streamed-ASHA PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
@@ -177,3 +190,4 @@ python build_tools/obs_fleet_smoke.py
 python build_tools/multitenant_smoke.py
 python build_tools/wirespeed_smoke.py
 python build_tools/catalog_smoke.py
+python build_tools/streamed_asha_smoke.py

@@ -1,0 +1,135 @@
+"""Streamed-ASHA smoke: the terabyte-scale adaptive-search PR's
+acceptance gate, standalone on the 8-virtual-device CPU mesh.
+
+Runs ``bench.streamed_asha_aux(quick=True)`` — an adaptive
+``DistGridSearchCV(adaptive=HalvingSpec(...))`` race over a disk-backed
+``ChunkedDataset`` >= 4x an enforced host-memory budget, on a 2D
+(task x data) ``TPUBackend(data_axis_size=2)`` mesh, with rungs fired
+at block-pass boundaries — and asserts:
+
+- the dataset really is out-of-core: ``data_bytes`` >= 4x the RSS
+  budget and the measured runs' peak-RSS delta stays UNDER the budget;
+- adaptive warm-wall speedup >= RATIO (default 2.0) over the
+  exhaustive streamed search of the same grid;
+- SAME best candidate: the rungs never killed the winner;
+- survivor-score parity <= 1e-5 vs the exhaustive streamed run
+  (a rung reads sufficient statistics, it never perturbs survivors);
+- rungs actually fired: ``retired_rung`` > 0, ``passes_saved`` > 0,
+  and ``streamed_bytes_saved`` > 0 (the race ended before the
+  iteration cap, so whole-dataset passes were never streamed);
+- NO recompile after warmup: compaction re-dispatches the same
+  structural programs at divisor widths;
+- mid-rung elastic shrink RESUMES the race (never restarts): >= 1
+  shrink, the mesh halved, same winner, same kill record, survivor
+  parity <= 1e-5 vs the un-preempted run.
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/streamed_asha_smoke.py [--ratio 2.0]
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def main(ratio):
+    from bench import streamed_asha_aux
+
+    aux = streamed_asha_aux(quick=True)
+    print(json.dumps({"streamed_asha": aux, "target_ratio": ratio},
+                     indent=1))
+    if "error" in aux:
+        raise SystemExit(f"FAIL: streamed-asha aux died: {aux['error']}")
+
+    failures = []
+    if aux["data_bytes"] < 4 * aux["rss_budget_bytes"]:
+        failures.append(
+            f"dataset {aux['data_bytes']}B < 4x budget "
+            f"{aux['rss_budget_bytes']}B — not out-of-core"
+        )
+    if aux["rss_delta_bytes"] >= aux["rss_budget_bytes"]:
+        failures.append(
+            f"peak-RSS delta {aux['rss_delta_bytes']}B breached the "
+            f"budget {aux['rss_budget_bytes']}B"
+        )
+    if aux["speedup_vs_exhaustive"] < ratio:
+        failures.append(
+            f"speedup {aux['speedup_vs_exhaustive']} < {ratio}"
+        )
+    if not aux["same_best_candidate"]:
+        failures.append(
+            "adaptive streamed search returned a different best "
+            "candidate than exhaustive — the rungs killed the winner"
+        )
+    parity = aux["survivor_score_max_diff"]
+    if parity is None:
+        failures.append("no surviving candidates to check parity on")
+    elif parity > 1e-5:
+        failures.append(f"survivor-score parity {parity} > 1e-5")
+    if not aux.get("retired_rung"):
+        failures.append(
+            "no rung ever killed a lane: the adaptive path did not run"
+        )
+    if not aux.get("passes_saved"):
+        failures.append("passes_saved == 0 despite rung kills")
+    if not aux.get("streamed_bytes_saved"):
+        failures.append(
+            "streamed_bytes_saved == 0: the race never ended before "
+            "the iteration cap"
+        )
+    warm = aux["warm_compile_cache_delta"]
+    if warm["jit_misses"] or warm["kernel_misses"]:
+        failures.append(f"compiles_after_warmup != 0: warm delta {warm}")
+    el = aux.get("elastic") or {}
+    if not el:
+        failures.append("elastic shrink leg missing from readout")
+    else:
+        if el["elastic_shrinks"] < 1:
+            failures.append("mid-rung preemption caused no elastic shrink")
+        if not el["same_best_candidate"]:
+            failures.append("elastic shrink changed the winning candidate")
+        if not el["same_kill_record"]:
+            failures.append(
+                "elastic shrink changed the rung kill record — the race "
+                "restarted instead of resuming"
+            )
+        ep = el["survivor_score_max_diff_vs_unpreempted"]
+        if ep is None:
+            failures.append("elastic leg has no survivors to compare")
+        elif ep > 1e-5:
+            failures.append(
+                f"elastic survivor parity {ep} > 1e-5 vs un-preempted"
+            )
+    if failures:
+        raise SystemExit("FAIL: " + "; ".join(failures))
+    print(
+        f"PASS: streamed ASHA {aux['adaptive_warm_wall_s']}s vs "
+        f"exhaustive {aux['exhaustive_warm_wall_s']}s "
+        f"({aux['speedup_vs_exhaustive']}x >= {ratio}x) on "
+        f"{aux['mesh']} over {aux['data_bytes'] >> 20} MiB "
+        f"(budget {aux['rss_budget_bytes'] >> 20} MiB, delta "
+        f"{aux['rss_delta_bytes'] >> 20} MiB), same best candidate "
+        f"#{aux['best_index']}, {aux['retired_rung']} lanes "
+        f"rung-killed (survivors {aux['rung_survivors']}), "
+        f"{aux['streamed_bytes_saved'] >> 20} MiB of streaming saved, "
+        f"survivor parity {parity}, 0 warm compiles, elastic resume "
+        f"to {el.get('devices_after')} devices with the same kill "
+        "record"
+    )
+
+
+if __name__ == "__main__":
+    r = 2.0
+    if "--ratio" in sys.argv:
+        r = float(sys.argv[sys.argv.index("--ratio") + 1])
+    main(r)

@@ -41,7 +41,23 @@ import os
 
 import numpy as np
 
-__all__ = ["ChunkedDataset", "Block", "is_chunked", "default_block_rows"]
+__all__ = ["ChunkedDataset", "Block", "NonSeekableReaderError",
+           "is_chunked", "default_block_rows"]
+
+
+class NonSeekableReaderError(RuntimeError):
+    """A block reader failed on RE-invocation.
+
+    Every streaming consumer re-invokes readers: multi-pass solvers
+    read each block once per pass, ``BlockFeeder.seek(i)`` replays a
+    block after a transient fault, and the durable-checkpoint digest
+    samples blocks up front. A one-shot reader (generator-, socket-, or
+    stream-backed) works exactly once and then raises or returns
+    nothing — which would otherwise surface as an unrelated crash deep
+    inside a retry. The remedy is to materialise the stream once:
+    ``ChunkedDataset.save(dir)`` the dataset, then ``fit`` on
+    ``ChunkedDataset.load(dir)`` (memory-mapped, re-readable at zero
+    host-memory cost)."""
 
 #: target bytes per block when no block_rows is given — big enough to
 #: amortise dispatch overhead, small enough that two in-flight blocks
@@ -133,6 +149,11 @@ class ChunkedDataset:
         # would otherwise cost two full passes over the on-disk matrix
         self._y_direct = None
         self._sw_direct = None
+        # blocks whose reader has been invoked successfully at least
+        # once — the witness set behind the non-seekable-reader
+        # contract (_invoke_reader): a reader that worked and then
+        # fails on REPLAY is one-shot, not broken input
+        self._read_once = set()
         expect = -(-self.n_rows // self.block_rows)
         if len(self._readers) != expect:
             raise ValueError(
@@ -235,6 +256,49 @@ class ChunkedDataset:
     # ------------------------------------------------------------------
     # block access
     # ------------------------------------------------------------------
+    def _invoke_reader(self, i):
+        """Invoke block ``i``'s reader, translating a re-invocation
+        failure (an exception, or a None/contract-less return after a
+        successful first read) into :class:`NonSeekableReaderError`
+        naming the ``save``/``load`` remedy. First-call failures are
+        the reader's own bug and propagate untouched."""
+        replay = i in self._read_once
+        try:
+            raw = self._readers[i]()
+        except Exception as exc:
+            if not replay:
+                raise
+            raise NonSeekableReaderError(
+                f"block {i}'s reader failed when invoked a second time "
+                "(streaming re-reads every block: one pass per solver "
+                "iteration, plus fault replays via BlockFeeder.seek). "
+                "ChunkedDataset.from_readers requires re-openable "
+                "readers — a generator/stream-backed one-shot reader "
+                "cannot stream-fit. Materialise it once with "
+                "ChunkedDataset.save(dir) and fit on "
+                "ChunkedDataset.load(dir) instead."
+            ) from exc
+        if raw is None or "X" not in raw:
+            kind = ("exhausted (returned None)" if raw is None
+                    else f"returned keys {sorted(raw)} without 'X'")
+            if not replay:
+                raise ValueError(
+                    f"block {i}'s reader {kind}; readers must return "
+                    "{'X': ..., 'y':?, 'sw':?} for the block's rows"
+                )
+            raise NonSeekableReaderError(
+                f"block {i}'s reader {kind} when invoked a second time "
+                "(streaming re-reads every block: one pass per solver "
+                "iteration, plus fault replays via BlockFeeder.seek). "
+                "ChunkedDataset.from_readers requires re-openable "
+                "readers — a generator/stream-backed one-shot reader "
+                "cannot stream-fit. Materialise it once with "
+                "ChunkedDataset.save(dir) and fit on "
+                "ChunkedDataset.load(dir) instead."
+            )
+        self._read_once.add(i)
+        return raw
+
     def read_block(self, i, pad=True):
         """Materialise block ``i`` as a :class:`Block`.
 
@@ -249,7 +313,7 @@ class ChunkedDataset:
 
         if not 0 <= i < self.n_blocks:
             raise IndexError(f"block {i} of {self.n_blocks}")
-        raw = self._readers[i]()
+        raw = self._invoke_reader(i)
         start, stop = self.block_range(i)
         n_real = stop - start
         X = raw["X"]
@@ -288,7 +352,7 @@ class ChunkedDataset:
         if self._y_direct is not None:
             return np.asarray(self._y_direct).reshape(-1)[: self.n_rows]
         parts = [
-            np.asarray(self._readers[i]()["y"]).reshape(-1)
+            np.asarray(self._invoke_reader(i)["y"]).reshape(-1)
             for i in range(self.n_blocks)
         ]
         return np.concatenate(parts)
@@ -305,7 +369,7 @@ class ChunkedDataset:
             )
         parts = [
             np.ascontiguousarray(
-                np.asarray(self._readers[i]()["sw"]).reshape(-1),
+                np.asarray(self._invoke_reader(i)["sw"]).reshape(-1),
                 dtype=np.float32,
             )
             for i in range(self.n_blocks)
@@ -344,7 +408,19 @@ class ChunkedDataset:
                      **kwargs):
         """Low-level constructor over arbitrary block readers (each a
         zero-arg callable returning ``{"X": ..., "y":?, "sw":?}`` for
-        its block's real rows)."""
+        its block's real rows).
+
+        **Readers must be re-openable**: every streaming consumer
+        invokes them repeatedly — multi-pass solvers read each block
+        once per pass, ``BlockFeeder.seek(i)`` replays a block after a
+        transient fault, and the durable-checkpoint digest samples
+        blocks up front — and each invocation must return the same
+        rows. A one-shot reader (wrapping a generator, socket, or other
+        forward-only stream) violates this contract; it is detected at
+        its second invocation and raises
+        :class:`NonSeekableReaderError` naming the remedy
+        (``save(dir)`` once, then fit on the memory-mapped
+        ``load(dir)``) instead of crashing mid-retry."""
         return cls(readers, n_rows, n_features, block_rows, **kwargs)
 
     @classmethod

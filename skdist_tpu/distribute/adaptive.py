@@ -29,6 +29,14 @@ rung at which each candidate died (``-1`` = ran to completion).
 ``eta=float('inf')`` scores every rung but kills nothing — the
 parity-pinned observe-only mode: its ``cv_results_`` is byte-identical
 to ``adaptive=None``.
+
+The same spec drives the STREAMED search (``fit(ChunkedDataset, ...)``)
+through the out-of-core drivers' pass-boundary rung seam: rungs fire at
+whole-dataset block-pass boundaries (an L-BFGS iteration / SGD epoch),
+scored with one extra pass of decomposable ``STREAM_SCORERS``
+sufficient statistics over the already-resident blocks, and killed
+candidates' task-tree lanes compact out of the streamed batch — later
+passes stream the same bytes through fewer programs.
 """
 
 import math
@@ -61,14 +69,18 @@ class HalvingSpec:
         ``min_slices`` slices of the compacted loop (the slice size
         itself is ``SKDIST_SLICE_ITERS`` / ~1/8 of ``max_iter`` — see
         ``parallel.resolve_slice_iters``), so the first rung decision
-        happens after ``min_slices * slice_iters`` iterations.
+        happens after ``min_slices * slice_iters`` iterations. On the
+        streamed (ChunkedDataset) path the cadence unit is whole-
+        dataset BLOCK PASSES instead: a rung fires after every
+        ``min_slices`` passes (an L-BFGS iteration / SGD epoch).
     metric : str, default 'auto'
         Device scorer used for rung decisions. ``'auto'`` follows the
         search's refit metric. Must resolve to a ``DEVICE_SCORERS``
-        kernel compatible with the label set; when it cannot (host-only
-        scorers, incompatible binary metrics), adaptive search WARNS
-        and falls back to exhaustive execution — it never gathers
-        per-rung predictions host-side.
+        kernel (resident path) or a decomposable ``STREAM_SCORERS``
+        kernel (streamed path) compatible with the label set; when it
+        cannot (host-only scorers, incompatible binary metrics),
+        adaptive search WARNS and falls back to exhaustive execution —
+        it never gathers per-rung predictions host-side.
     """
 
     def __init__(self, eta=3, min_slices=1, metric="auto"):

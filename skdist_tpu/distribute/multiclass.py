@@ -913,18 +913,18 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
         self.n_jobs = n_jobs
         self.verbose = verbose
 
-    def fit(self, X, y, **fit_params):
+    def fit(self, X, y=None, **fit_params):
         check_estimator_backend(self, self.verbose)
         from ..data import is_chunked
 
-        if is_chunked(X):
-            raise NotImplementedError(
-                "DistOneVsOneClassifier does not stream ChunkedDataset "
-                "input yet (pair-masked fits are planned on the same "
-                "task axis as the streamed OvR); use "
-                "DistOneVsRestClassifier or resident X"
-            )
         backend = resolve_backend(self.backend, n_jobs=self.n_jobs)
+        if is_chunked(X):
+            return self._fit_streamed(backend, X, y, fit_params)
+        if y is None:
+            raise ValueError(
+                "y is required for resident input (only ChunkedDataset "
+                "input carries its own labels)"
+            )
         y = np.asarray(y)
         self.classes_ = np.unique(y)
         k = len(self.classes_)
@@ -936,6 +936,126 @@ class DistOneVsOneClassifier(BaseEstimator, ClassifierMixin):
             done = self._try_batched(backend, X, y, sample_weight=sw)
         if done is None:
             self._fit_generic(backend, X, y, fit_params)
+        self.estimator = clone(self.estimator)
+        strip_runtime(self)
+        return self
+
+    # -- streamed out-of-core path --------------------------------------
+    def _fit_streamed(self, backend, dataset, y, fit_params):
+        """OvO over a ChunkedDataset: the PAIR axis rides the task axis
+        of ONE streamed fit — every block is read once per solver pass
+        for ALL ``k·(k-1)/2`` pairs, with pair membership composed on
+        device as a weight mask (``in_pair × sample_weight``, the
+        resident batched path's idiom) and labels binarised per task
+        (positive class ``j``). No host fallback exists for out-of-core
+        input, so unsupported configurations raise with the
+        resident-path remedy."""
+        import jax.numpy as jnp
+
+        from ..models.linear import (
+            _annotate_stream_meta, _freeze, hyper_float,
+            prepare_sample_weight,
+        )
+        from ..models.streaming import stream_fit_tasks
+
+        est = self.estimator
+        est_cls = type(est)
+        if getattr(est_cls, "_stream_fit_kind", None) is None:
+            raise ValueError(
+                f"{est_cls.__name__} has no streamed fit driver; "
+                "ChunkedDataset OvO supports the linear families"
+            )
+        if getattr(est, "class_weight", None) is not None:
+            raise ValueError(
+                "class_weight does not map onto the streamed {0,1} "
+                "binary sub-problems; fit with resident X for "
+                "class-weighted OvO"
+            )
+        if getattr(est, "engine", None) == "host":
+            raise ValueError(
+                "engine='host' cannot fit a ChunkedDataset; use "
+                "engine='auto'/'xla'"
+            )
+        if y is None:
+            y = dataset.load_y()
+        y = np.asarray(y)
+        if y.ndim != 1 and not (y.ndim == 2 and y.shape[1] == 1):
+            raise ValueError(
+                "OvO needs 1-D multiclass labels; got y with shape "
+                f"{y.shape}"
+            )
+        y = y.reshape(-1)
+        sw, sw_ok = full_length_sample_weight(fit_params, dataset.n_rows)
+        if not sw_ok:
+            raise ValueError(
+                "streamed OvO supports only a full-length sample_weight "
+                f"fit param; got {sorted(fit_params)}"
+            )
+        if sw is None:
+            sw = dataset.load_sw()
+        self.classes_ = np.unique(y)
+        k = len(self.classes_)
+        self.pairs_ = [(i, j) for i in range(k) for j in range(i + 1, k)]
+        y_idx = np.searchsorted(self.classes_, y).astype(np.int32)
+        sw_arr = prepare_sample_weight(sw, dataset.n_rows)
+        # binary sub-problem meta: classes {0, 1} exactly like the
+        # resident batched path's _binary_prep
+        meta = _annotate_stream_meta({
+            "n_features": dataset.n_features,
+            "classes": np.arange(2, dtype=np.int64),
+            "n_classes": 2,
+            "cw_arr": None,
+        }, dataset)
+        static = _freeze(est._static_config(meta))
+        n_pairs = len(self.pairs_)
+        hyper = {
+            name: np.full(
+                n_pairs, float(hyper_float(getattr(est, name))),
+                np.float32,
+            )
+            for name in est_cls._hyper_names
+        }
+        if est_cls._stream_fit_kind == "gram" and "alpha" not in hyper:
+            hyper["alpha"] = np.full(
+                n_pairs, float(hyper_float(est.alpha)), np.float32
+            )
+        task_args = {
+            "hyper": hyper,
+            "i": np.asarray([p[0] for p in self.pairs_], np.int32),
+            "j": np.asarray([p[1] for p in self.pairs_], np.int32),
+        }
+
+        def derive(block, task):
+            yi = block["y"]
+            in_pair = (yi == task["i"]) | (yi == task["j"])
+            yb = (yi == task["j"]).astype(jnp.int32)
+            # pair membership composes multiplicatively with the
+            # caller's weights; block tail-padding rows carry zero
+            # weight and fall out of every pair
+            w = in_pair.astype(jnp.float32) * block["sw"]
+            return block["X"], yb, w, task["hyper"]
+
+        params = stream_fit_tasks(
+            backend, est_cls, meta, static, dataset,
+            {"y": y_idx, "sw": sw_arr}, task_args, derive=derive,
+            key_extra=("ovo",),
+        )
+        _warn_nonfinite_lanes(
+            params,
+            lambda t: "pair (%r, %r)" % (
+                self.classes_[self.pairs_[t][0]],
+                self.classes_[self.pairs_[t][1]],
+            ),
+            "one-vs-one",
+        )
+        self.estimators_ = [
+            _make_fitted_binary(
+                est,
+                {key: np.asarray(v)[t] for key, v in params.items()},
+                meta,
+            )
+            for t in range(n_pairs)
+        ]
         self.estimator = clone(self.estimator)
         strip_runtime(self)
         return self

@@ -45,6 +45,7 @@ from ..metrics import (
     default_device_scorer,
     device_scorer_compatible,
     resolve_rung_scorer,
+    resolve_stream_rung,
     scorer_task_compatible,
 )
 from ..parallel import (
@@ -1371,6 +1372,19 @@ class DistBaseSearchCV(BaseEstimator):
         scorer_specs = _resolve_stream_scoring(estimator, self.scoring, y)
         n = dataset.n_rows
         n_splits = len(splits)
+        # adaptive (ASHA) bookkeeping, the streamed mirror of the
+        # batched path's: rungs fire at block-pass boundaries inside
+        # the streamed drivers (an L-BFGS iteration / SGD epoch =
+        # one whole-dataset pass), scored with one extra pass of
+        # decomposable sufficient statistics over the already-resident
+        # blocks — never a host gather of predictions
+        adaptive = getattr(self, "adaptive", None)
+        killed_gids = {}
+        restored_killed = {}
+        any_dispatched = False
+        y_classes = (
+            np.unique(y) if adaptive is not None and y is not None else None
+        )
         sw_param, sw_ok = full_length_sample_weight(fit_params, n)
         extra = [k for k in fit_params if k != "sample_weight"]
         if not sw_ok or extra:
@@ -1394,10 +1408,13 @@ class DistBaseSearchCV(BaseEstimator):
             for gid, row in checkpoint.completed.items():
                 if 0 <= gid < len(out):
                     row = dict(row)
-                    # tolerate rows journaled by an adaptive resident
-                    # run of the same signature shape (tag stripped for
-                    # aggregate_score_dicts' uniform keys)
-                    row.pop("rung_killed", None)
+                    # a journaled rung kill restores AS a kill: the row
+                    # already carries its error_score values, and the
+                    # tag (stripped for aggregate_score_dicts' uniform
+                    # keys) feeds the rung_ column on resume
+                    rk = row.pop("rung_killed", None)
+                    if rk is not None:
+                        restored_killed[gid] = int(rk)
                     out[gid] = row
                     restored.add(gid)
         hyper_names = list(getattr(est_cls, "_hyper_names", ()))
@@ -1451,6 +1468,8 @@ class DistBaseSearchCV(BaseEstimator):
                     gids.append(gid)
             if not gids:
                 continue
+            any_dispatched = True
+            gids_arr = np.asarray(gids, dtype=np.int64)
             y_enc, sw_arr, meta = bucket_est._prep_stream_fit(
                 dataset, y, sw
             )
@@ -1464,6 +1483,55 @@ class DistBaseSearchCV(BaseEstimator):
                 "split": np.asarray(split_ids, dtype=np.int32),
             }
             row_arrays = {"y": y_enc, "sw": sw_arr, "fold": fold_id}
+            # adaptive rung evaluator: resolve the rung metric to a
+            # decomposable streamed scorer (None → warn-and-exhaustive
+            # via the engaged flag in fit) and group each candidate's
+            # fold lanes so they live and die together. The gram
+            # driver's direct solve has no pass boundaries — adaptive
+            # over it stays exhaustive by construction.
+            rung_ctrl = None
+            rung_pair = None
+            if adaptive is not None and est_cls._stream_fit_kind != "gram":
+                rung_pair = resolve_stream_rung(
+                    adaptive.metric, scorer_specs, self.refit,
+                    y_classes, est_cls=est_cls,
+                )
+                if rung_pair is not None:
+                    rung_ctrl = RungController(
+                        adaptive.eta, adaptive.min_slices,
+                        groups=gids_arr // n_splits,
+                    )
+            rung_hook = None
+            if rung_ctrl is not None:
+                rung_weight = {"test": weight_fns["test"]}
+
+                def rung_hook(pass_idx, live_ids, make_params,
+                              _ctrl=rung_ctrl, _pair=rung_pair,
+                              _ta=task_args, _meta=meta, _static=static,
+                              _rw=rung_weight):
+                    # min_slices is the rung cadence in whole-dataset
+                    # block passes on this path
+                    if not _ctrl.due(pass_idx):
+                        return np.empty(0, np.int64)
+                    live_tasks = {
+                        "hyper": {
+                            k: v[live_ids]
+                            for k, v in _ta["hyper"].items()
+                        },
+                        "split": _ta["split"][live_ids],
+                    }
+                    # one extra pass of sufficient statistics over the
+                    # already-resident blocks; stats=None continues the
+                    # fit's live accounting dict (backend.last_round_stats)
+                    sc = stream_scores(
+                        backend, est_cls, _meta, _static, dataset,
+                        row_arrays, live_tasks, make_params(),
+                        [_pair], _rw, key_extra=("cv", "rung"),
+                    )
+                    return _ctrl.decide(
+                        live_ids, sc["test_rung"], pass_idx
+                    )
+
             t0 = time.perf_counter()
             # key_extra distinguishes this fold-masked derive from the
             # plain single-fit derive in the structural compile keys —
@@ -1471,8 +1539,22 @@ class DistBaseSearchCV(BaseEstimator):
             params = stream_fit_tasks(
                 backend, est_cls, meta, static, dataset, row_arrays,
                 task_args, derive=derive, key_extra=("cv",),
+                rung_hook=rung_hook,
             )
             fit_wall = time.perf_counter() - t0
+            if rung_ctrl is not None:
+                if rung_ctrl.active:
+                    self._adaptive_engaged_ = True
+                # controller ids are the bucket's task-axis indices;
+                # gids_arr maps them back to global (candidate × fold)
+                for lid, r in rung_ctrl.killed.items():
+                    killed_gids[int(gids_arr[lid])] = int(r)
+                if rung_ctrl.history:
+                    stats_live = backend.last_round_stats
+                    stats_live["rung_survivors"] = ",".join(
+                        str(int(h["n_live"] - h["n_killed"]))
+                        for h in rung_ctrl.history
+                    )
             stats = backend.last_round_stats
             t0 = time.perf_counter()
             scores = stream_scores(
@@ -1488,9 +1570,32 @@ class DistBaseSearchCV(BaseEstimator):
                 row["fit_time"] = per_fit
                 row["score_time"] = per_score
                 out[gid] = row
-                if checkpoint is not None:
+                # rung-killed lanes are NOT journaled here: their rows
+                # carry a kill-time carry's raw scores, and a crash
+                # before _apply_rung_retirement's corrective tagged
+                # record would resume them as legitimately completed
+                if checkpoint is not None and gid not in killed_gids:
                     checkpoint.record(gid, row)
-        _quarantine_nonfinite(out, self.error_score, context="streamed")
+        # adaptive rung kills map to error_score rows (one warning, the
+        # rung recorded for the rung_ column and journaled ONCE tagged
+        # rung_killed so a resume restores the kill); the lane
+        # quarantine then handles genuinely diverged lanes, skipping
+        # the killed rows so they are neither double-reported nor
+        # raised on
+        _apply_rung_retirement(
+            out, killed_gids, self.error_score, checkpoint=checkpoint,
+            context="streamed",
+        )
+        if adaptive is not None and not any_dispatched:
+            # every task restored from the journal: the resumed results
+            # ARE the journaled adaptive race — nothing fell back, so
+            # the could-not-engage warning must not fire
+            self._adaptive_engaged_ = True
+        self._rung_killed_gids_ = {**restored_killed, **killed_gids}
+        _quarantine_nonfinite(
+            out, self.error_score, context="streamed",
+            exempt=set(self._rung_killed_gids_),
+        )
         return out
 
     @staticmethod

@@ -465,6 +465,61 @@ def resolve_rung_scorer(metric, scorer_specs, refit, classes=None,
     return producible(("rung", metric, kernel, kind))
 
 
+def resolve_stream_rung(metric, scorer_specs, refit, classes=None,
+                        est_cls=None):
+    """Resolve a ``HalvingSpec.metric`` to the ``(out_name, metric)``
+    pair the STREAMED ASHA rung pass accumulates with
+    ``STREAM_SCORERS`` sufficient-statistics kernels, or None when no
+    decomposable kernel can serve it (the caller then warns and runs
+    the streamed search exhaustively — rung decisions never gather
+    per-rung predictions to the host).
+
+    Mirrors :func:`resolve_rung_scorer`'s policy over the streamed
+    scorer table: ``'auto'`` follows the search's refit metric among
+    the already-resolved ``scorer_specs`` ``[(out_name, metric)]``
+    pairs; an explicit metric must have a ``STREAM_SCORERS`` kernel
+    whose semantics hold for this label set and estimator kind, AND
+    whose output kind the family can produce (a proba rung metric on a
+    family without a proba kernel must fall back, not crash
+    mid-dispatch). The returned pair always carries the ``'rung'``
+    output name — the streamed rung pass scores test-fold rows only,
+    so its accumulator key is ``'test_rung'``.
+    """
+    def producible(pair):
+        if pair is None:
+            return None
+        if STREAM_SCORERS[pair[1]][2] != "proba" or est_cls is None:
+            return pair
+        if not hasattr(est_cls, "_build_proba_kernel"):
+            return None
+        return pair
+
+    if metric in (None, "auto"):
+        if not scorer_specs:
+            return None
+        want = refit if isinstance(refit, str) else "score"
+        for pair in scorer_specs:
+            if pair[0] == want:
+                return producible(("rung", pair[1]))
+        if len(scorer_specs) > 1:
+            import warnings
+
+            warnings.warn(
+                "HalvingSpec(metric='auto') with multimetric scoring "
+                f"and refit={refit!r}: rung kills will rank candidates "
+                f"by {scorer_specs[0][1]!r} (the first resolved scoring "
+                "entry). Pass HalvingSpec(metric=...) to choose the "
+                "metric adaptive halving eliminates by.",
+                UserWarning,
+            )
+        return producible(("rung", scorer_specs[0][1]))
+    if metric not in STREAM_SCORERS:
+        return None
+    if not device_scorer_compatible(metric, classes, task=est_cls):
+        return None
+    return producible(("rung", metric))
+
+
 # ---------------------------------------------------------------------------
 # host scorer resolution (generic path), sklearn-backed
 # ---------------------------------------------------------------------------

@@ -193,21 +193,34 @@ def _n_tasks(task_args):
     return len(np.asarray(next(iter(task_args["hyper"].values()))))
 
 
-def _slot_pad_tree(tree, T, slots):
-    """Pad every task-axis leaf to a slot multiple by repeating the
-    last lane — mesh task sharding needs a divisible axis (the
-    streamed analogue of the round loop's tail padding); padded lanes
-    compute duplicate work and their outputs are sliced off."""
-    Tp = -(-T // max(1, int(slots))) * max(1, int(slots))
+def _take_tree(tree, idx):
+    """Subset every task-axis leaf to the given lane indices — the
+    task-batch SHRINK of a rung kill: retired lanes' slots compact
+    away and later passes dispatch fewer programs."""
+    return jax.tree_util.tree_map(lambda a: np.asarray(a)[idx], tree)
+
+
+def _pad_tree_to(tree, T, Tp):
+    """Pad every task-axis leaf to exactly ``Tp`` rows by repeating
+    the last lane; padded lanes compute duplicate work and their
+    outputs are sliced off."""
     if Tp == T:
-        return tree, T
+        return tree
     pad = Tp - T
     return jax.tree_util.tree_map(
         lambda a: np.concatenate(
             [np.asarray(a), np.repeat(np.asarray(a)[-1:], pad, axis=0)]
         ),
         tree,
-    ), Tp
+    )
+
+
+def _slot_pad_tree(tree, T, slots):
+    """Pad every task-axis leaf to a slot multiple by repeating the
+    last lane — mesh task sharding needs a divisible axis (the
+    streamed analogue of the round loop's tail padding)."""
+    Tp = -(-T // max(1, int(slots))) * max(1, int(slots))
+    return _pad_tree_to(tree, T, Tp), Tp
 
 
 # ---------------------------------------------------------------------------
@@ -305,7 +318,7 @@ def _two_loop_batch(g, S, Y, rho, k):
 
 
 def lbfgs_stream(eval_fg, eval_f, w0, tol, max_iter, history=10,
-                 max_ls=20):
+                 max_ls=20, pass_hook=None):
     """Batched L-BFGS whose objective evaluations are STREAMED passes.
 
     ``eval_fg(W (T,P) f32) -> (f (T,), g (T,P))`` and ``eval_f`` are
@@ -315,12 +328,27 @@ def lbfgs_stream(eval_fg, eval_f, w0, tol, max_iter, history=10,
     constants, direction-normalisation rule, curvature filter, and
     ``done`` semantics (converged at ``tol`` | line-search stall |
     iteration cap) — in host numpy f32 over the task batch, with frozen
-    lanes masked out of every update. Returns ``(W, n_iter, done)``.
+    lanes masked out of every update. Returns ``(W, n_iter, done)``
+    indexed by the ORIGINAL lane order.
+
+    ``pass_hook(pass_idx, lane_ids, w, it, done) -> killed lane ids``
+    is the rung seam, called after every iteration (= one block-pass
+    group of the dataset): ``lane_ids`` maps the batch's current rows
+    to original lanes. Lanes the hook kills are recorded at their
+    kill-time iterate and COMPACTED out of every solver array, so
+    subsequent streamed evaluations dispatch a smaller task batch.
+    Lanes are independent in the batched recursion (every reduction is
+    per-lane, the lockstep line search halves per-lane step sizes), so
+    survivor trajectories are bitwise identical under compaction.
     """
     w = np.ascontiguousarray(w0, dtype=np.float32)
     T, P = w.shape
     m = int(history)
     tol = np.asarray(tol, dtype=np.float32).reshape(T)
+    lanes = np.arange(T)
+    out_w = w.copy()
+    out_it = np.zeros(T, np.int64)
+    out_done = np.zeros(T, bool)
     f, g = eval_fg(w)
     f = np.asarray(f, np.float32).reshape(T)
     g = np.asarray(g, np.float32).reshape(T, P)
@@ -331,7 +359,11 @@ def lbfgs_stream(eval_fg, eval_f, w0, tol, max_iter, history=10,
     it = np.zeros(T, np.int64)
     done = (np.max(np.abs(g), axis=1) <= tol) | (max_iter <= 0)
     rT = np.arange(T)
-    while not done.all():
+    pass_idx = 0
+    while done.size and not done.all():
+        # a pass_hook kill compacts every lane array — the iteration's
+        # temporaries must track the LIVE batch size, not the original
+        T = lanes.size
         d = _two_loop_batch(g, S, Y, rho, k)
         gd0 = np.einsum("tp,tp->t", g, d)
         descent = gd0 < 0
@@ -386,7 +418,26 @@ def lbfgs_stream(eval_fg, eval_f, w0, tol, max_iter, history=10,
         done = np.where(
             live, converged | stalled | (it >= max_iter), done
         )
-    return w, it, done
+        pass_idx += 1
+        if pass_hook is not None:
+            killed = np.asarray(
+                pass_hook(pass_idx, lanes, w, it, done), dtype=np.int64
+            ).reshape(-1)
+            if killed.size:
+                drop = np.isin(lanes, killed)
+                out_w[lanes[drop]] = w[drop]
+                out_it[lanes[drop]] = it[drop]
+                out_done[lanes[drop]] = done[drop]
+                keep = ~drop
+                w, f, g = w[keep], f[keep], g[keep]
+                S, Y, rho = S[keep], Y[keep], rho[keep]
+                k, it, done, tol = k[keep], it[keep], done[keep], tol[keep]
+                lanes = lanes[keep]
+                rT = np.arange(lanes.size)
+    out_w[lanes] = w
+    out_it[lanes] = it
+    out_done[lanes] = done
+    return out_w, out_it, out_done
 
 
 # ---------------------------------------------------------------------------
@@ -460,22 +511,40 @@ def _host_unpack(est_cls, meta, static, dataset):
 # the drivers
 # ---------------------------------------------------------------------------
 
-def _zero_block_dev(plan, dataset, row_arrays, extra_scalars=()):
-    """A one-row zero block, placed once — the regulariser kernels'
-    dummy shared tree."""
+def _check_data_axis_geometry(backend, dataset):
+    """2D (task x data) meshes row-shard every placed block: the padded
+    block height must split evenly over the 'data' axis, or GSPMD's
+    device_put rejects the block with an opaque divisibility error —
+    fail here with the remedy instead."""
+    dsize = getattr(backend, "data_axis_size", 1)
+    if dsize > 1 and dataset.block_rows % dsize:
+        raise ValueError(
+            f"block_rows={dataset.block_rows} does not divide over the "
+            f"mesh 'data' axis (data_axis_size={dsize}); rebuild the "
+            "ChunkedDataset with a block_rows that is a multiple of "
+            "the data axis size"
+        )
+
+
+def _zero_block_dev(plan, dataset, row_arrays, extra_scalars=(), rows=1):
+    """A zero block of ``rows`` rows (all weight-0 padding), placed
+    once — the regulariser kernels' dummy shared tree. ``rows`` is the
+    mesh's data-axis size on 2D backends: even a dummy block must be
+    row-shardable onto the 'data' axis."""
     from ..sparse import PackedX
 
+    rows = max(1, int(rows))
     if dataset.x_format == "packed":
-        X = PackedX(np.zeros((1, dataset.packed_m), np.int32),
-                    np.zeros((1, dataset.packed_m), np.float32),
+        X = PackedX(np.zeros((rows, dataset.packed_m), np.int32),
+                    np.zeros((rows, dataset.packed_m), np.float32),
                     dataset.n_features)
     else:
-        X = np.zeros((1, dataset.n_features), np.float32)
+        X = np.zeros((rows, dataset.n_features), np.float32)
     tree = {"X": X}
     for name, arr in row_arrays.items():
         arr = np.asarray(arr)
         tree[name] = np.full(
-            (1,) + arr.shape[1:], _pad_rows_for(name), arr.dtype
+            (rows,) + arr.shape[1:], _pad_rows_for(name), arr.dtype
         )
     for name in extra_scalars:
         tree[name] = np.int32(0)
@@ -484,7 +553,7 @@ def _zero_block_dev(plan, dataset, row_arrays, extra_scalars=()):
 
 def _fit_lbfgs_stream(backend, est_cls, meta, static, dataset, row_arrays,
                       task_args, derive, stats, sync, key_extra=(),
-                      w_init=None):
+                      w_init=None, rung_hook=None):
     st = dict(static)
     max_iter, history = int(st["max_iter"]), int(st["history"])
     width = est_cls._flat_w_width(meta, static)
@@ -505,64 +574,138 @@ def _fit_lbfgs_stream(backend, est_cls, meta, static, dataset, row_arrays,
         reg_kernel, example,
         cache_key=_stream_key(est_cls, static, meta, "lbfgs_reg", key_extra),
     )
-    # mesh task sharding needs a slot-divisible task axis; padded
-    # lanes duplicate the last task and are sliced off below
-    task_args, Tp = _slot_pad_tree(task_args, T, plan_fg.n_task_slots)
     read = _make_block_read(dataset, row_arrays, pad=True)
     n_blocks = dataset.n_blocks
 
-    state = {"tasks": plan_fg.put_task(task_args)}
-    zero_dev = {"b": _zero_block_dev(plan_reg, dataset, row_arrays)}
+    # the solver runs over the LIVE lane subset; a rung kill shrinks
+    # sel["idx"] and re-places the task tree, so subsequent passes
+    # stream the same bytes through fewer programs. Slot padding (mesh
+    # task sharding needs a divisible axis) happens at the dispatch
+    # seam on the live subset only.
+    sel = {"idx": np.arange(T)}
+    state = {}
+    zero_dev = {}
+
+    def place_tasks(fresh=True):
+        # ``fresh`` recomputes the padded width from the current slot
+        # count; an elastic restart keeps the previous width instead
+        # (the largest-divisor re-layout guarantees it still divides)
+        # so mid-pass device state stays size-consistent.
+        L = sel["idx"].size
+        if fresh or "Lp" not in state:
+            slots = max(1, int(plan_fg.n_task_slots))
+            state["Lp"] = -(-L // slots) * slots
+        state["tasks"] = plan_fg.put_task(
+            _pad_tree_to(_take_tree(task_args, sel["idx"]), L, state["Lp"])
+        )
+        zero_dev["b"] = _zero_block_dev(
+            plan_reg, dataset, row_arrays,
+            rows=getattr(backend, "data_axis_size", 1),
+        )
+
+    place_tasks()
 
     def restart():
         # preemption: device state presumed lost — shrink an elastic
         # mesh to the survivors (rebuilding the three plans), then
         # re-place the task tree and the regulariser's zero block
         _elastic_replans(backend, (plan_fg, plan_f, plan_reg))
-        state["tasks"] = plan_fg.put_task(task_args)
-        zero_dev["b"] = _zero_block_dev(plan_reg, dataset, row_arrays)
+        place_tasks(fresh=False)
         faults.record("shared_replacements")
+
+    def _pad_W(W):
+        L, Lp = W.shape[0], state["Lp"]
+        if Lp == L:
+            return W
+        return np.concatenate([W, np.repeat(W[-1:], Lp - L, axis=0)])
 
     def eval_fg(W):
         W = np.ascontiguousarray(W, np.float32)
-        tc = lambda: {"task": state["tasks"], "W": plan_fg.put_task(W)}
+        L = W.shape[0]
+        tc = lambda: {"task": state["tasks"],
+                      "W": plan_fg.put_task(_pad_W(W))}
         acc = _streamed_sum(plan_fg, read, n_blocks, tc, stats, sync,
                             restart=restart)
         reg = jax.device_get(plan_reg.fn(zero_dev["b"], tc()))
-        return (np.asarray(acc["f"]) + np.asarray(reg["f"]),
-                np.asarray(acc["g"]) + np.asarray(reg["g"]))
+        return (np.asarray(acc["f"])[:L] + np.asarray(reg["f"])[:L],
+                np.asarray(acc["g"])[:L] + np.asarray(reg["g"])[:L])
 
     def eval_f(W):
         W = np.ascontiguousarray(W, np.float32)
-        tc = lambda: {"task": state["tasks"], "W": plan_f.put_task(W)}
+        L = W.shape[0]
+        tc = lambda: {"task": state["tasks"],
+                      "W": plan_f.put_task(_pad_W(W))}
         acc = _streamed_sum(plan_f, read, n_blocks, tc, stats, sync,
                             restart=restart)
         reg = jax.device_get(plan_reg.fn(zero_dev["b"], tc()))
-        return np.asarray(acc["f"]) + np.asarray(reg["f"])
+        return np.asarray(acc["f"])[:L] + np.asarray(reg["f"])[:L]
 
-    w0 = np.zeros((Tp, width), np.float32)
+    w0 = np.zeros((T, width), np.float32)
     if w_init is not None:
-        # warm start: real lanes begin at the caller's (T, width)
-        # seeds; padded lanes stay zero (sliced off below either way)
-        wi = np.asarray(w_init, np.float32).reshape(T, width)
-        w0[:T] = wi
-    tol = np.asarray(task_args["hyper"]["tol"], np.float32)
+        # warm start: lanes begin at the caller's (T, width) seeds
+        w0[:] = np.asarray(w_init, np.float32).reshape(T, width)
+    tol = np.asarray(task_args["hyper"]["tol"], np.float32).reshape(T)
+    unpack = _host_unpack(est_cls, meta, static, dataset)
+
+    pass_hook = None
+    if rung_hook is not None:
+        def pass_hook(pass_idx, lane_ids, w_rows, it_rows, done_rows):
+            live = ~done_rows
+            live_ids = lane_ids[live]
+            if live_ids.size == 0:
+                return np.empty(0, np.int64)
+            w_live, it_live = w_rows[live], it_rows[live]
+
+            def make_params():
+                return _stack_params([
+                    unpack(w_live[i], int(it_live[i]))
+                    for i in range(live_ids.size)
+                ])
+
+            killed = np.asarray(
+                rung_hook(pass_idx, live_ids, make_params), np.int64
+            ).reshape(-1)
+            if killed.size:
+                sel["idx"] = lane_ids[~np.isin(lane_ids, killed)]
+                if sel["idx"].size:  # all-killed: no further dispatches
+                    place_tasks()
+                stats["retired_rung"] = (
+                    stats.get("retired_rung", 0) + int(killed.size)
+                )
+                # counterfactual upper bound: a killed lane would have
+                # paid at most (max_iter - pass_idx) more solver passes
+                stats["passes_saved"] = (
+                    stats.get("passes_saved", 0)
+                    + int(killed.size) * max(0, max_iter - pass_idx)
+                )
+            return killed
+
     W, n_iter, _done = lbfgs_stream(
         eval_fg, eval_f, w0, tol, max_iter, history=history,
-        max_ls=20,
+        max_ls=20, pass_hook=pass_hook,
     )
-    unpack = _host_unpack(est_cls, meta, static, dataset)
+    if rung_hook is not None and sel["idx"].size < T:
+        # bytes are shared across lanes per pass: the race ending at
+        # max(n_iter) instead of the iteration cap saves whole-dataset
+        # passes (an upper-bound estimate, documented as such)
+        stats["streamed_bytes_saved"] = (
+            stats.get("streamed_bytes_saved", 0)
+            + int(dataset.nbytes_estimate)
+            * max(0, max_iter - int(n_iter.max(initial=0)))
+        )
     params = [unpack(W[t], int(n_iter[t])) for t in range(T)]
     return _stack_params(params)
 
 
 def _fit_gram_stream(backend, est_cls, meta, static, dataset, row_arrays,
                      task_args, derive, stats, sync, key_extra=(),
-                     w_init=None):
+                     w_init=None, rung_hook=None):
     """Block-accumulated normal equations for the ridge family: stream
     ``(XᵀSX, XᵀST)`` partials, finish with one solve per task.
     ``w_init`` is accepted and ignored — a direct solve has no
-    iterate to seed."""
+    iterate to seed; ``rung_hook`` likewise — a one-pass direct solve
+    has no pass boundaries for a rung to act between (an adaptive
+    search over a gram family stays exhaustive and warns)."""
     from .linear import (
         _apply_class_weight, _linear_op, maybe_exact_matmuls,
     )
@@ -647,13 +790,16 @@ def _fit_gram_stream(backend, est_cls, meta, static, dataset, row_arrays,
 
 def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
                     task_args, derive, stats, sync, key_extra=(),
-                    w_init=None):
+                    w_init=None, rung_hook=None):
     """Epochs as block streams: visit blocks in order, advance the
     mini-batch carry through the resident scan's exact update
     (``solvers.sgd_batch_scan``), apply the epoch-end early-stopping
     bookkeeping host-side in f32 — mirroring ``solvers._sgd_epoch_body``
     value for value, so an aligned, unshuffled streamed fit is bitwise
-    identical to the resident kernel."""
+    identical to the resident kernel. ``rung_hook`` (see
+    :func:`stream_fit_tasks`) is consulted at every epoch boundary —
+    the SGD rendition of the rung-at-block-pass contract: killed lanes
+    record their kill-time carry and compact out of the device batch."""
     from .linear import maybe_exact_matmuls
     from .solvers import sgd_batch_scan
 
@@ -760,34 +906,64 @@ def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
     n_batches_total = np.float32(-(-n // batch_size))
 
     T = _n_tasks(task_args)
-    task_args, Tp = _slot_pad_tree(task_args, T, plan.n_task_slots)
-    tol = np.asarray(task_args["hyper"]["tol"], np.float32)
-    tasks_dev = plan.put_task(task_args)
+    tol = np.asarray(task_args["hyper"]["tol"], np.float32).reshape(T)
     if penalty in ("l1", "elasticnet"):
-        pstate0 = (np.zeros(Tp, np.float32),
-                   np.zeros((Tp, width), np.float32))
+        pstate0 = (np.zeros(T, np.float32),
+                   np.zeros((T, width), np.float32))
     else:
         pstate0 = ()
-    w0 = np.zeros((Tp, width), np.float32)
+    w0 = np.zeros((T, width), np.float32)
     if w_init is not None:
         # warm start: epochs begin at the caller's (T, width) seeds
-        w0[:T] = np.asarray(w_init, np.float32).reshape(T, width)
-    carry = plan.put_task({
-        "w": w0,
-        "pstate": pstate0,
-        "step": np.zeros(Tp, np.int32),
-        "acc": np.zeros(Tp, np.float32),
+        w0[:] = np.asarray(w_init, np.float32).reshape(T, width)
+
+    # the device batch covers the LIVE lane subset (sel["idx"]); host
+    # bookkeeping stays full-size, indexed through the lane map. A
+    # rung kill records the killed lanes' carry into w_out and
+    # compacts the device batch — later epochs stream the same blocks
+    # through fewer programs.
+    sel = {"idx": np.arange(T)}
+    dev = {}
+
+    def place_tasks(fresh=True):
+        # ``fresh`` recomputes the padded width from the current slot
+        # count; an elastic restart keeps the previous width instead
+        # (the largest-divisor re-layout guarantees it still divides)
+        # so the epoch-start carry snapshot stays size-consistent.
+        L = sel["idx"].size
+        if fresh or "Lp" not in sel:
+            slots = max(1, int(plan.n_task_slots))
+            sel["Lp"] = -(-L // slots) * slots
+        dev["tasks"] = plan.put_task(
+            _pad_tree_to(_take_tree(task_args, sel["idx"]), L, sel["Lp"])
+        )
+
+    def place_carry(host_tree_L):
+        return plan.put_task(
+            _pad_tree_to(host_tree_L, sel["idx"].size, sel["Lp"])
+        )
+
+    place_tasks()
+    carry = place_carry({
+        "w": w0, "pstate": pstate0,
+        "step": np.zeros(T, np.int32),
+        "acc": np.zeros(T, np.float32),
     })
     # host-side early-stopping state (mirrors _sgd_epoch_body's tail)
-    best = np.full(Tp, np.inf, np.float32)
-    bad = np.zeros(Tp, np.int64)
-    n_done = np.zeros(Tp, np.int64)
-    done = np.zeros(Tp, bool)
+    best = np.full(T, np.inf, np.float32)
+    bad = np.zeros(T, np.int64)
+    n_done = np.zeros(T, np.int64)
+    done = np.zeros(T, bool)
+    w_out = w0.copy()
+    unpack = _sgd_host_unpack(est_cls, meta, static)
 
     guard = _BlockRetry(stats)
     epoch_guard = _BlockRetry(stats)
     e = 0
+    epochs_run = 0
     while e < max_iter:
+        lane = sel["idx"]
+        L = lane.size
         carry_start = carry
         # host snapshot of the epoch-start carry: the preemption
         # restart below (and the epoch-retry path) re-place from it
@@ -805,20 +981,20 @@ def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
                 item = feeder.next()
                 if item is None:
                     break
-                i, dev = item
+                i, dv = item
                 t0 = time.perf_counter()
                 try:
                     _dispatch_seam()
-                    carry = plan.fn(dev, {"task": tasks_dev,
-                                          "carry": carry})
+                    carry = plan.fn(dv, {"task": dev["tasks"],
+                                         "carry": carry})
                 except Exception as exc:
                     def restart():
                         # preemption loses device state: shrink an
                         # elastic mesh to the survivors, re-place the
                         # tasks and rewind to the epoch-start carry
-                        nonlocal tasks_dev, carry
+                        nonlocal carry
                         _elastic_replans(backend, (plan,))
-                        tasks_dev = plan.put_task(task_args)
+                        place_tasks(fresh=False)
                         carry = _reset_acc(plan.put_task(host_start))
                         faults.record("shared_replacements")
 
@@ -832,7 +1008,9 @@ def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
         finally:
             feeder.close()
         try:
-            acc = np.asarray(jax.device_get(carry["acc"]), np.float32)
+            acc = np.asarray(
+                jax.device_get(carry["acc"]), np.float32
+            )[:L]
         except Exception as exc:
             # async fault surfacing only at the blocking gather: the
             # whole epoch's carry chain is suspect — re-run the epoch
@@ -844,35 +1022,86 @@ def _fit_sgd_stream(backend, est_cls, meta, static, dataset, row_arrays,
             stats["retries"] = epoch_guard.retry.total
             if kind == faults.PREEMPTED:
                 _elastic_replans(backend, (plan,))
-                tasks_dev = plan.put_task(task_args)
+                place_tasks(fresh=False)
                 faults.record("shared_replacements")
             carry = plan.put_task(host_start)
             continue
+        epochs_run = e + 1
         # ---- epoch-end bookkeeping: the resident epoch body's tail,
         # value for value, in host f32 (same IEEE ops => bitwise) -----
-        keep = done.copy()
+        keep = done[lane]
         loss = (acc / n_batches_total).astype(np.float32)
-        improved = loss < (best - tol).astype(np.float32)
-        bad_new = np.where(improved, 0, bad + 1)
+        improved = loss < (best[lane] - tol[lane]).astype(np.float32)
+        bad_new = np.where(improved, 0, bad[lane] + 1)
         newly_stopped = bad_new >= n_iter_no_change
-        best_new = np.minimum(best, loss).astype(np.float32)
+        best_new = np.minimum(best[lane], loss).astype(np.float32)
         if keep.any():
             # frozen lanes keep their epoch-start carry, exactly like
             # the resident scan's pick()
-            kmask = plan.put_task(keep)
-            carry = _pick_carry(kmask, carry_start, carry)
-        best = np.where(keep, best, best_new)
-        bad = np.where(keep, bad, bad_new)
-        n_done = np.where(keep, n_done, n_done + 1)
-        done = keep | newly_stopped | ((e + 1) >= max_iter)
-        if done.all():
+            kmask = _pad_tree_to(keep, L, sel["Lp"])
+            carry = _pick_carry(plan.put_task(kmask), carry_start, carry)
+        best[lane] = np.where(keep, best[lane], best_new)
+        bad[lane] = np.where(keep, bad[lane], bad_new)
+        n_done[lane] = np.where(keep, n_done[lane], n_done[lane] + 1)
+        done[lane] = keep | newly_stopped | ((e + 1) >= max_iter)
+        # ---- rung hook at the epoch (block-pass) boundary ----------
+        if rung_hook is not None:
+            live = ~done[lane]
+            live_ids = lane[live]
+            if live_ids.size:
+                def make_params():
+                    w_h = np.asarray(
+                        jax.device_get(carry["w"]), np.float32
+                    )[:L][live]
+                    return _stack_params([
+                        unpack(w_h[i], int(n_done[live_ids[i]]))
+                        for i in range(live_ids.size)
+                    ])
+
+                killed = np.asarray(
+                    rung_hook(e + 1, live_ids, make_params), np.int64
+                ).reshape(-1)
+                if killed.size:
+                    host_c = jax.tree_util.tree_map(
+                        lambda a: np.asarray(a)[:L],
+                        jax.device_get(carry),
+                    )
+                    drop = np.isin(lane, killed)
+                    w_out[lane[drop]] = np.asarray(
+                        host_c["w"], np.float32
+                    )[drop]
+                    done[killed] = True
+                    sel["idx"] = lane[~drop]
+                    if sel["idx"].size:
+                        place_tasks()
+                        carry = place_carry(_take_tree(
+                            host_c, np.flatnonzero(~drop)
+                        ))
+                    stats["retired_rung"] = (
+                        stats.get("retired_rung", 0) + int(killed.size)
+                    )
+                    stats["passes_saved"] = (
+                        stats.get("passes_saved", 0)
+                        + int(killed.size) * max(0, max_iter - (e + 1))
+                    )
+        lane_now = sel["idx"]
+        if lane_now.size == 0 or done[lane_now].all():
             break
         e += 1
 
-    w_host = np.asarray(jax.device_get(carry["w"]), np.float32)
+    lane = sel["idx"]
+    if lane.size:
+        w_out[lane] = np.asarray(
+            jax.device_get(carry["w"]), np.float32
+        )[: lane.size]
+    if rung_hook is not None and lane.size < T:
+        stats["streamed_bytes_saved"] = (
+            stats.get("streamed_bytes_saved", 0)
+            + int(dataset.nbytes_estimate)
+            * max(0, max_iter - epochs_run)
+        )
     # unpack per task (host reshape, identical to the family unpack)
-    unpack = _sgd_host_unpack(est_cls, meta, static)
-    params = [unpack(w_host[t], int(n_done[t])) for t in range(T)]
+    params = [unpack(w_out[t], int(n_done[t])) for t in range(T)]
     return _stack_params(params)
 
 
@@ -920,7 +1149,7 @@ def _stack_params(params_list):
 
 def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
                      task_args, derive=None, sync=None, stats=None,
-                     key_extra=(), w_init=None):
+                     key_extra=(), w_init=None, rung_hook=None):
     """Fit a batch of tasks over a ChunkedDataset with the family's
     streamed driver. ``row_arrays`` maps per-row vector names (``y``
     encoded labels, ``sw`` weights, ``fold`` CV fold ids, ...) to
@@ -929,8 +1158,20 @@ def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
     the family's fit problem (fold masking, OvR binarisation).
     ``w_init`` (``(T, width)`` flat-layout seeds) warm-starts the
     iterative drivers' solver carries (the gram driver's direct solve
-    ignores it). Returns a dict of stacked ``(T, ...)`` fitted
-    params."""
+    ignores it).
+
+    ``rung_hook(pass_idx, live_ids, make_params) -> killed lane ids``
+    is the streamed ASHA seam: the iterative drivers call it at every
+    block-pass boundary (an L-BFGS iteration, an SGD epoch) with the
+    not-yet-converged lane ids and a zero-arg ``make_params`` closure
+    materialising those lanes' CURRENT fitted params (for a
+    sufficient-statistics scoring pass over the already-resident
+    blocks, :func:`stream_scores`). Lanes it returns are recorded at
+    their kill-time iterate and compacted out of the device batch —
+    retired lanes stop paying device FLOPs and their task-tree slots
+    compact away. The gram driver has no pass boundaries and ignores
+    the hook. Returns a dict of stacked ``(T, ...)`` fitted params
+    (killed lanes carry their kill-time params)."""
     kind = getattr(est_cls, "_stream_fit_kind", None)
     if kind is None:
         raise TypeError(
@@ -938,6 +1179,7 @@ def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
             "(_stream_fit_kind is unset); materialise the dataset or "
             "use a linear family"
         )
+    _check_data_axis_geometry(backend, dataset)
     sync = _resolve_sync(backend, sync)
     if stats is None:
         stats = _stream_stats(backend, sync)
@@ -950,7 +1192,7 @@ def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
     stats["tasks"] = stats.get("tasks", 0) + _n_tasks(task_args)
     out = driver(backend, est_cls, meta, static, dataset, row_arrays,
                  task_args, derive, stats, sync, key_extra=key_extra,
-                 w_init=w_init)
+                 w_init=w_init, rung_hook=rung_hook)
     # delta-publication (publish_round_stats): safe on a shared/
     # re-published dict — the CV driver hands this same dict to
     # stream_scores, whose own publish folds only the scoring pass
@@ -974,6 +1216,7 @@ def stream_scores(backend, est_cls, meta, static, dataset, row_arrays,
     from .linear import maybe_exact_matmuls
     from ..metrics import STREAM_SCORERS
 
+    _check_data_axis_geometry(backend, dataset)
     sync = _resolve_sync(backend, sync)
     if stats is None:
         # continue the fit's dict when one exists (the CV driver's

@@ -561,6 +561,13 @@ ROUND_STATS_REQUIRED = {
     "retired_convergence": 0,  # lanes that ran to convergence/cap
     "shared_bytes": 0,       # placed shared-tree bytes (broadcast leg)
     "streamed_bytes": 0,     # H2D-fed block bytes (streaming leg)
+    # streamed-rung accounting (both are documented upper-bound
+    # estimates — see models/streaming's rung seams): solver passes the
+    # killed lanes would still have paid, and whole-dataset bytes the
+    # shortened race never streamed
+    "passes_saved": 0,
+    "streamed_bytes_saved": 0,
+    "rung_survivors": None,  # per-rung survivor counts, "12,4,2"
 }
 
 
@@ -591,6 +598,7 @@ def new_round_stats(mode=None, **extra):
 _ROUND_PUBLISH_KEYS = (
     "rounds", "tasks", "retries", "dispatch_s", "gather_wait_s",
     "retired_rung", "retired_convergence", "streamed_bytes",
+    "passes_saved", "streamed_bytes_saved",
 )
 
 

@@ -28,13 +28,19 @@ from .backend import (
     tree_nbytes,
 )
 from .compile_cache import enable_disk_cache, structural_key
-from .mesh import ElasticMeshManager
+from .mesh import (
+    ElasticMeshManager,
+    STREAM_BLOCK_RULES,
+    match_partition_rules,
+)
 
 __all__ = [
     "TaskBackend",
     "LocalBackend",
     "TPUBackend",
     "ElasticMeshManager",
+    "STREAM_BLOCK_RULES",
+    "match_partition_rules",
     "BatchedPlan",
     "BlockFeeder",
     "StreamPlan",

@@ -582,11 +582,14 @@ class LocalBackend(TaskBackend):
     supports_iterative = True
 
     def prepare_streamed(self, kernel, block_example=None,
-                         static_args=None, cache_key=None):
+                         static_args=None, cache_key=None,
+                         partition_rules=None):
         """Jit entry + placement fns for a block-streamed dispatch
         (``kernel(block, task)``; tasks vmapped on the leading axis):
         the task tree is placed once by the caller, the shared tree —
-        one data block — per block by a :class:`BlockFeeder`."""
+        one data block — per block by a :class:`BlockFeeder`.
+        ``partition_rules`` is accepted for signature parity with the
+        mesh backend and ignored (no mesh to place onto)."""
         import jax
         import jax.numpy as jnp
 
@@ -830,9 +833,15 @@ class TPUBackend(TaskBackend):
         """Swap in a (shrunken or regrown) elastic mesh: the device
         roster and every placement decision from here on bind to it;
         compiled programs for the new sharding build lazily through
-        the ordinary structural-cache path."""
+        the ordinary structural-cache path. The data-axis size is
+        re-derived from the adopted mesh — a both-axis elastic
+        re-layout may have shrunk (or restored) the 'data' axis, and
+        every row-sharding decision keys on the CURRENT size."""
         self.mesh = mesh
         self.devices = list(mesh.devices.flat)
+        self.data_axis_size = dict(
+            zip(mesh.axis_names, mesh.devices.shape)
+        ).get("data", 1)
 
     def elastic_preempted(self):
         """A round classified PREEMPTED: drop cached broadcasts
@@ -957,17 +966,23 @@ class TPUBackend(TaskBackend):
     supports_iterative = True
 
     def prepare_streamed(self, kernel, block_example=None,
-                         static_args=None, cache_key=None):
+                         static_args=None, cache_key=None,
+                         partition_rules=None):
         """Mesh variant of the streamed plan: the task axis shards over
         the task mesh axis exactly like :meth:`prepare_batched`'s, and
         the per-block shared tree row-shards onto the mesh 'data' axis
-        when one exists (:func:`_block_shardings`) — streamed blocks
-        land on the same axis the resident row-sharded path uses, so
-        GSPMD inserts the identical psum of gram/gradient partials.
+        when one exists — resolved through the declarative
+        partition-rule table (:func:`_block_shardings`;
+        ``partition_rules`` overrides the default
+        :data:`~skdist_tpu.parallel.mesh.STREAM_BLOCK_RULES`) —
+        streamed blocks land on the same axis the resident row-sharded
+        path uses, so GSPMD inserts the identical psum of
+        gram/gradient partials.
 
         The returned plan carries a ``rebuild`` hook re-resolving it
         against the backend's CURRENT mesh — the elastic-restart seam
-        for the streamed drivers."""
+        for the streamed drivers (a both-axis elastic re-layout is
+        picked up here, including a shrunken 'data' axis)."""
         self.elastic_regrow_check()
 
         def resolve(plan):
@@ -975,7 +990,9 @@ class TPUBackend(TaskBackend):
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             task_sharding = NamedSharding(self.mesh, P(self.axis_name))
-            block_shardings = _block_shardings(self, block_example)
+            block_shardings = _block_shardings(
+                self, block_example, partition_rules
+            )
             plan.fn = _jit_vmapped(
                 kernel, static_args, task_sharding, block_shardings,
                 cache_key, False,
@@ -1511,33 +1528,35 @@ class StreamPlan:
             self._rebuild(self)
 
 
-def _block_shardings(backend, block_example):
-    """Per-leaf shardings of a streamed block on a mesh backend: row
-    leaves (leading axis == the block's row count) ride the mesh 'data'
-    axis when one exists — the streamed analogue of
-    ``row_sharded_specs`` (GSPMD then psums the solver contractions
-    over the data axis exactly as in the resident row-sharded path) —
-    and everything else (per-block scalars like the SGD epoch clock)
-    replicates. On 1D meshes everything replicates."""
+def _block_shardings(backend, block_example, rules=None):
+    """Per-leaf shardings of a streamed block on a mesh backend,
+    resolved DECLARATIVELY: a named-axis partition-rule table (regex
+    over '/'-joined block-tree paths → ``PartitionSpec``,
+    :func:`~skdist_tpu.parallel.mesh.match_partition_rules`) replaces
+    the old hand-plumbed leading-dim heuristic. Under the default
+    :data:`~skdist_tpu.parallel.mesh.STREAM_BLOCK_RULES` the design
+    matrix (dense ``X`` or packed-CSR children) and the per-row
+    vectors (``y``/``sw``/``fold``) ride the mesh 'data' axis — the
+    streamed analogue of ``row_sharded_specs`` (GSPMD then psums the
+    solver contractions over the data axis exactly as in the resident
+    row-sharded path) — while per-block scalars (the SGD epoch clock)
+    and unmatched leaves replicate. On 1D meshes everything
+    replicates."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     rep = NamedSharding(backend.mesh, P())
     if getattr(backend, "data_axis_size", 1) <= 1:
         return rep
-    row = NamedSharding(backend.mesh, P("data"))
-    leaves = jax.tree_util.tree_leaves(block_example)
-    n_rows = max(
-        (l.shape[0] for l in leaves if getattr(l, "ndim", 0) >= 1),
-        default=0,
+    from .mesh import STREAM_BLOCK_RULES, match_partition_rules
+
+    specs = match_partition_rules(
+        STREAM_BLOCK_RULES if rules is None else rules, block_example
     )
-
-    def pick(leaf):
-        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == n_rows:
-            return row
-        return rep
-
-    return jax.tree_util.tree_map(pick, block_example)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(backend.mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 class BlockFeeder:

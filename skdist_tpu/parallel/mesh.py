@@ -20,6 +20,7 @@ import itertools
 import json
 import logging
 import os
+import re
 import time
 
 import numpy as np
@@ -31,6 +32,8 @@ __all__ = [
     "initialize_cluster",
     "task_data_mesh",
     "multihost_task_mesh",
+    "match_partition_rules",
+    "STREAM_BLOCK_RULES",
     "ElasticMeshManager",
     "HeartbeatFileProbe",
     "KVStoreHeartbeatProbe",
@@ -172,6 +175,84 @@ def multihost_task_mesh(data_axis_size=None):
 
 
 # ---------------------------------------------------------------------------
+# declarative named-axis partition rules
+# ---------------------------------------------------------------------------
+
+#: Default partition-rule table for streamed data blocks: the design
+#: matrix (dense ``X`` or its packed-CSR children ``X/0``/``X/1``) and
+#: the per-row vectors (labels, sample weights, fold ids) row-shard
+#: onto the mesh 'data' axis; anything unmatched — and every scalar,
+#: regardless of rules (the SGD epoch/block clocks) — replicates.
+#: Ordered first-match-wins, same contract as the exemplar regex
+#: partition tables over named param trees.
+STREAM_BLOCK_RULES = (
+    (r"(^|/)X($|/)", ("data",)),
+    (r"(^|/)(y|sw|fold)($|/)", ("data",)),
+)
+
+
+def _leaf_path_name(path):
+    """'/'-joined human name of a pytree leaf path (dict keys, attr
+    names, sequence/flattened indices)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):  # DictKey / FlattenedIndexKey
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):  # GetAttrKey
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):  # SequenceKey
+            parts.append(str(k.idx))
+        else:  # pragma: no cover - future key kinds
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree, default=()):
+    """Declarative named-axis placement: map every leaf of ``tree`` to a
+    ``PartitionSpec`` by regex-matching its '/'-joined tree path against
+    ``rules`` — an ordered ``(pattern, spec)`` table, first match wins
+    (``re.search`` semantics). Specs may be ``PartitionSpec`` instances
+    or plain tuples of axis names (``("data",)``); scalar leaves always
+    replicate regardless of rules (a scalar has no axis to shard).
+
+    ``default`` is the spec for unmatched non-scalar leaves (replicate
+    by default); pass ``default=None`` to make an unmatched leaf a
+    loud ``ValueError`` naming the path — the strict mode for param
+    trees where silent replication would hide a placement bug.
+
+    Returns a tree of ``PartitionSpec`` with the same structure as
+    ``tree`` — the declarative replacement for hand-plumbed per-leaf
+    sharding decisions (consumed by ``prepare_streamed`` /
+    ``_block_shardings`` on 2D (task × data) meshes).
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    def to_spec(s):
+        return s if isinstance(s, PartitionSpec) else PartitionSpec(*s)
+
+    compiled = [(re.compile(pat), to_spec(spec)) for pat, spec in rules]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = []
+    for path, leaf in flat:
+        name = _leaf_path_name(path)
+        if getattr(leaf, "ndim", 0) == 0:
+            specs.append(PartitionSpec())
+            continue
+        for pat, spec in compiled:
+            if pat.search(name):
+                specs.append(spec)
+                break
+        else:
+            if default is None:
+                raise ValueError(
+                    f"no partition rule matches tree path {name!r}"
+                )
+            specs.append(to_spec(default))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+# ---------------------------------------------------------------------------
 # elastic meshes (preemptible capacity)
 # ---------------------------------------------------------------------------
 
@@ -199,13 +280,19 @@ class ElasticMeshManager:
       full) mesh. Returns the new mesh or None.
     - :attr:`degraded` — whether the current mesh is smaller than full.
 
-    **Shrink geometry.** The shrunken task extent is the largest
-    divisor of the FULL task extent that the survivors can still
-    populate (times the unchanged 'data' axis). The divisor rule is
-    what keeps every task axis laid out for the full mesh — padded
-    carries, slot-aligned chunks, streamed task trees — placeable on
-    the shrunken mesh without re-padding: anything divisible by the
-    full extent is divisible by each of its divisors.
+    **Shrink geometry.** Largest-divisor re-layout on BOTH axes: the
+    shrunken layout is the (task extent, data size) pair maximising
+    devices used, with the task extent a divisor of the FULL task
+    extent and the data size a divisor of the full 'data' axis. Ties
+    prefer the larger data size, so the per-fit psum geometry — and
+    with it bitwise parity against the full mesh — is preserved
+    whenever the survivors allow it; only when fewer than
+    ``data_axis_size`` devices survive per slot does the data axis
+    itself shrink (previously a hard error). The divisor rule is what
+    keeps every task axis laid out for the full mesh — padded carries,
+    slot-aligned chunks, streamed task trees — placeable on the
+    shrunken mesh without re-padding: anything divisible by the full
+    extent is divisible by each of its divisors.
 
     **Probing.** ``probe`` is the seam to real preemption signals
     (plant notifications, heartbeat loss, device health): a callable
@@ -259,6 +346,7 @@ class ElasticMeshManager:
             }
         self.participant_ids = sorted(set(self._pid_of.values()))
         self.current_extent = self.full_extent
+        self.current_data = self.data_axis_size
         #: epoch agreement (multi-process coordinated resume): on by
         #: default exactly when participants ARE processes — the only
         #: roster whose loss tears a jax.distributed collective
@@ -282,7 +370,8 @@ class ElasticMeshManager:
     # ------------------------------------------------------------------
     @property
     def degraded(self):
-        return self.current_extent < self.full_extent
+        return (self.current_extent < self.full_extent
+                or self.current_data < self.data_axis_size)
 
     def _probe_lost(self):
         """Currently-lost participant ids (a frozenset). An operator
@@ -325,29 +414,39 @@ class ElasticMeshManager:
         return [d for d in self.full_devices
                 if self._pid_of[id(d)] not in lost]
 
-    def _fit_extent(self, n_survivors):
-        """Largest divisor of the full task extent the survivors can
-        populate (see class docstring), or 0 when even one task slot
-        cannot be formed."""
-        best = 0
-        for t in range(1, self.full_extent + 1):
-            if self.full_extent % t == 0 and \
-                    t * self.data_axis_size <= n_survivors:
-                best = t
+    def _fit_layout(self, n_survivors):
+        """Largest-divisor re-layout on BOTH axes (see class
+        docstring): the ``(task extent, data size)`` pair maximising
+        devices used, the extent a divisor of the full task extent and
+        the data size a divisor of the full 'data' axis; ties prefer
+        the larger data size (preserving the psum geometry and bitwise
+        parity with the full mesh whenever survivors allow). Returns
+        ``(0, 0)`` when even one task slot cannot be formed."""
+        best = (0, 0)
+        for d in range(1, self.data_axis_size + 1):
+            if self.data_axis_size % d:
+                continue
+            for t in range(1, self.full_extent + 1):
+                if self.full_extent % t or t * d > n_survivors:
+                    continue
+                if (t * d, d) > (best[0] * best[1], best[1]):
+                    best = (t, d)
         return best
 
-    def _build(self, extent, survivors):
+    def _build(self, extent, dsize, survivors):
         from jax.sharding import Mesh
 
-        picked = survivors[: extent * self.data_axis_size]
+        picked = survivors[: extent * dsize]
         if self.data_axis_size > 1:
-            arr = np.array(picked).reshape(extent, self.data_axis_size)
+            # keep the 2D axis names even at dsize == 1 so compiled
+            # programs and PartitionSpecs referencing 'data' stay valid
+            arr = np.array(picked).reshape(extent, dsize)
             return Mesh(arr, (self.axis_name, "data"))
         return Mesh(np.array(picked), (self.axis_name,))
 
     def _resize(self, kind, lost):
         survivors = self._survivors(lost)
-        extent = self._fit_extent(len(survivors))
+        extent, dsize = self._fit_layout(len(survivors))
         if extent == 0:
             raise RuntimeError(
                 f"elastic mesh cannot shrink below one task slot: "
@@ -355,19 +454,22 @@ class ElasticMeshManager:
                 f"data_axis_size={self.data_axis_size} (lost "
                 f"participants: {sorted(lost)})"
             )
-        if extent == self.current_extent:
+        if (extent, dsize) == (self.current_extent, self.current_data):
             return None
-        mesh = self._build(extent, survivors)
+        mesh = self._build(extent, dsize, survivors)
         self.events.append({
             "kind": kind, "lost": sorted(lost),
             "from_extent": self.current_extent, "to_extent": extent,
+            "from_data": self.current_data, "to_data": dsize,
             "t": time.time(),
         })
         logger.warning(
-            "elastic mesh %s: task extent %d -> %d (lost participants: "
-            "%s)", kind, self.current_extent, extent, sorted(lost) or "none",
+            "elastic mesh %s: task extent %d -> %d, data axis %d -> %d "
+            "(lost participants: %s)", kind, self.current_extent, extent,
+            self.current_data, dsize, sorted(lost) or "none",
         )
         self.current_extent = extent
+        self.current_data = dsize
         faults.record(
             "elastic_shrinks" if kind == "shrink" else "elastic_regrows"
         )
@@ -383,6 +485,10 @@ class ElasticMeshManager:
             "mesh.task_extent",
             help="current elastic task-axis extent per manager",
         ).set(extent, mesh=self._obs_id)
+        obs_metrics.gauge(
+            "mesh.data_axis",
+            help="current elastic data-axis size per manager",
+        ).set(dsize, mesh=self._obs_id)
         return mesh
 
     # ------------------------------------------------------------------
@@ -524,7 +630,8 @@ class ElasticMeshManager:
             return None
         lost = self._probe_lost()
         survivors = self._survivors(lost)
-        if self._fit_extent(len(survivors)) <= self.current_extent:
+        extent, dsize = self._fit_layout(len(survivors))
+        if extent * dsize <= self.current_extent * self.current_data:
             return None
         if self.cluster is not None:
             self.rebuild_cluster()

@@ -1,0 +1,477 @@
+"""Terabyte-scale streamed ASHA on declarative 2D (task x data) meshes.
+
+Rungs fire at block-pass boundaries inside the streamed drivers and
+kill candidate groups between passes: engaged/kill semantics mirror the
+resident compacted path (one RungKilledWarning, a ``rung_`` column,
+survivor parity with the exhaustive streamed race), the gram family
+stays exhaustive by construction, and the saved work is accounted
+through ``last_round_stats``.
+
+Placement: `match_partition_rules` / `_fit_layout` units, streamed
+search parity on real 2D ``(tasks, data)`` mesh shapes of the
+8-virtual-device harness, warm refits compiling nothing, and a
+mid-rung elastic shrink resuming the race on the re-laid-out mesh.
+
+Durability: rung-killed lanes journal ONCE as their tagged error rows
+and a resume restores the exact race; a one-shot (non-seekable) block
+reader fails its second invocation with the typed remedy error.
+"""
+
+import glob
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from sklearn.datasets import make_classification
+from sklearn.model_selection import KFold
+
+from skdist_tpu.data import ChunkedDataset, NonSeekableReaderError
+from skdist_tpu.distribute.adaptive import HalvingSpec, RungKilledWarning
+from skdist_tpu.distribute.search import DistGridSearchCV
+from skdist_tpu.models import LogisticRegression, Ridge, SGDClassifier
+from skdist_tpu.parallel import (
+    ElasticMeshManager,
+    TPUBackend,
+    compile_cache,
+    faults,
+)
+from skdist_tpu.testing.faultinject import FaultInjector
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fault_state():
+    faults.reset_stats()
+    yield
+    faults.set_injector(None)
+    faults.reset_stats()
+
+
+def _clf_data(n=600, d=12, k=3, seed=0, sep=1.5):
+    X, y = make_classification(
+        n_samples=n, n_features=d, n_informative=max(2, d - 4),
+        n_classes=k, class_sep=sep, random_state=seed,
+    )
+    return X.astype(np.float32), y
+
+
+def _half_groups():
+    return max(1, len(jax.devices()) // 2)
+
+
+GRID = {"C": list(np.logspace(-4, 2, 6))}
+EST_KW = dict(max_iter=60, tol=1e-6, engine="xla")
+
+
+def _asha_search(ds, adaptive, backend=None, grid=None, est=None,
+                 checkpoint_dir=None, **kw):
+    est = est if est is not None else LogisticRegression(**EST_KW)
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        gs = DistGridSearchCV(
+            est, grid or GRID, backend=backend, cv=KFold(3),
+            adaptive=adaptive, **kw,
+        ).fit(ds, checkpoint_dir=checkpoint_dir)
+    return gs, ws
+
+
+def _kills(ws):
+    return [w for w in ws if issubclass(w.category, RungKilledWarning)]
+
+
+def _not_engaged(ws):
+    return [w for w in ws
+            if "could not engage" in str(w.message)]
+
+
+# ---------------------------------------------------------------------------
+# declarative placement units: partition rules + elastic 2D layouts
+# ---------------------------------------------------------------------------
+
+class TestPartitionRules:
+    def _names(self, specs):
+        return jax.tree_util.tree_map(lambda s: tuple(s), specs)
+
+    def test_stream_block_rules_place_rows_on_data(self):
+        from skdist_tpu.parallel.mesh import (
+            STREAM_BLOCK_RULES,
+            match_partition_rules,
+        )
+
+        block = {
+            "X": np.ones((8, 3), np.float32),
+            "y": np.ones(8, np.int32),
+            "sw": np.ones(8, np.float32),
+            "fold": np.ones(8, np.int32),
+            "epoch": np.float32(0.0),  # SGD block clock: a scalar
+        }
+        specs = match_partition_rules(STREAM_BLOCK_RULES, block)
+        got = self._names(specs)
+        assert got["X"] == ("data",)
+        assert got["y"] == ("data",)
+        assert got["sw"] == ("data",)
+        assert got["fold"] == ("data",)
+        assert got["epoch"] == ()  # scalars always replicate
+
+    def test_packed_csr_children_match_via_path(self):
+        from skdist_tpu.parallel.mesh import (
+            STREAM_BLOCK_RULES,
+            match_partition_rules,
+        )
+
+        block = {"X": {"0": np.ones((8, 4)), "1": np.ones((8, 4))}}
+        got = self._names(match_partition_rules(STREAM_BLOCK_RULES, block))
+        assert got["X"]["0"] == ("data",)
+        assert got["X"]["1"] == ("data",)
+
+    def test_first_match_wins_and_default(self):
+        from skdist_tpu.parallel.mesh import match_partition_rules
+
+        rules = ((r"^w$", ("tasks",)), (r"w", ("data",)))
+        tree = {"w": np.ones(4), "other": np.ones(4)}
+        got = self._names(match_partition_rules(rules, tree))
+        assert got["w"] == ("tasks",)   # first rule, not the second
+        assert got["other"] == ()       # unmatched -> default replicate
+
+    def test_strict_default_raises_naming_path(self):
+        from skdist_tpu.parallel.mesh import match_partition_rules
+
+        with pytest.raises(ValueError, match="a/b"):
+            match_partition_rules(
+                (), {"a": {"b": np.ones(4)}}, default=None
+            )
+
+    def test_scalar_replicates_even_when_rule_matches(self):
+        from skdist_tpu.parallel.mesh import match_partition_rules
+
+        got = self._names(match_partition_rules(
+            ((r"s", ("data",)),), {"s": np.float32(1.0)}
+        ))
+        assert got["s"] == ()
+
+
+class TestFitLayout2D:
+    """Largest-divisor re-layout on BOTH axes: the shrunken mesh keeps
+    divisor geometry so resumed programs stay valid, ties prefer the
+    larger data size (preserving the psum geometry)."""
+
+    def _mgr(self, data_axis_size):
+        return ElasticMeshManager(
+            devices=jax.devices(), data_axis_size=data_axis_size,
+            group_size=1,
+        )
+
+    def test_full_and_degenerate(self):
+        m = self._mgr(2)  # 8 devices -> task extent 4, data 2
+        assert m._fit_layout(8) == (4, 2)
+        assert m._fit_layout(1) == (1, 1)
+        assert m._fit_layout(0) == (0, 0)
+
+    def test_tie_prefers_larger_data_size(self):
+        m = self._mgr(2)
+        # 7 survivors: (4,1) and (2,2) both use 4 devices -> (2,2)
+        assert m._fit_layout(7) == (2, 2)
+        assert m._fit_layout(3) == (1, 2)
+
+    def test_1d_falls_back_to_task_divisors(self):
+        m = self._mgr(1)
+        assert m._fit_layout(5) == (4, 1)
+        assert m._fit_layout(8) == (8, 1)
+
+    def test_nondividing_data_axis_rejected(self):
+        with pytest.raises(ValueError, match="data_axis_size"):
+            self._mgr(3)
+
+
+# ---------------------------------------------------------------------------
+# streamed ASHA: rungs at block-pass boundaries
+# ---------------------------------------------------------------------------
+
+class TestStreamedAsha:
+    def test_kills_engaged_and_survivor_parity(self):
+        X, y = _clf_data()
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        gs, ws = _asha_search(ds, HalvingSpec(eta=3, min_slices=5))
+        rung = np.asarray(gs.cv_results_["rung_"])
+        assert (rung >= 0).any(), "expected rung kills on the C sweep"
+        mean = np.asarray(gs.cv_results_["mean_test_score"])
+        assert np.all(np.isnan(mean[rung >= 0]))
+        assert np.all(np.isfinite(mean[rung == -1]))
+        assert rung[gs.best_index_] == -1
+        assert len(_kills(ws)) == 1, "one RungKilledWarning per fit"
+        assert not _not_engaged(ws)
+        # exhaustive streamed reference: same winner, survivors score
+        # to within the streamed re-layout tolerance
+        ref, _ = _asha_search(ds, None)
+        assert gs.best_params_ == ref.best_params_
+        surv = rung == -1
+        np.testing.assert_allclose(
+            mean[surv],
+            np.asarray(ref.cv_results_["mean_test_score"])[surv],
+            atol=1e-5,
+        )
+
+    def test_observe_only_inf_eta_is_bitwise_exhaustive(self):
+        X, y = _clf_data(n=480)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        inf, ws = _asha_search(
+            ds, HalvingSpec(eta=float("inf"), min_slices=5)
+        )
+        base, _ = _asha_search(ds, None)
+        assert np.all(np.asarray(inf.cv_results_["rung_"]) == -1)
+        assert "rung_" not in base.cv_results_
+        # rung scoring passes observe; they must not perturb the fits
+        np.testing.assert_array_equal(
+            inf.cv_results_["mean_test_score"],
+            base.cv_results_["mean_test_score"],
+        )
+        assert not _kills(ws) and not _not_engaged(ws)
+
+    def test_sgd_epoch_rungs_kill(self):
+        X, y = _clf_data(n=512, k=2)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=128)
+        est = SGDClassifier(loss="log_loss", max_iter=16, batch_size=64,
+                            shuffle=False, tol=None)
+        gs, ws = _asha_search(
+            ds, HalvingSpec(eta=3, min_slices=4),
+            grid={"alpha": [1e-6, 1e-4, 1e-2, 1.0, 10.0, 100.0]},
+            est=est,
+        )
+        rung = np.asarray(gs.cv_results_["rung_"])
+        assert (rung >= 0).any()
+        assert rung[gs.best_index_] == -1
+        assert len(_kills(ws)) == 1
+
+    def test_gram_family_stays_exhaustive_and_warns(self):
+        X, y = _clf_data(k=2)
+        ds = ChunkedDataset.from_arrays(X, y.astype(np.float32),
+                                        block_rows=120)
+        gs, ws = _asha_search(
+            ds, HalvingSpec(eta=2), est=Ridge(),
+            grid={"alpha": [0.1, 1.0, 10.0]}, scoring="neg_mean_squared_error",
+        )
+        assert np.all(np.asarray(gs.cv_results_["rung_"]) == -1)
+        assert len(_not_engaged(ws)) == 1
+        assert not _kills(ws)
+
+    def test_rung_accounting_in_round_stats(self):
+        X, y = _clf_data()
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        bk = TPUBackend()
+        # a cap the survivors never reach: the race ends when the last
+        # survivor converges, so whole-dataset passes are saved and the
+        # bytes-saved counterfactual is positive
+        est = LogisticRegression(max_iter=200, tol=1e-3, engine="xla")
+        gs, _ws = _asha_search(
+            ds, HalvingSpec(eta=3, min_slices=5), backend=bk, est=est
+        )
+        assert (np.asarray(gs.cv_results_["rung_"]) >= 0).any()
+        st = bk.last_round_stats
+        # killed lanes stop streaming: saved passes and their bytes
+        assert st["passes_saved"] > 0
+        assert st["streamed_bytes_saved"] > 0
+        assert st["retired_rung"] >= 1
+        assert faults.snapshot()["lanes_rung_killed"] >= 1
+        surv = [int(s) for s in st["rung_survivors"].split(",")]
+        assert surv == sorted(surv, reverse=True)  # monotone race
+
+
+# ---------------------------------------------------------------------------
+# 2D (task x data) mesh shapes on the 8-virtual-device harness
+# ---------------------------------------------------------------------------
+
+class TestStreamed2DMesh:
+    @pytest.mark.parametrize("dsize", [2, 4])
+    def test_streamed_search_parity_vs_1d(self, dsize):
+        X, y = _clf_data(n=600, k=2)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        kw = dict(grid={"C": [0.5, 5.0]})
+        gs_2d, _ = _asha_search(
+            ds, None, backend=TPUBackend(data_axis_size=dsize), **kw
+        )
+        gs_1d, _ = _asha_search(ds, None, **kw)
+        np.testing.assert_allclose(
+            gs_2d.cv_results_["mean_test_score"],
+            gs_1d.cv_results_["mean_test_score"], atol=1e-5,
+        )
+        assert gs_2d.best_params_ == gs_1d.best_params_
+
+    def test_asha_on_2d_mesh_matches_1d_race(self):
+        X, y = _clf_data()
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        spec = HalvingSpec(eta=3, min_slices=5)
+        gs_2d, ws = _asha_search(
+            ds, spec, backend=TPUBackend(data_axis_size=2)
+        )
+        gs_1d, _ = _asha_search(ds, spec)
+        r2, r1 = (np.asarray(g.cv_results_["rung_"])
+                  for g in (gs_2d, gs_1d))
+        assert (r2 >= 0).any()
+        np.testing.assert_array_equal(r2, r1)
+        assert gs_2d.best_params_ == gs_1d.best_params_
+        surv = r2 == -1
+        np.testing.assert_allclose(
+            np.asarray(gs_2d.cv_results_["mean_test_score"])[surv],
+            np.asarray(gs_1d.cv_results_["mean_test_score"])[surv],
+            atol=1e-5,
+        )
+        assert len(_kills(ws)) == 1
+
+    def test_warm_asha_refit_compiles_nothing(self):
+        X, y = _clf_data()
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        spec = HalvingSpec(eta=3, min_slices=5)
+        bk = TPUBackend(data_axis_size=2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _asha_search(ds, spec, backend=bk)  # warm
+            before = compile_cache.snapshot()
+            _asha_search(ds, spec, backend=bk)
+        after = compile_cache.snapshot()
+        assert after["jit_misses"] == before["jit_misses"]
+        assert after["kernel_misses"] == before["kernel_misses"]
+
+
+class TestMidRungElasticShrink:
+    def test_preempted_race_resumes_on_shrunken_mesh(self):
+        """A PREEMPTED mid-race shrinks the mesh by the largest-divisor
+        rule on BOTH axes and the race resumes: same winner, same kill
+        record, survivor parity with the un-preempted run."""
+        X, y = _clf_data()
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        spec = HalvingSpec(eta=3, min_slices=5)
+        ref, _ = _asha_search(ds, spec)
+        bk = TPUBackend(elastic={"group_size": _half_groups()})
+        with FaultInjector().on_host(1, at_round=3):
+            gs, ws = _asha_search(ds, spec, backend=bk)
+        assert faults.snapshot()["elastic_shrinks"] >= 1
+        assert len(bk.devices) == len(jax.devices()) // 2
+        rung = np.asarray(gs.cv_results_["rung_"])
+        assert (rung >= 0).any()
+        np.testing.assert_array_equal(
+            rung, np.asarray(ref.cv_results_["rung_"])
+        )
+        assert gs.best_params_ == ref.best_params_
+        surv = rung == -1
+        np.testing.assert_allclose(
+            np.asarray(gs.cv_results_["mean_test_score"])[surv],
+            np.asarray(ref.cv_results_["mean_test_score"])[surv],
+            atol=1e-5,
+        )
+        assert len(_kills(ws)) == 1
+
+
+# ---------------------------------------------------------------------------
+# durable checkpoints: the kill journals once, the resume IS the race
+# ---------------------------------------------------------------------------
+
+class TestStreamedCheckpointRung:
+    def test_kills_journal_once_tagged_and_resume_is_deterministic(
+            self, tmp_path):
+        X, y = _clf_data()
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        spec = HalvingSpec(eta=3, min_slices=5)
+        g1, ws1 = _asha_search(
+            ds, spec, checkpoint_dir=str(tmp_path)
+        )
+        rung = np.asarray(g1.cv_results_["rung_"])
+        killed = {int(c) for c in np.flatnonzero(rung >= 0)}
+        assert killed and len(_kills(ws1)) == 1
+        # a killed lane appears ONLY as its rung_killed-tagged error
+        # row — never first as a half-trained carry's raw scores
+        seen = {}
+        for path in glob.glob(str(tmp_path / "*.jsonl")):
+            with open(path) as fh:
+                for line in fh:
+                    row = json.loads(line)
+                    seen.setdefault(int(row["t"]), []).append(row["r"])
+        n_splits = 3
+        assert len(seen) == len(rung) * n_splits
+        for gid, rows in seen.items():
+            if gid // n_splits in killed:
+                assert len(rows) == 1
+                assert "rung_killed" in rows[0]
+                assert np.isnan(rows[0]["test_score"])
+            else:
+                assert all("rung_killed" not in r for r in rows)
+        # resume: every lane restores (kills AS kills), bitwise results,
+        # and neither warning fires — the journal already holds the race
+        faults.reset_stats()
+        g2, ws2 = _asha_search(
+            ds, spec, checkpoint_dir=str(tmp_path)
+        )
+        assert faults.snapshot()["checkpoint_hits"] == len(rung) * n_splits
+        np.testing.assert_array_equal(
+            g1.cv_results_["rung_"], g2.cv_results_["rung_"]
+        )
+        np.testing.assert_array_equal(
+            g1.cv_results_["mean_test_score"],
+            g2.cv_results_["mean_test_score"],
+        )
+        assert g1.best_params_ == g2.best_params_
+        assert not _kills(ws2) and not _not_engaged(ws2)
+
+
+# ---------------------------------------------------------------------------
+# the from_readers contract: one-shot readers fail loud with the remedy
+# ---------------------------------------------------------------------------
+
+class _OneShotReader:
+    """A forward-only stream: the first invocation yields the block,
+    every later one raises like an exhausted generator/socket."""
+
+    def __init__(self, X, y, s, e):
+        self.X, self.y, self.s, self.e = X, y, s, e
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls > 1:
+            raise OSError("stream exhausted")
+        return {"X": self.X[self.s:self.e], "y": self.y[self.s:self.e]}
+
+
+class TestNonSeekableReader:
+    def _one_shot_ds(self, n=240, d=8, block_rows=120):
+        X, y = _clf_data(n=n, d=d, k=2)
+        readers = [
+            _OneShotReader(X, y, s, min(s + block_rows, n))
+            for s in range(0, n, block_rows)
+        ]
+        return ChunkedDataset.from_readers(
+            readers, n, d, block_rows, has_y=True
+        )
+
+    def test_second_invocation_raises_typed_remedy(self):
+        ds = self._one_shot_ds()
+        ds.read_block(0)
+        with pytest.raises(NonSeekableReaderError, match=r"save"):
+            ds.read_block(0)
+
+    def test_error_names_block_and_chains_cause(self):
+        ds = self._one_shot_ds()
+        ds.read_block(1)
+        with pytest.raises(NonSeekableReaderError, match="block 1"):
+            try:
+                ds.read_block(1)
+            except NonSeekableReaderError as exc:
+                assert isinstance(exc.__cause__, OSError)
+                raise
+
+    def test_first_call_failure_propagates_raw(self):
+        def broken():
+            raise OSError("disk on fire")
+
+        ds = ChunkedDataset.from_readers(
+            [broken], 4, 2, 4, has_y=False
+        )
+        with pytest.raises(OSError, match="disk on fire"):
+            ds.read_block(0)
+
+    def test_multipass_fit_surfaces_remedy(self):
+        ds = self._one_shot_ds()
+        with pytest.raises(NonSeekableReaderError, match=r"save"):
+            LogisticRegression(max_iter=30, engine="xla").fit(ds)

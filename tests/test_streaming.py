@@ -615,12 +615,32 @@ class TestStreamedOvR:
         proba = s.predict_proba(X)
         np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
 
-    def test_ovo_chunked_raises(self):
+    def test_ovo_pair_masked_streaming_parity(self):
+        # each block streams ONCE per solver pass for all k(k-1)/2
+        # pairs (pair masks composed on device) and matches the
+        # resident batched OvO prediction for prediction
+        X, y = _clf_data(n=600, k=4, d=8)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        s = DistOneVsOneClassifier(
+            LogisticRegression(max_iter=60, tol=1e-6, engine="xla")
+        ).fit(ds)
+        assert len(s.estimators_) == 6
+        assert len(s.pairs_) == 6
+        r = DistOneVsOneClassifier(
+            LogisticRegression(max_iter=60, tol=1e-6, engine="xla")
+        ).fit(X, y)
+        assert (s.predict(X) == r.predict(X)).mean() == 1.0
+
+    def test_ovo_streamed_guards(self):
         X, y = _clf_data(n=200, k=3)
         ds = ChunkedDataset.from_arrays(X, y, block_rows=100)
-        with pytest.raises(NotImplementedError, match="OneVsRest"):
+        with pytest.raises(ValueError, match="engine='host'"):
             DistOneVsOneClassifier(
-                LogisticRegression(engine="xla")
+                LogisticRegression(engine="host")
+            ).fit(ds, y)
+        with pytest.raises(ValueError, match="class_weight"):
+            DistOneVsOneClassifier(
+                LogisticRegression(engine="xla", class_weight="balanced")
             ).fit(ds, y)
 
     def test_ovr_downsampling_rejected(self):
