@@ -2320,6 +2320,50 @@ def streamed_asha_aux(quick=False):
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+def streamed_gbdt_aux(quick=False):
+    """Measured readout of out-of-core boosting: streamed
+    ``DistHistGradientBoosting*.fit(ChunkedDataset)`` on a 2D
+    (task x data) mesh over a disk-backed dataset >= 4x an enforced
+    peak-RSS budget — raw-pass accounting (sketch + bin, then the
+    uint8 binned cache for every round), cache-hit on refit, byte
+    counters vs the exact pass structure, streamed-vs-resident
+    holdout accuracy, the compile invariant, and the streamed ASHA
+    race over boosting carries — the evidence behind the
+    streamed-GBDT smoke's gates. Best-effort: a dict with "error" on
+    any failure."""
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+        ))
+        from bench_streamed_gbdt import run_streamed_gbdt_bench
+
+        return run_streamed_gbdt_bench(quick=quick)
+    except Exception as exc:  # noqa: BLE001 — aux must not kill the headline
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _streamed_gbdt_main(quick=False):
+    """Standalone capture of the out-of-core boosting readout →
+    ``BENCH_streamed_gbdt_r20.json`` (cold/warm streamed fits over
+    the binned block cache, raw-pass + binned-byte accounting,
+    resident holdout parity, peak-RSS delta vs budget, compile
+    invariant, streamed ASHA race over boosting carries)."""
+    import jax
+
+    payload = {
+        "metric": "streamed_gbdt_fit",
+        "aux": streamed_gbdt_aux(quick=quick),
+        "platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(payload, indent=1), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_streamed_gbdt_r20.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    return payload
+
+
 def _streamed_asha_main(quick=False):
     """Standalone capture of the streamed adaptive-search readout →
     ``BENCH_streamed_asha_r19.json`` (adaptive vs exhaustive streamed
@@ -2621,6 +2665,8 @@ if __name__ == "__main__":
         _gbdt_main(quick="--quick" in sys.argv)
     elif "--sparse" in sys.argv:
         _sparse_main(quick="--quick" in sys.argv)
+    elif "--streamed-gbdt" in sys.argv:
+        _streamed_gbdt_main(quick="--quick" in sys.argv)
     elif "--streamed-asha" in sys.argv:
         _streamed_asha_main(quick="--quick" in sys.argv)
     elif "--asha" in sys.argv:

@@ -1,0 +1,248 @@
+"""
+Streamed-GBDT benchmark: out-of-core boosting on the binned block
+cache vs the resident fit, plus a streamed ASHA race over boosting
+carries.
+
+The evidence behind the streamed-GBDT smoke's gates, five legs in one
+process over a disk-backed ``ChunkedDataset`` >= 4x an enforced
+host-memory budget:
+
+- **warmup / cold cache build**: one cold streamed fit pays the two
+  raw passes (quantile-sketch + bin) and writes the uint8 binned
+  cache next to the dataset, then compiles every per-level program.
+- **measured warm fit (headline)**: a second streamed fit on
+  ``TPUBackend(data_axis_size=2)`` must HIT the cache (zero raw
+  passes — only the seekability probe touches the reader), stream
+  only binned bytes (``binned_bytes_cached == 0``,
+  ``binned_bytes_streamed == rounds x (depth+1) x cache bytes``),
+  recompile NOTHING, and keep the peak-RSS delta under the budget.
+- **resident baseline**: the dataset materialised (AFTER the RSS
+  window closes) and fit resident; holdout accuracy of the streamed
+  model must match within 0.02 — the sketch-vs-exact edge gap plus
+  f32 tie-breaks, never a different algorithm.
+- **streamed ASHA race**: ``DistGridSearchCV(adaptive=HalvingSpec)``
+  over a learning-rate grid with rungs at round boundaries must kill
+  lanes (``retired_rung`` > 0) and return the SAME best candidate as
+  the exhaustive streamed search of the same grid.
+
+Usage (CPU mesh, like the unit tier):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/bench_streamed_gbdt.py [--quick]
+"""
+
+import json
+import os
+import sys
+import time
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def synthesize(dirpath, n_blocks, block_rows, d, seed=7):
+    """Disk-backed binary task with feature interactions (so boosting
+    depth earns its keep), written block-by-block — the full X never
+    exists in host memory during synthesis."""
+    from skdist_tpu.data import ChunkedDataset
+
+    n = n_blocks * block_rows
+
+    class _GenReader:
+        def __init__(self, s, e):
+            self.s, self.e = s, e
+
+        def __call__(self):
+            r = np.random.RandomState(seed * 1000 + self.s // block_rows)
+            X = r.randn(self.e - self.s, d).astype(np.float32)
+            y = (X[:, 0] * X[:, 1] + X[:, 2]
+                 + 0.3 * r.randn(self.e - self.s) > 0).astype(np.int64)
+            return {"X": X, "y": y}
+
+    gen = ChunkedDataset(
+        [_GenReader(s, min(s + block_rows, n))
+         for s in range(0, n, block_rows)],
+        n, d, block_rows, has_y=True,
+    )
+    gen.save(dirpath)
+    return ChunkedDataset.load(dirpath)
+
+
+def holdout(d, n=4096, seed=99):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, d).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] + 0.3 * r.randn(n) > 0).astype(
+        np.int64)
+    return X, y
+
+
+def _peak_rss():
+    from skdist_tpu.utils.meminfo import peak_rss_bytes
+
+    v = peak_rss_bytes()
+    if v is None:
+        raise SystemExit("streamed-gbdt bench needs /proc (Linux)")
+    return v
+
+
+def run_streamed_gbdt_bench(quick=True, data_axis_size=2, tmpdir=None):
+    """One measured readout dict (the smoke's evidence). Raises on
+    workload errors; callers wanting best-effort wrap it."""
+    import tempfile
+
+    from sklearn.model_selection import KFold
+
+    from skdist_tpu.distribute.search import DistGridSearchCV, HalvingSpec
+    from skdist_tpu.models.gbdt import DistHistGradientBoostingClassifier
+    from skdist_tpu.models.streaming import stream_fit_estimator
+    from skdist_tpu.parallel import TPUBackend, compile_cache
+
+    d = 64
+    block_rows = 4096 if quick else 16384
+    n_blocks = 12 if quick else 24
+    max_iter = 6 if quick else 20
+    max_depth = 3 if quick else 4
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="skdist_streamed_gbdt_")
+    ds = synthesize(os.path.join(tmpdir, "ds"), n_blocks, block_rows, d)
+    data_bytes = int(ds.nbytes_estimate)
+    budget = data_bytes // 4
+    Xh, yh = holdout(d)
+
+    kw = dict(
+        max_iter=max_iter, max_depth=max_depth, max_bins=32,
+        min_samples_leaf=20, learning_rate=0.3,
+        early_stopping=False, validation_fraction=None,
+    )
+
+    def stream_once():
+        bk = TPUBackend(data_axis_size=data_axis_size)
+        est = DistHistGradientBoostingClassifier(**kw)
+        t0 = time.perf_counter()
+        stream_fit_estimator(est, ds, backend=bk)
+        wall = time.perf_counter() - t0
+        return wall, est, dict(bk.last_round_stats or {})
+
+    # -- cold leg: raw-pass accounting + cache build ---------------------
+    inv0 = ds.reader_invocations
+    cold_s, est_cold, cold_stats = stream_once()
+    cold_raw_reads = ds.reader_invocations - inv0
+
+    # -- warmup: one cached fit settles the allocator arena and touches
+    # every cache page, so the measured leg isolates steady-state RSS --
+    stream_once()
+
+    # -- measured warm leg: cache hit, compile + RSS invariants ----------
+    rss0 = _peak_rss()
+    snap0 = compile_cache.snapshot()
+    inv1 = ds.reader_invocations
+    warm_s, est_w, warm_stats = stream_once()
+    snap1 = compile_cache.snapshot()
+    warm_raw_reads = ds.reader_invocations - inv1
+    rss_delta = _peak_rss() - rss0
+    acc_streamed = float(
+        ((est_w.decision_function(Xh) > 0).astype(np.int64) == yh).mean()
+    )
+
+    # -- resident baseline (AFTER the RSS window: materialising X is the
+    # one thing the streamed path exists to avoid) -----------------------
+    Xr = ds.materialize()
+    yr = ds.load_y()
+    est_r = DistHistGradientBoostingClassifier(**kw).fit(Xr, yr)
+    acc_resident = float(
+        ((est_r.decision_function(Xh) > 0).astype(np.int64) == yh).mean()
+    )
+
+    # -- streamed ASHA race over boosting carries ------------------------
+    # train-loss early stopping (the streamed-supported monitor): the
+    # survivors converge before the round cap, so whole-dataset passes
+    # are saved and streamed_bytes_saved is positive — the boosting
+    # analogue of the linear race ending on tol
+    grid = {"learning_rate": [0.003, 0.03, 0.3, 1.0]}
+    race_est = DistHistGradientBoostingClassifier(
+        max_iter=2 * max_iter, max_depth=3, max_bins=32,
+        min_samples_leaf=20, early_stopping=True,
+        validation_fraction=None, n_iter_no_change=2, tol=2e-2,
+    )
+
+    def search_once(adaptive):
+        bk = TPUBackend(data_axis_size=data_axis_size)
+        gs = DistGridSearchCV(
+            race_est, grid, backend=bk, cv=KFold(2), scoring="accuracy",
+            refit=False, adaptive=adaptive,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            gs.fit(ds)
+        return gs, dict(bk.last_round_stats or {})
+
+    gs_a, race_stats = search_once(
+        HalvingSpec(eta=3, min_slices=max(2, max_iter // 4))
+    )
+    gs_e, _ = search_once(None)
+    rung = np.asarray(gs_a.cv_results_["rung_"])
+
+    cache_pass = int(ds.n_rows) * int(ds.n_features)  # uint8 bytes/pass
+    return {
+        "n_rows": int(ds.n_rows),
+        "n_blocks": int(n_blocks),
+        "n_features": int(d),
+        "data_bytes": data_bytes,
+        "rss_budget_bytes": int(budget),
+        "rss_delta_bytes": int(rss_delta),
+        "mesh": f"tasks={8 // data_axis_size} x data={data_axis_size}",
+        "max_iter": int(max_iter),
+        "max_depth": int(max_depth),
+        "cold_wall_s": round(cold_s, 3),
+        "warm_wall_s": round(warm_s, 3),
+        "cold_raw_block_reads": int(cold_raw_reads),
+        "warm_raw_block_reads": int(warm_raw_reads),
+        "raw_pass_block_budget": int(2 * n_blocks + 4),
+        "cache_bytes": cache_pass,
+        "cold_binned_bytes_cached": cold_stats.get("binned_bytes_cached"),
+        "warm_binned_bytes_cached": warm_stats.get("binned_bytes_cached"),
+        "warm_binned_bytes_streamed": warm_stats.get(
+            "binned_bytes_streamed"),
+        "expected_binned_bytes_streamed": int(
+            cache_pass * (1 + max_iter * (max_depth + 1))
+        ),
+        "holdout_accuracy_streamed": round(acc_streamed, 4),
+        "holdout_accuracy_resident": round(acc_resident, 4),
+        "holdout_accuracy_delta": round(
+            abs(acc_streamed - acc_resident), 4),
+        "warm_compile_cache_delta": {
+            "jit_misses": snap1["jit_misses"] - snap0["jit_misses"],
+            "kernel_misses": (
+                snap1["kernel_misses"] - snap0["kernel_misses"]
+            ),
+        },
+        "asha_same_best_candidate": bool(
+            gs_a.best_index_ == gs_e.best_index_
+        ),
+        "asha_best_index": int(gs_e.best_index_),
+        "asha_n_killed_candidates": int((rung >= 0).sum()),
+        "asha_retired_rung": race_stats.get("retired_rung"),
+        "asha_passes_saved": race_stats.get("passes_saved"),
+        "asha_streamed_bytes_saved": race_stats.get(
+            "streamed_bytes_saved"),
+    }
+
+
+def main():
+    quick = "--quick" in sys.argv
+    out = run_streamed_gbdt_bench(quick=quick)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

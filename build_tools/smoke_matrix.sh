@@ -172,6 +172,20 @@
 #                             elastic shrink that RESUMES the race
 #                             (same kill record and winner) on the
 #                             halved mesh (streamed-ASHA PR).
+#   streamed_gbdt_smoke.py  — out-of-core boosting: streamed
+#                             DistHistGradientBoosting* fit over a
+#                             disk-backed ChunkedDataset >= 4x an
+#                             enforced peak-RSS budget on a 2D mesh;
+#                             raw features streamed exactly twice
+#                             (sketch + bin), every boosting round
+#                             reads the uint8 binned block cache
+#                             (byte accounting exact, cache HIT on
+#                             fit 2+), streamed-vs-resident holdout
+#                             accuracy <= 0.02, 0 post-warmup
+#                             compiles, and a streamed ASHA race
+#                             over boosting carries with the SAME
+#                             best candidate as exhaustive
+#                             (streamed-GBDT PR).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python build_tools/serving_smoke.py
@@ -191,3 +205,4 @@ python build_tools/multitenant_smoke.py
 python build_tools/wirespeed_smoke.py
 python build_tools/catalog_smoke.py
 python build_tools/streamed_asha_smoke.py
+python build_tools/streamed_gbdt_smoke.py

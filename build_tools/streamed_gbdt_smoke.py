@@ -1,0 +1,134 @@
+"""Streamed-GBDT smoke: the out-of-core boosting PR's acceptance
+gate, standalone on the 8-virtual-device CPU mesh.
+
+Runs ``bench.streamed_gbdt_aux(quick=True)`` — streamed
+``DistHistGradientBoosting*.fit(ChunkedDataset)`` over a disk-backed
+dataset >= 4x an enforced host-memory budget, on a 2D (task x data)
+``TPUBackend(data_axis_size=2)`` mesh — and asserts:
+
+- the dataset really is out-of-core: ``data_bytes`` >= 4x the RSS
+  budget and the measured warm fit's peak-RSS delta stays UNDER it;
+- raw features are streamed exactly TWICE, ever: the cold fit's
+  reader invocations fit the sketch-pass + bin-pass budget, and the
+  warm fit touches the reader only through the seekability probe
+  (every boosting round reads the uint8 binned cache);
+- the cache HITS on fit 2+: ``binned_bytes_cached`` is paid once,
+  and the warm fit's streamed binned bytes equal
+  ``(1 + rounds x (depth+1)) x cache_bytes`` exactly — the
+  accounting-verified pass structure (baseline + per-round D
+  histogram passes + 1 update pass);
+- streamed-vs-resident holdout accuracy within 0.02 (the sketch
+  edges vs exact quantiles gap; tree growth itself is parity-bounded
+  by the shared kernel);
+- NO recompile after warmup: the warm fit re-dispatches the cached
+  per-level programs;
+- streamed ASHA over boosting carries: rungs at round boundaries
+  kill lanes (``retired_rung`` > 0, ``passes_saved`` > 0) and the
+  race returns the SAME best candidate as the exhaustive streamed
+  search.
+
+Exit code 0 = pass. Usage:
+
+    python build_tools/streamed_gbdt_smoke.py [--acc-delta 0.02]
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def main(acc_delta):
+    from bench import streamed_gbdt_aux
+
+    aux = streamed_gbdt_aux(quick=True)
+    print(json.dumps({"streamed_gbdt": aux,
+                      "target_acc_delta": acc_delta}, indent=1))
+    if "error" in aux:
+        raise SystemExit(f"FAIL: streamed-gbdt aux died: {aux['error']}")
+
+    failures = []
+    if aux["data_bytes"] < 4 * aux["rss_budget_bytes"]:
+        failures.append(
+            f"dataset {aux['data_bytes']}B < 4x budget "
+            f"{aux['rss_budget_bytes']}B — not out-of-core"
+        )
+    if aux["rss_delta_bytes"] >= aux["rss_budget_bytes"]:
+        failures.append(
+            f"peak-RSS delta {aux['rss_delta_bytes']}B breached the "
+            f"budget {aux['rss_budget_bytes']}B"
+        )
+    if aux["cold_raw_block_reads"] > aux["raw_pass_block_budget"]:
+        failures.append(
+            f"cold fit read {aux['cold_raw_block_reads']} raw blocks > "
+            f"sketch+bin budget {aux['raw_pass_block_budget']} — a "
+            "boosting round touched the raw stream"
+        )
+    if aux["warm_raw_block_reads"] > 2:
+        failures.append(
+            f"warm fit read {aux['warm_raw_block_reads']} raw blocks "
+            "(> the 2-read seekability probe): the binned cache missed"
+        )
+    if aux["warm_binned_bytes_cached"] != 0:
+        failures.append(
+            "warm fit rebuilt the binned cache "
+            f"({aux['warm_binned_bytes_cached']}B cached) instead of "
+            "hitting it"
+        )
+    if (aux["warm_binned_bytes_streamed"]
+            != aux["expected_binned_bytes_streamed"]):
+        failures.append(
+            f"warm binned bytes {aux['warm_binned_bytes_streamed']} != "
+            f"expected {aux['expected_binned_bytes_streamed']} — the "
+            "pass structure drifted from baseline + rounds x (depth "
+            "hist + update)"
+        )
+    if aux["holdout_accuracy_delta"] > acc_delta:
+        failures.append(
+            f"streamed-vs-resident holdout accuracy delta "
+            f"{aux['holdout_accuracy_delta']} > {acc_delta}"
+        )
+    warm = aux["warm_compile_cache_delta"]
+    if warm["jit_misses"] or warm["kernel_misses"]:
+        failures.append(f"compiles_after_warmup != 0: warm delta {warm}")
+    if not aux["asha_same_best_candidate"]:
+        failures.append(
+            "adaptive streamed GBDT search returned a different best "
+            "candidate than exhaustive — the rungs killed the winner"
+        )
+    if not aux.get("asha_retired_rung"):
+        failures.append(
+            "no rung ever killed a boosting lane: the adaptive path "
+            "did not engage"
+        )
+    if not aux.get("asha_passes_saved"):
+        failures.append("passes_saved == 0 despite rung kills")
+    if failures:
+        raise SystemExit("FAIL: " + "; ".join(failures))
+    print(
+        f"PASS: streamed GBDT fit {aux['warm_wall_s']}s warm on "
+        f"{aux['mesh']} over {aux['data_bytes'] >> 20} MiB raw "
+        f"(budget {aux['rss_budget_bytes'] >> 20} MiB, delta "
+        f"{aux['rss_delta_bytes'] >> 20} MiB), cache "
+        f"{aux['cache_bytes'] >> 20} MiB hit on fit 2+ "
+        f"({aux['warm_raw_block_reads']} raw reads), holdout delta "
+        f"{aux['holdout_accuracy_delta']} <= {acc_delta}, 0 warm "
+        f"compiles, ASHA same best #{aux['asha_best_index']} with "
+        f"{aux['asha_retired_rung']} lanes rung-killed and "
+        f"{aux['asha_passes_saved']} passes saved"
+    )
+
+
+if __name__ == "__main__":
+    a = 0.02
+    if "--acc-delta" in sys.argv:
+        a = float(sys.argv[sys.argv.index("--acc-delta") + 1])
+    main(a)

@@ -1361,8 +1361,9 @@ class DistBaseSearchCV(BaseEstimator):
                 f"{est_cls.__name__} has no streamed fit driver; "
                 "ChunkedDataset search supports the linear families "
                 "(LogisticRegression, LinearSVC, SGDClassifier, the "
-                "Ridge family). Materialise the dataset for other "
-                "estimators."
+                "Ridge family) and the boosting pair "
+                "(DistHistGradientBoostingClassifier/Regressor). "
+                "Materialise the dataset for other estimators."
             )
         if getattr(estimator, "engine", None) == "host":
             raise ValueError(

@@ -324,6 +324,11 @@ def _build_boost_parts(meta, static):
         "init_carry": init_carry, "resume": resume, "finalize": finalize,
         "Kt": Kt, "D": D, "T": T, "classification": classification,
         "K": K,
+        # the loss pieces alone, for the streamed driver
+        # (models/streaming._fit_gbdt_stream): per-block grad/hess and
+        # monitor terms recompute from the SAME closures the resident
+        # round body traces — parity by shared code
+        "grads": grads, "loss_vals": loss_vals,
     }
 
 
@@ -352,6 +357,10 @@ class _BaseGBDT(BaseEstimator):
     #: the compacted scheduler's gate: boosting rounds are the
     #: iteration axis, early stopping is the done flag
     _supports_sliced_fit = True
+    #: out-of-core driver (models/streaming._fit_gbdt_stream): boosting
+    #: rounds stream the uint8 binned block cache, the margin carry F
+    #: lives in host memmaps, rungs fire at round boundaries
+    _stream_fit_kind = "gbdt"
 
     def __init__(self, loss, learning_rate=0.1, max_iter=100, max_depth=5,
                  max_bins=64, l2_regularization=0.0, min_samples_leaf=20,
@@ -533,15 +542,79 @@ class _BaseGBDT(BaseEstimator):
 
         return decision
 
+    # ---- streamed (out-of-core) fit ---------------------------------------
+    def _prep_stream_fit(self, dataset, y, sample_weight=None):
+        """Stage a ChunkedDataset fit: sketch bin edges in one raw pass,
+        build (or memory-map back) the uint8 binned block cache in a
+        second, and hand the driver a meta that carries both — boosting
+        rounds then stream only the cache, never the raw features."""
+        self._check_hypers()
+        if dataset.x_format != "dense":
+            raise TypeError(
+                f"{type(self).__name__} has no histogram form for "
+                f"packed ('{dataset.x_format}') ChunkedDatasets; "
+                "stream a dense dataset or materialise + densify"
+            )
+        if y is None:
+            raise ValueError(
+                f"{type(self).__name__} needs labels: the "
+                "ChunkedDataset carries none and no y was passed"
+            )
+        es = _resolve_early_stopping(self.early_stopping, dataset.n_rows)
+        if es and self.validation_fraction is not None:
+            raise ValueError(
+                f"{type(self).__name__} cannot hold out a validation "
+                "fraction from a streamed fit (blocks arrive once per "
+                "pass; there is no resident split to carve). Supported "
+                "over a ChunkedDataset: validation_fraction=None "
+                "(early stopping monitors the streaming train loss, "
+                "like the resident train-loss monitor) or "
+                "early_stopping=False"
+            )
+        # fail fast on one-shot readers BEFORE the sketch pass spends a
+        # full traversal: the fit needs the raw stream exactly twice
+        # (sketch + bin) and the cached stream once per boosting pass
+        dataset.check_seekable()
+        cache = dataset.with_binned_cache(max_bins=self.max_bins)
+        sw = prepare_sample_weight(sample_weight, dataset.n_rows)
+        meta = {
+            "n_features": dataset.n_features,
+            "n_samples": dataset.n_rows,
+            "edges": cache.edges,
+            "kernel_family": "hist_tree",
+            "binned_cache": cache,
+        }
+        if self._classification:
+            y_idx, classes = encode_labels(y)
+            meta.update(classes=classes, n_classes=len(classes))
+            y_enc = y_idx
+        else:
+            y_enc = np.asarray(y, np.float32).reshape(-1)
+        return y_enc, sw, meta
+
+    def _set_fitted(self, params, meta):
+        """Land a fitted state from the streamed driver (mirrors
+        linear._set_fitted): the binned cache is a fit-time artifact,
+        not part of the fitted surface — predict bins raw features
+        against ``edges`` in-program."""
+        meta = {k: v for k, v in meta.items() if k != "binned_cache"}
+        self._params = jax.device_get(params)
+        self._meta = meta
+        self.n_features_in_ = meta["n_features"]
+        if "classes" in meta:
+            self.classes_ = meta["classes"]
+        self.n_iter_ = int(np.asarray(self._params["n_iter"]).reshape(()))
+        return self
+
     # ---- fitted surface ---------------------------------------------------
-    def fit(self, X, y, sample_weight=None):
+    def fit(self, X, y=None, sample_weight=None):
         from ..data import is_chunked
 
         if is_chunked(X):
-            raise TypeError(
-                f"{type(self).__name__} has no streamed (out-of-core) "
-                "fit driver yet; materialise the ChunkedDataset "
-                "(dataset.materialize()) or fit on a resident array"
+            from .streaming import stream_fit_estimator
+
+            return stream_fit_estimator(
+                self, X, y=y, sample_weight=sample_weight
             )
         if y is None:
             raise TypeError(f"{type(self).__name__}.fit requires y")
@@ -552,13 +625,7 @@ class _BaseGBDT(BaseEstimator):
         kernel = get_kernel(type(self), "fit", meta, static)
         params = kernel(data["X"], data["y"], data["sw"], hyper,
                         {"edges": jnp.asarray(meta["edges"])})
-        self._params = jax.device_get(params)
-        self._meta = meta
-        self.n_features_in_ = meta["n_features"]
-        if "classes" in meta:
-            self.classes_ = meta["classes"]
-        self.n_iter_ = int(self._params["n_iter"])
-        return self
+        return self._set_fitted(params, meta)
 
     def _check_fitted(self):
         if not hasattr(self, "_params"):

@@ -30,6 +30,19 @@ Three family forms, selected by the estimator's ``_stream_fit_kind``:
 - **"gram"** (Ridge family): the normal equations accumulate — each
   block contributes its ``(XᵀSX, XᵀST)`` partials, one small solve
   finishes per task.
+- **"gbdt"** (DistHistGradientBoosting*): boosting rounds become
+  binned-cache streams. Raw features are touched exactly twice up
+  front (the quantile-sketch pass and the bin pass that writes the
+  uint8 cache, both inside ``ChunkedDataset.with_binned_cache``);
+  every boosting round then streams the ~4×-smaller cache: one
+  histogram pass per tree level (per-node grad/hess histograms
+  accumulated across blocks, psum'd over the mesh 'data' axis by
+  GSPMD) plus one update pass advancing the margin carry ``F`` —
+  which lives in host memmaps and rides the block tree, so device
+  memory stays O(block). Split scoring runs the resident kernel's own
+  ``histogram_node_scores``/``pick_level_splits`` on the gathered
+  histograms, so resident-vs-streamed trees agree to f32 block-sum
+  tolerance. The rung hook fires at every round boundary.
 
 Every driver dispatches per-task batches (the CV search's candidate ×
 fold axis, OvR's class axis) through one vmapped program whose task
@@ -40,6 +53,9 @@ the current pass after re-placing device state.
 """
 
 import math
+import os
+import shutil
+import tempfile
 import time
 
 import numpy as np
@@ -1136,6 +1152,502 @@ def _pick_carry(keep_dev, old, new):
     return jax.tree_util.tree_map(pick, old, new)
 
 
+def _fit_gbdt_stream(backend, est_cls, meta, static, dataset, row_arrays,
+                     task_args, derive, stats, sync, key_extra=(),
+                     w_init=None, rung_hook=None):
+    """Boosting rounds as binned-cache streams.
+
+    Round structure (all passes read the uint8 binned cache, never raw
+    features): per tree level, a histogram pass routes every block's
+    rows to their current node with the partial heap placed in the task
+    tree, scatters ``newton_channels(grad, hess, w)`` into per-(class,
+    feature, node, bin) histograms, and accumulates across blocks
+    (:func:`_streamed_sum`; on a mesh the row-sharded scatter psums
+    over 'data'). A device chooser then scores the gathered histograms
+    with the resident kernel's OWN :func:`~.tree.histogram_node_scores`
+    / :func:`~.tree.pick_level_splits` — parity by shared code. The
+    host assembles the round's heap (leaf values from the level totals:
+    an unsplit node's Newton step is ``−G/(H+λ)`` of the samples
+    resting there; last-level children split their parent's totals via
+    the recorded left-cumulative stats, exactly the resident kernel's
+    final-assignment scatter re-expressed). One update pass advances
+    the margin carry ``F`` and accumulates the early-stop monitor.
+
+    ``F`` lives in two host memmaps sized (T, n, Kt): the update pass
+    reads ``F_cur`` and writes ``F_nxt``, committing not-yet-done lanes
+    back to ``F_cur`` only after the pass completes — so a transient
+    fault replays block i bitwise (its input rows are untouched) and a
+    preemption rewinds the whole pass idempotently. ``w_init`` is
+    accepted and ignored (an ensemble has no flat iterate to seed).
+
+    ``rung_hook`` fires at every round boundary with finalize-shaped
+    params for the live lanes (unrun rounds hold all-zero trees, so the
+    decision kernel's full static-T scan is exact mid-race); killed
+    lanes compact out of the task batch and their F rows go cold."""
+    from .gbdt import _P_EPS, _build_boost_parts, _stacked_tree_walk
+    from .tree import (
+        _NEG, histogram_node_scores, n_tree_nodes, newton_channels,
+        pick_level_splits,
+    )
+
+    st = dict(static)
+    parts = _build_boost_parts(meta, static)
+    grads, loss_vals = parts["grads"], parts["loss_vals"]
+    Kt, D, K = parts["Kt"], parts["D"], parts["K"]
+    classification = parts["classification"]
+    max_iter = parts["T"]
+    N = n_tree_nodes(D)
+    es = bool(st["_early_stopping"])
+    patience = int(st["n_iter_no_change"])
+    msl = int(st["min_samples_leaf"])
+    B = int(st["max_bins"])
+    d = int(st["_n_features"])
+
+    cache = meta.get("binned_cache")
+    if cache is None:
+        cache = dataset.with_binned_cache(
+            edges=np.asarray(meta["edges"], np.float32), max_bins=B
+        )
+    edges_np = np.asarray(meta["edges"], np.float32)
+    stats["binned_bytes_cached"] = (
+        stats.get("binned_bytes_cached", 0)
+        + (0 if cache.hit else int(cache.nbytes))
+    )
+
+    n = dataset.n_rows
+    R = dataset.block_rows
+    n_blocks = dataset.n_blocks
+    T = _n_tasks(task_args)
+    lr_h = np.asarray(task_args["hyper"]["learning_rate"],
+                      np.float32).reshape(T)
+    lam_h = np.asarray(task_args["hyper"]["l2_regularization"],
+                       np.float32).reshape(T)
+    tol_h = np.asarray(task_args["hyper"]["tol"], np.float32).reshape(T)
+
+    # ---- kernels ----------------------------------------------------
+
+    def _routed_nodes(Xb, f_a, t_a, s_a, level):
+        # replay `level` levels of heap routing — tree_predict_kernel's
+        # walk against the partial heap (non-split nodes carry
+        # is_split=False and hold their samples, like the resident
+        # level loop's split_s gate)
+        node = jnp.zeros(Xb.shape[0], jnp.int32)
+        for _ in range(level):
+            f = jnp.clip(f_a[node], 0, d - 1)
+            t = t_a[node]
+            s = s_a[node]
+            b = jnp.take_along_axis(Xb, f[:, None], axis=1)[:, 0]
+            child = 2 * node + 1 + (b > t).astype(jnp.int32)
+            node = jnp.where(s, child, node)
+        return node
+
+    def make_hist_kernel(level):
+        nl = 2 ** level
+        start = nl - 1
+
+        def kernel(block, tc):
+            Xb_u, yb, fit_w, _hyper = derive(block, tc["task"])
+            Xb = Xb_u.astype(jnp.int32)
+            F_lane = block["F"][tc["task"]["lane"]]
+            g, h = grads(F_lane, yb)
+            tr = tc["task"]["tree"]
+
+            def one_class(gk, hk, f_a, t_a, s_a):
+                node = _routed_nodes(Xb, f_a, t_a, s_a, level)
+                at = (node >= start) & (node < start + nl)
+                rel = jnp.clip(node - start, 0, nl - 1)
+                Ych = newton_channels(gk, hk, fit_w) * \
+                    at[:, None].astype(jnp.float32)
+                seg = (jnp.arange(d)[None, :] * nl + rel[:, None]) * B + Xb
+                hist = jnp.zeros((d * nl * B, 3), jnp.float32).at[
+                    seg.reshape(-1)
+                ].add(jnp.repeat(Ych, d, axis=0))
+                return hist.reshape(d, nl, B, 3)
+
+            if Kt == 1:
+                hist = one_class(
+                    g, h, tr["feat"][0], tr["thr"][0], tr["split"][0]
+                )[None]
+            else:
+                hist = jax.vmap(one_class, in_axes=(1, 1, 0, 0, 0))(
+                    g, h, tr["feat"], tr["thr"], tr["split"]
+                )
+            return {"hist": hist}  # (Kt, d, nl, B, 3)
+
+        return kernel
+
+    def make_choose_kernel(level):
+        nl = 2 ** level
+
+        def kernel(_z, tc):
+            lam = tc["task"]["hyper"]["l2_regularization"]
+
+            def one_class(hk):
+                cum = jnp.cumsum(hk, axis=2)
+                gain, cnt_l, cnt_r, tot = histogram_node_scores(
+                    cum, lam, newton=True
+                )
+                node_cnt = tot[0, :, -1]
+                ok = (cnt_l >= msl) & (cnt_r >= msl)
+                gain = jnp.where(ok, gain, _NEG)
+                # w_root=1 is exact here: the boost kernel fixes
+                # min_impurity_decrease=0, so the decrease gate reduces
+                # to best_gain > 1e-12 for ANY positive root mass —
+                # the same decision the resident kernel takes
+                best_f, best_t, _bg, do_split = pick_level_splits(
+                    gain, node_cnt, min_samples_split=2,
+                    w_root=jnp.float32(1.0), min_impurity_decrease=0.0,
+                )
+                lstat = cum[best_f, jnp.arange(nl), best_t]
+                return {"feat": jnp.where(do_split, best_f, -1),
+                        "thr": best_t, "split": do_split,
+                        "tot": tot[0], "lstat": lstat}
+
+            hist = tc["hist"]
+            if Kt == 1:
+                return jax.tree_util.tree_map(
+                    lambda a: a[None], one_class(hist[0])
+                )
+            return jax.vmap(one_class)(hist)
+
+        return kernel
+
+    def update_kernel(block, tc):
+        Xb_u, yb, fit_w, _hyper = derive(block, tc["task"])
+        Xb = Xb_u.astype(jnp.int32)
+        F_lane = block["F"][tc["task"]["lane"]]
+        tr = tc["task"]["tree"]
+        F_new = F_lane + _stacked_tree_walk(
+            Xb, tr["feat"], tr["thr"], tr["split"], tr["leaf"], D
+        )
+        lv = loss_vals(F_new, yb)
+        return {"F": F_new, "mon_num": jnp.sum(fit_w * lv),
+                "mon_den": jnp.sum(fit_w)}
+
+    def init_kernel(block, tc):
+        # per-lane baseline sufficient statistics (fold-masked weights
+        # differ per lane): class-weighted counts / weighted y sum
+        _Xb, yb, fit_w, _hyper = derive(block, tc["task"])
+        if classification:
+            s = jax.nn.one_hot(yb, max(K, 2), dtype=jnp.float32).T @ fit_w
+        else:
+            s = jnp.sum(fit_w * yb.astype(jnp.float32))[None]
+        return {"s": s, "w": jnp.sum(fit_w)[None]}
+
+    # ---- plans ------------------------------------------------------
+    example = {"X": np.zeros((R, d), np.uint8)}
+    for name, arr in row_arrays.items():
+        arr = np.asarray(arr)
+        example[name] = np.zeros((R,) + arr.shape[1:], arr.dtype)
+    example["F"] = np.zeros((1, R, Kt), np.float32)
+
+    def skey(part):
+        return _stream_key(est_cls, static, meta, part, key_extra)
+
+    plans_h = [
+        backend.prepare_streamed(make_hist_kernel(l), example,
+                                 cache_key=skey(f"gbdt_h{l}"))
+        for l in range(D)
+    ]
+    plans_c = [
+        backend.prepare_streamed(make_choose_kernel(l), None,
+                                 cache_key=skey(f"gbdt_c{l}"))
+        for l in range(D)
+    ]
+    plan_u = backend.prepare_streamed(update_kernel, example,
+                                      cache_key=skey("gbdt_u"))
+    plan_b = backend.prepare_streamed(init_kernel, example,
+                                      cache_key=skey("gbdt_b"))
+    all_plans = plans_h + plans_c + [plan_u, plan_b]
+
+    # ---- host state -------------------------------------------------
+    sel = {"idx": np.arange(T)}
+    state = {}
+    fdir = tempfile.mkdtemp(prefix="skdist_gbdt_F_")
+    F_cur = np.lib.format.open_memmap(
+        os.path.join(fdir, "F_cur.npy"), mode="w+",
+        dtype=np.float32, shape=(T, n, Kt),
+    )
+    F_nxt = np.lib.format.open_memmap(
+        os.path.join(fdir, "F_nxt.npy"), mode="w+",
+        dtype=np.float32, shape=(T, n, Kt),
+    )
+
+    def read(i):
+        s0 = i * R
+        e0 = min(s0 + R, n)
+        m = e0 - s0
+        xb = np.zeros((R, d), np.uint8)
+        xb[:m] = cache.xb[s0:e0]
+        tree = {"X": xb}
+        for name, arr in row_arrays.items():
+            sl = np.asarray(arr[s0:e0])
+            if m < R:
+                sl = np.concatenate([
+                    sl,
+                    np.full((R - m,) + sl.shape[1:],
+                            _pad_rows_for(name), sl.dtype),
+                ])
+            tree[name] = sl
+        idx = sel["idx"]
+        L = idx.size
+        Fp = np.zeros((state["Lp"], R, Kt), np.float32)
+        Fp[:L, :m] = F_cur[idx, s0:e0]
+        if state["Lp"] > L:
+            Fp[L:] = Fp[L - 1]  # duplicate-last, like _pad_tree_to
+        tree["F"] = Fp
+        return tree
+
+    def place_current():
+        state["tasks"] = plan_u.put_task(state["task_host"])
+
+    def place_round(tree_host):
+        L = sel["idx"].size
+        if "Lp" not in state:
+            slots = max(1, int(plan_u.n_task_slots))
+            state["Lp"] = -(-L // slots) * slots
+        th = dict(_take_tree(task_args, sel["idx"]))
+        th["lane"] = np.arange(L, dtype=np.int32)
+        th["tree"] = tree_host
+        state["task_host"] = _pad_tree_to(th, L, state["Lp"])
+        place_current()
+
+    def restart_pass():
+        _elastic_replans(backend, all_plans)
+        place_current()
+        faults.record("shared_replacements")
+
+    def tc():
+        return {"task": state["tasks"]}
+
+    try:
+        # ---- baseline pass ------------------------------------------
+        zero_heap = {
+            "feat": np.full((T, Kt, N), -1, np.int32),
+            "thr": np.zeros((T, Kt, N), np.int32),
+            "split": np.zeros((T, Kt, N), bool),
+        }
+        place_round(zero_heap)
+        acc0 = _streamed_sum(plan_b, read, n_blocks, tc, stats, sync,
+                             restart=restart_pass)
+        stats["binned_bytes_streamed"] += int(cache.nbytes)
+        sS = np.asarray(acc0["s"], np.float32)[:T]
+        wS = np.maximum(np.asarray(acc0["w"], np.float32)[:T, 0],
+                        np.float32(1e-12))
+        if not classification:
+            base_all = (sS[:, :1] / wS[:, None]).astype(np.float32)
+        elif K <= 2:
+            p = np.clip(sS[:, K - 1] / wS, _P_EPS,
+                        np.float32(1.0) - np.float32(_P_EPS))
+            base_all = np.log(
+                p / (np.float32(1.0) - p)
+            ).astype(np.float32)[:, None]
+        else:
+            pri = sS / wS[:, None]
+            base_all = np.log(
+                np.clip(pri, _P_EPS, None)
+            ).astype(np.float32)
+        for t in range(T):
+            F_cur[t] = base_all[t][None, :]
+
+        # ---- outputs + early-stop mirrors (resident carry, host f32)
+        feat_all = np.full((T, max_iter, Kt, N), -1, np.int32)
+        thr_all = np.zeros((T, max_iter, Kt, N), np.int32)
+        split_all = np.zeros((T, max_iter, Kt, N), bool)
+        leaf_all = np.zeros((T, max_iter, Kt, N), np.float32)
+        best = np.full(T, np.inf, np.float32)
+        bad = np.zeros(T, np.int64)
+        n_rounds = np.zeros(T, np.int64)
+        done = np.zeros(T, bool)
+
+        guard = _BlockRetry(stats)
+        r = 0
+        rounds_run = 0
+        while r < max_iter:
+            lane = sel["idx"]
+            L = lane.size
+            # ---- grow one tree per live lane, level by level --------
+            featH = np.full((L, Kt, N), -1, np.int32)
+            thrH = np.zeros((L, Kt, N), np.int32)
+            splitH = np.zeros((L, Kt, N), bool)
+            tots, lstats = [], []
+            for l in range(D):
+                place_round({"feat": featH, "thr": thrH,
+                             "split": splitH})
+                acc = _streamed_sum(plans_h[l], read, n_blocks, tc,
+                                    stats, sync, restart=restart_pass)
+                stats["binned_bytes_streamed"] += int(cache.nbytes)
+                fin = jax.device_get(plans_c[l].fn(
+                    plans_c[l].put_block({"z": np.zeros(1, np.float32)}),
+                    {"task": state["tasks"],
+                     "hist": jnp.asarray(acc["hist"])},
+                ))
+                nl = 2 ** l
+                i0 = nl - 1
+                featH[:, :, i0:i0 + nl] = np.asarray(
+                    fin["feat"], np.int32)[:L]
+                thrH[:, :, i0:i0 + nl] = np.asarray(
+                    fin["thr"], np.int32)[:L]
+                splitH[:, :, i0:i0 + nl] = np.asarray(
+                    fin["split"], bool)[:L]
+                tots.append(np.asarray(fin["tot"], np.float32)[:L])
+                lstats.append(np.asarray(fin["lstat"], np.float32)[:L])
+            # ---- leaves from the level totals (host f32) ------------
+            leafH = np.zeros((L, Kt, N), np.float32)
+            lam_l = lam_h[lane][:, None, None]
+            for l in range(D):
+                nl = 2 ** l
+                i0 = nl - 1
+                tot = tots[l]
+                val = -tot[..., 0] / np.maximum(
+                    tot[..., 1] + lam_l, np.float32(1e-12)
+                )
+                leafH[:, :, i0:i0 + nl] = np.where(
+                    splitH[:, :, i0:i0 + nl], np.float32(0.0),
+                    val.astype(np.float32),
+                )
+            nl = 2 ** (D - 1)
+            i0 = nl - 1
+            left = lstats[D - 1]
+            right = tots[D - 1] - left
+            spD = splitH[:, :, i0:i0 + nl]
+            lv = -left[..., 0] / np.maximum(
+                left[..., 1] + lam_l, np.float32(1e-12))
+            rv = -right[..., 0] / np.maximum(
+                right[..., 1] + lam_l, np.float32(1e-12))
+            iD = 2 ** D - 1
+            leafH[:, :, iD:iD + 2 * nl:2] = np.where(
+                spD, lv.astype(np.float32), np.float32(0.0))
+            leafH[:, :, iD + 1:iD + 2 * nl:2] = np.where(
+                spD, rv.astype(np.float32), np.float32(0.0))
+            leafH *= lr_h[lane][:, None, None]
+
+            # ---- update pass: advance F, accumulate the monitor -----
+            place_round({"feat": featH, "thr": thrH, "split": splitH,
+                         "leaf": leafH})
+            num = np.zeros(L, np.float32)
+            den = np.zeros(L, np.float32)
+            feeder = BlockFeeder(read, n_blocks,
+                                 lambda t_: plan_u.put_block(t_),
+                                 sync=sync, stats=stats)
+            try:
+                while True:
+                    item = feeder.next()
+                    if item is None:
+                        break
+                    i, dv = item
+                    t0 = time.perf_counter()
+                    try:
+                        _dispatch_seam()
+                        out = jax.device_get(plan_u.fn(dv, tc()))
+                    except Exception as exc:
+                        def restart_u():
+                            restart_pass()
+                            num[:] = np.float32(0.0)
+                            den[:] = np.float32(0.0)
+
+                        # transient: F_cur rows are untouched until the
+                        # pass commits, so block i replays bitwise
+                        guard.handle(exc, feeder, i, restart=restart_u)
+                        continue
+                    stats["dispatch_s"] += time.perf_counter() - t0
+                    s0 = i * R
+                    e0 = min(s0 + R, n)
+                    F_nxt[lane, s0:e0] = np.asarray(
+                        out["F"], np.float32)[:L, :e0 - s0]
+                    num += np.asarray(out["mon_num"], np.float32)[:L]
+                    den += np.asarray(out["mon_den"], np.float32)[:L]
+            finally:
+                feeder.close()
+            stats["binned_bytes_streamed"] += int(cache.nbytes)
+            rounds_run = r + 1
+
+            # ---- commit F for lanes not yet frozen (block-wise: the
+            # carries are memmaps and must not materialise whole) -----
+            keep = done[lane]
+            upd = lane[~keep]
+            for i in range(n_blocks):
+                s0 = i * R
+                e0 = min(s0 + R, n)
+                F_cur[upd, s0:e0] = F_nxt[upd, s0:e0]
+
+            # ---- round-end bookkeeping: the resident round body's
+            # tail, value for value, in host f32 ----------------------
+            mon = (num / np.maximum(den, np.float32(1e-12))).astype(
+                np.float32)
+            improved = mon < (best[lane] - tol_h[lane]).astype(np.float32)
+            bad_new = np.where(improved, 0, bad[lane] + 1)
+            done_new = np.full(L, (r + 1) >= max_iter)
+            if es:
+                done_new = done_new | (bad_new >= patience)
+            act = ~keep
+            ai = lane[act]
+            feat_all[ai, r] = featH[act]
+            thr_all[ai, r] = thrH[act]
+            split_all[ai, r] = splitH[act]
+            leaf_all[ai, r] = leafH[act]
+            best[lane] = np.where(keep, best[lane],
+                                  np.minimum(best[lane], mon))
+            bad[lane] = np.where(keep, bad[lane], bad_new)
+            n_rounds[lane] = np.where(keep, n_rounds[lane], r + 1)
+            done[lane] = keep | done_new
+
+            # ---- rung hook at the round (block-pass) boundary -------
+            if rung_hook is not None:
+                live_ids = lane[~done[lane]]
+                if live_ids.size:
+                    def make_params():
+                        idx = live_ids
+                        return {
+                            "feat": feat_all[idx], "thr": thr_all[idx],
+                            "is_split": split_all[idx],
+                            "leaf": leaf_all[idx],
+                            "baseline": base_all[idx],
+                            "n_iter": n_rounds[idx].astype(np.int32),
+                            "edges": np.repeat(
+                                edges_np[None], idx.size, axis=0),
+                        }
+
+                    killed = np.asarray(
+                        rung_hook(r + 1, live_ids, make_params), np.int64
+                    ).reshape(-1)
+                    if killed.size:
+                        # out arrays already hold kill-time params
+                        done[killed] = True
+                        sel["idx"] = lane[~np.isin(lane, killed)]
+                        state.pop("Lp", None)
+                        stats["retired_rung"] = (
+                            stats.get("retired_rung", 0)
+                            + int(killed.size)
+                        )
+                        stats["passes_saved"] = (
+                            stats.get("passes_saved", 0)
+                            + int(killed.size) * (D + 1)
+                            * max(0, max_iter - (r + 1))
+                        )
+            lane_now = sel["idx"]
+            if lane_now.size == 0 or done[lane_now].all():
+                break
+            r += 1
+    finally:
+        del F_cur, F_nxt
+        shutil.rmtree(fdir, ignore_errors=True)
+
+    if rung_hook is not None and sel["idx"].size < T:
+        # upper bound: every remaining round was D hist passes + one
+        # update pass over the cache
+        stats["streamed_bytes_saved"] = (
+            stats.get("streamed_bytes_saved", 0)
+            + int(cache.nbytes) * (D + 1) * max(0, max_iter - rounds_run)
+        )
+    return {
+        "feat": feat_all, "thr": thr_all, "is_split": split_all,
+        "leaf": leaf_all, "baseline": base_all,
+        "n_iter": n_rounds.astype(np.int32),
+        "edges": np.repeat(edges_np[None], T, axis=0),
+    }
+
+
 def _stack_params(params_list):
     """List of per-task param dicts -> dict of stacked (T, ...) arrays
     (n_iter-style scalars stack to (T,))."""
@@ -1177,7 +1689,8 @@ def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
         raise TypeError(
             f"{est_cls.__name__} has no out-of-core fit path "
             "(_stream_fit_kind is unset); materialise the dataset or "
-            "use a linear family"
+            "use a family with a streamed driver (the linear families "
+            "or DistHistGradientBoosting*)"
         )
     _check_data_axis_geometry(backend, dataset)
     sync = _resolve_sync(backend, sync)
@@ -1188,6 +1701,7 @@ def stream_fit_tasks(backend, est_cls, meta, static, dataset, row_arrays,
         "lbfgs": _fit_lbfgs_stream,
         "sgd": _fit_sgd_stream,
         "gram": _fit_gram_stream,
+        "gbdt": _fit_gbdt_stream,
     }[kind]
     stats["tasks"] = stats.get("tasks", 0) + _n_tasks(task_args)
     out = driver(backend, est_cls, meta, static, dataset, row_arrays,

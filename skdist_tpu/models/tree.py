@@ -52,7 +52,9 @@ __all__ = [
     "ExtraTreeClassifier",
     "ExtraTreeRegressor",
     "build_tree_kernel",
+    "histogram_node_scores",
     "newton_channels",
+    "pick_level_splits",
     "tree_predict_kernel",
 ]
 
@@ -61,6 +63,78 @@ _NEG = -1e30
 
 def n_tree_nodes(max_depth):
     return 2 ** (max_depth + 1) - 1
+
+
+def histogram_node_scores(hist_cum, lam=None, *, newton=False,
+                          classification=False, K=1):
+    """hist_cum (d, nl, B, C) cumulative over bins → per-(f, node,
+    threshold) gain proxies + counts. Returns (gain, cnt_l, cnt_r,
+    node_totals) with node_totals (d, nl, C). ``lam`` is the
+    traced Newton λ (only consumed by the newton objective).
+
+    Module-level so out-of-core drivers (``models/streaming.py``) can
+    score histograms gathered across blocks with the exact ops the
+    resident kernel traces — resident-vs-streamed parity is by shared
+    code, not by reimplementation."""
+    tot = hist_cum[:, :, -1, :]  # (d, nl, C)
+    L = hist_cum  # left stats for threshold t = bins <= t
+    R = tot[:, :, None, :] - L
+    cnt_l = L[..., -1]
+    cnt_r = R[..., -1]
+    if newton:
+        g_l, h_l = L[..., 0], L[..., 1]
+        g_r, h_r = R[..., 0], R[..., 1]
+        g_t, h_t = tot[..., 0], tot[..., 1]
+        gain = (
+            g_l**2 / jnp.maximum(h_l + lam, 1e-12)
+            + g_r**2 / jnp.maximum(h_r + lam, 1e-12)
+            - (g_t**2 / jnp.maximum(h_t + lam, 1e-12))[:, :, None]
+        )
+    elif classification:
+        wl = jnp.sum(L[..., :K], axis=-1)
+        wr = jnp.sum(R[..., :K], axis=-1)
+        sl = jnp.sum(L[..., :K] ** 2, axis=-1) / jnp.maximum(wl, 1e-12)
+        sr = jnp.sum(R[..., :K] ** 2, axis=-1) / jnp.maximum(wr, 1e-12)
+        st = jnp.sum(tot[..., :K] ** 2, axis=-1) / jnp.maximum(
+            jnp.sum(tot[..., :K], axis=-1), 1e-12
+        )
+        # (Σ wt·gini improvements): decrease·W_root = sl + sr - st
+        gain = sl + sr - st[:, :, None]
+    else:
+        w_l, wy_l, wy2_l = L[..., 0], L[..., 1], L[..., 2]
+        w_r, wy_r, wy2_r = R[..., 0], R[..., 1], R[..., 2]
+        sse_l = wy2_l - wy_l**2 / jnp.maximum(w_l, 1e-12)
+        sse_r = wy2_r - wy_r**2 / jnp.maximum(w_r, 1e-12)
+        wt, wy_t, wy2_t = tot[..., 0], tot[..., 1], tot[..., 2]
+        sse_t = wy2_t - wy_t**2 / jnp.maximum(wt, 1e-12)
+        gain = sse_t[:, :, None] - (sse_l + sse_r)
+    return gain, cnt_l, cnt_r, tot
+
+
+def pick_level_splits(gain, node_cnt, *, min_samples_split, w_root,
+                      min_impurity_decrease):
+    """Pick the best (feature, threshold) per node from masked gains.
+
+    ``gain`` (d, nl, B) with invalid cells already at ``_NEG``;
+    ``node_cnt`` (nl,) unweighted occupancy. Returns
+    (best_f, best_t, best_gain, do_split). Shared by the resident
+    level loop and the streamed host chooser."""
+    nl = gain.shape[1]
+    B = gain.shape[2]
+    gain_fb = jnp.transpose(gain, (1, 0, 2)).reshape(nl, -1)
+    best_flat = jnp.argmax(gain_fb, axis=1)
+    best_gain = jnp.take_along_axis(
+        gain_fb, best_flat[:, None], axis=1
+    )[:, 0]
+    best_f = (best_flat // B).astype(jnp.int32)
+    best_t = (best_flat % B).astype(jnp.int32)
+    decrease = best_gain / jnp.maximum(w_root, 1e-12)
+    do_split = (
+        (best_gain > 1e-12)
+        & (decrease >= min_impurity_decrease)
+        & (node_cnt >= min_samples_split)
+    )
+    return best_f, best_t, best_gain, do_split
 
 
 def resolve_hist_config(n_features, n_bins, hist_mode="auto",
@@ -257,44 +331,10 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
         )
 
     def node_scores(hist_cum, lam=None):
-        """hist_cum (d, nl, B, C) cumulative over bins → per-(f, node,
-        threshold) gain proxies + counts. Returns (gain, cnt_l, cnt_r,
-        node_totals) with node_totals (d, nl, C). ``lam`` is the
-        traced Newton λ (only consumed by the newton objective)."""
-        tot = hist_cum[:, :, -1, :]  # (d, nl, C)
-        L = hist_cum  # left stats for threshold t = bins <= t
-        R = tot[:, :, None, :] - L
-        cnt_l = L[..., -1]
-        cnt_r = R[..., -1]
-        if newton:
-            g_l, h_l = L[..., 0], L[..., 1]
-            g_r, h_r = R[..., 0], R[..., 1]
-            g_t, h_t = tot[..., 0], tot[..., 1]
-            gain = (
-                g_l**2 / jnp.maximum(h_l + lam, 1e-12)
-                + g_r**2 / jnp.maximum(h_r + lam, 1e-12)
-                - (g_t**2 / jnp.maximum(h_t + lam, 1e-12))[:, :, None]
-            )
-        elif classification:
-            wl = jnp.sum(L[..., :K], axis=-1)
-            wr = jnp.sum(R[..., :K], axis=-1)
-            sl = jnp.sum(L[..., :K] ** 2, axis=-1) / jnp.maximum(wl, 1e-12)
-            sr = jnp.sum(R[..., :K] ** 2, axis=-1) / jnp.maximum(wr, 1e-12)
-            wt = wl + wr
-            st = jnp.sum(tot[..., :K] ** 2, axis=-1) / jnp.maximum(
-                jnp.sum(tot[..., :K], axis=-1), 1e-12
-            )
-            # (Σ wt·gini improvements): decrease·W_root = sl + sr - st
-            gain = sl + sr - st[:, :, None]
-        else:
-            w_l, wy_l, wy2_l = L[..., 0], L[..., 1], L[..., 2]
-            w_r, wy_r, wy2_r = R[..., 0], R[..., 1], R[..., 2]
-            sse_l = wy2_l - wy_l**2 / jnp.maximum(w_l, 1e-12)
-            sse_r = wy2_r - wy_r**2 / jnp.maximum(w_r, 1e-12)
-            wt, wy_t, wy2_t = tot[..., 0], tot[..., 1], tot[..., 2]
-            sse_t = wy2_t - wy_t**2 / jnp.maximum(wt, 1e-12)
-            gain = sse_t[:, :, None] - (sse_l + sse_r)
-        return gain, cnt_l, cnt_r, tot
+        return histogram_node_scores(
+            hist_cum, lam, newton=newton,
+            classification=classification, K=K,
+        )
 
     def kernel(Xb, Ych, key, l2=None):
         n = Xb.shape[0]
@@ -448,18 +488,11 @@ def build_tree_kernel(n_features, n_bins, channels, max_depth, max_features,
                 gain = jnp.where(sel, gain, _NEG)
 
             # ---- pick best (feature, threshold) per node
-            gain_fb = jnp.transpose(gain, (1, 0, 2)).reshape(nl, d * B)
-            best_flat = jnp.argmax(gain_fb, axis=1)
-            best_gain = jnp.take_along_axis(
-                gain_fb, best_flat[:, None], axis=1
-            )[:, 0]
-            best_f = (best_flat // B).astype(jnp.int32)
-            best_t = (best_flat % B).astype(jnp.int32)
-            decrease = best_gain / jnp.maximum(w_root, 1e-12)
-            do_split = (
-                (best_gain > 1e-12)
-                & (decrease >= min_impurity_decrease)
-                & (node_cnt >= min_samples_split)
+            best_f, best_t, best_gain, do_split = pick_level_splits(
+                gain, node_cnt,
+                min_samples_split=min_samples_split,
+                w_root=w_root,
+                min_impurity_decrease=min_impurity_decrease,
             )
 
             idx = start + jnp.arange(nl)

@@ -567,6 +567,12 @@ ROUND_STATS_REQUIRED = {
     # shortened race never streamed
     "passes_saved": 0,
     "streamed_bytes_saved": 0,
+    # binned-block-cache accounting (streamed GBDT): bytes written
+    # building the uint8 cache this fit (0 on a cache HIT — the 4x
+    # read-amplification win is observable, not asserted) and bytes
+    # read back from it across all boosting passes
+    "binned_bytes_cached": 0,
+    "binned_bytes_streamed": 0,
     "rung_survivors": None,  # per-rung survivor counts, "12,4,2"
 }
 
@@ -599,6 +605,7 @@ _ROUND_PUBLISH_KEYS = (
     "rounds", "tasks", "retries", "dispatch_s", "gather_wait_s",
     "retired_rung", "retired_convergence", "streamed_bytes",
     "passes_saved", "streamed_bytes_saved",
+    "binned_bytes_cached", "binned_bytes_streamed",
 )
 
 

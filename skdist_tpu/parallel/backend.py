@@ -1548,6 +1548,10 @@ def _block_shardings(backend, block_example, rules=None):
     rep = NamedSharding(backend.mesh, P())
     if getattr(backend, "data_axis_size", 1) <= 1:
         return rep
+    if block_example is None:
+        # finish-style plans (gram solve, GBDT chooser) take no real
+        # block — their placeholder input replicates on any mesh
+        return rep
     from .mesh import STREAM_BLOCK_RULES, match_partition_rules
 
     specs = match_partition_rules(

@@ -188,6 +188,10 @@ def multihost_task_mesh(data_axis_size=None):
 STREAM_BLOCK_RULES = (
     (r"(^|/)X($|/)", ("data",)),
     (r"(^|/)(y|sw|fold)($|/)", ("data",)),
+    # streamed GBDT margin carry F is (lanes, rows, classes): lane axis
+    # replicated (each task lane gathers its own slice), rows sharded
+    # on 'data' alongside the binned X block they were computed from
+    (r"(^|/)F($|/)", (None, "data")),
 )
 
 

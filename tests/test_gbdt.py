@@ -573,10 +573,17 @@ def test_ovr_rides_class_axis(tpu_backend, clf_data):
     assert ovr.predict_proba(X).shape == (len(y), 3)
 
 
-def test_chunked_dataset_raises_with_remedy(tmp_path, binary_data):
+def test_chunked_dataset_fit_is_streamed(tmp_path, binary_data):
+    # fit(ChunkedDataset) no longer raises: it routes to the streamed
+    # out-of-core driver (tests/test_streamed_gbdt.py pins parity);
+    # the one config a stream can't support names what IS supported
     from skdist_tpu.data import ChunkedDataset
 
     X, y = binary_data
     ds = ChunkedDataset.from_arrays(X, y=y, block_rows=64)
-    with pytest.raises(TypeError, match="materialise"):
-        _clf().fit(ds, None)
+    est = _clf(max_iter=4, max_depth=2, max_bins=16,
+               validation_fraction=None).fit(ds, None)
+    assert est.n_features_in_ == X.shape[1]
+    assert float(np.mean(est.predict(X) == y)) > 0.85
+    with pytest.raises(ValueError, match="validation_fraction=None"):
+        _clf(early_stopping=True, validation_fraction=0.1).fit(ds)

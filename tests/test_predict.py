@@ -103,6 +103,20 @@ def test_bad_method(clf_data):
         get_prediction_udf(model, method="transform")
 
 
+def _wide_sparse_csr(n_rows=2000, n_cols=1 << 18, nnz=5243):
+    """~1e-5-density CSR at HashingVectorizer width. Built directly
+    from sampled coordinates: sp.random() at this shape permutes all
+    n_rows*n_cols candidate positions and takes ~40 s alone."""
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, n_rows, size=nnz)
+    cols = rng.integers(0, n_cols, size=nnz)
+    vals = rng.random(nnz, dtype=np.float32)
+    return sp.csr_matrix((vals, (rows, cols)), shape=(n_rows, n_cols),
+                         dtype=np.float32)
+
+
 def test_sparse_width_guardrail(monkeypatch):
     """A DENSIFICATION whose result blows the budget must raise an
     informative error up front, not OOM (round-2 VERDICT weak #7) —
@@ -110,15 +124,12 @@ def test_sparse_width_guardrail(monkeypatch):
     columns is a realistic HashingVectorizer width; since the sparse
     fit plane, fitting such an input SUCCEEDS (packed, never
     densified) unless the plane is disabled."""
-    import scipy.sparse as sp
-
     from skdist_tpu.models.linear import as_dense_f32
     from skdist_tpu.sparse import SPARSE_FIT_ENV
     from skdist_tpu.utils.meminfo import BUDGET_ENV
 
     monkeypatch.setenv(BUDGET_ENV, str(1 << 20))  # 1 MB budget
-    X = sp.random(2000, 1 << 18, density=1e-5, format="csr",
-                  dtype=np.float32, random_state=0)
+    X = _wide_sparse_csr()
     with pytest.raises(ValueError) as exc:
         as_dense_f32(X)
     msg = str(exc.value)
@@ -133,9 +144,21 @@ def test_sparse_width_guardrail(monkeypatch):
     monkeypatch.setenv(SPARSE_FIT_ENV, "0")
     with pytest.raises(ValueError, match="batch_predict"):
         LR(max_iter=5).fit(X, y)
-    # with the plane on (default), the SAME input fits without ever
-    # densifying — the size the framework exists to serve
-    monkeypatch.delenv(SPARSE_FIT_ENV)
+
+
+@pytest.mark.slow
+def test_sparse_width_packed_fit_succeeds(monkeypatch):
+    """With the sparse plane on (default), the SAME 2**18-column input
+    that the guardrail above rejects on the dense path fits without
+    ever densifying — the size the framework exists to serve. Slow
+    tier: the wide packed fit dominates the tier-1 budget."""
+    from skdist_tpu.models import LogisticRegression as LR
+    from skdist_tpu.utils.meminfo import BUDGET_ENV
+
+    monkeypatch.setenv(BUDGET_ENV, str(1 << 20))  # 1 MB budget
+    X = _wide_sparse_csr()
+    y = np.zeros(2000, dtype=np.int64)
+    y[:1000] = 1
     model = LR(max_iter=5, engine="xla").fit(X, y)
     assert model._meta.get("x_format") == "packed"
     assert model.coef_.shape == (1, 1 << 18)

@@ -39,6 +39,7 @@ def test_breast_cancer_row_near_reference():
     assert row["ours"] >= row["reference"] - 0.005
 
 
+@pytest.mark.slow  # four end-to-end protocol runs; dominates the tier-1 budget
 def test_fetched_rows_score_synthetic_standins(tmp_path):
     """Without local covtype/20news caches, the fetched protocols run
     end-to-end on the synthetic stand-ins and produce real scores —

@@ -152,6 +152,25 @@ class TestPartitionRules:
         ))
         assert got["s"] == ()
 
+    def test_gbdt_margin_carry_rows_on_data_lanes_replicated(self):
+        from skdist_tpu.parallel.mesh import (
+            STREAM_BLOCK_RULES,
+            match_partition_rules,
+        )
+
+        # streamed-GBDT update block: binned features ride "data" like
+        # any X, the boosting margin carry F is (lanes, rows, K) — rows
+        # co-sharded with the block, the lane axis replicated
+        block = {
+            "X": np.zeros((8, 3), np.uint8),
+            "y": np.zeros(8, np.int32),
+            "sw": np.ones(8, np.float32),
+            "F": np.zeros((2, 8, 1), np.float32),
+        }
+        got = self._names(match_partition_rules(STREAM_BLOCK_RULES, block))
+        assert got["X"] == ("data",)
+        assert got["F"] == (None, "data")
+
 
 class TestFitLayout2D:
     """Largest-divisor re-layout on BOTH axes: the shrunken mesh keeps
@@ -475,3 +494,21 @@ class TestNonSeekableReader:
         ds = self._one_shot_ds()
         with pytest.raises(NonSeekableReaderError, match=r"save"):
             LogisticRegression(max_iter=30, engine="xla").fit(ds)
+
+    def test_streamed_gbdt_fails_fast_before_sketch_pass(self):
+        from skdist_tpu.models.gbdt import (
+            DistHistGradientBoostingClassifier,
+        )
+
+        ds = self._one_shot_ds()
+        est = DistHistGradientBoostingClassifier(
+            max_iter=4, max_depth=2, max_bins=8,
+            early_stopping=False, validation_fraction=None,
+        )
+        with pytest.raises(NonSeekableReaderError, match=r"save"):
+            est.fit(ds)
+        # the seekability probe fired BEFORE the sketch pass: after the
+        # unavoidable label pass (calls == 1 everywhere) the probe
+        # re-read only block 0 — no second traversal ever started
+        assert all(r.calls == 1 for r in ds._readers[1:])
+        assert ds._readers[0].calls == 2

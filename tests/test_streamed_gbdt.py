@@ -1,0 +1,364 @@
+"""Streamed GBDT: out-of-core boosting on the binned block cache.
+
+Three claim families, each pinned against the resident path:
+
+- **sketch**: the one-pass streaming quantile sketch is merge-order
+  invariant (exact multiset union) and its edges stay within one
+  requested-bin rank width of the exact quantiles — including on
+  skewed, constant, and duplicate-heavy columns;
+- **parity**: a streamed ``fit(ChunkedDataset)`` grows the SAME trees
+  as the resident ``newton=True`` kernel fed the same edges (shared
+  grower code; leaf values within f32 block-sum tolerance), across
+  binary/multiclass/regression, weighted, and ragged-block datasets —
+  and when an f32 gain tie breaks differently, the decision surface
+  still agrees to float tolerance;
+- **plumbing**: the binned cache is built once and HIT on refit, raw
+  features are streamed exactly twice (sketch + bin — boosting rounds
+  add zero raw reads), the byte counters match the pass structure,
+  unsupported configs raise naming what IS supported, and transient /
+  preemption faults replay block- / pass-granular without changing
+  the fitted ensemble.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from skdist_tpu.data import ChunkedDataset, NonSeekableReaderError
+from skdist_tpu.models.gbdt import (
+    DistHistGradientBoostingClassifier,
+    DistHistGradientBoostingRegressor,
+)
+from skdist_tpu.models.linear import _freeze, get_kernel, hyper_float
+from skdist_tpu.ops.binning import (
+    StreamingQuantileSketch,
+    quantile_bin_edges,
+)
+from skdist_tpu.parallel import TPUBackend, faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fault_state():
+    faults.reset_stats()
+    yield
+    faults.set_injector(None)
+    faults.reset_stats()
+
+
+KW = dict(max_iter=6, max_depth=3, max_bins=16, min_samples_leaf=5,
+          early_stopping=False, validation_fraction=None)
+
+
+def _make(cls, n, d, K, weighted, seed):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W = rng.normal(size=(d, max(K, 1)))
+    sc = X @ W
+    if cls is DistHistGradientBoostingClassifier:
+        if K > 2:
+            y = np.argmax(sc + 0.5 * rng.normal(size=sc.shape), axis=1)
+        else:
+            y = (sc[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.int64)
+    else:
+        y = (sc[:, 0] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    sw = (rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+          if weighted else None)
+    return X, y, sw
+
+
+def _resident_ref(est, X, y, sw, edges):
+    """The resident fit kernel fed externally-fixed edges — the
+    shared-code parity oracle for the streamed driver."""
+    data, meta = est._prep_fit_data(X, y, sw)
+    meta = dict(meta)
+    meta["edges"] = edges
+    static = _freeze(est._static_config(meta))
+    hyper = {k: jnp.asarray(hyper_float(getattr(est, k)))
+             for k in est._hyper_names}
+    kernel = get_kernel(type(est), "fit", meta, static)
+    return jax.device_get(kernel(data["X"], data["y"], data["sw"], hyper,
+                                 {"edges": jnp.asarray(edges)}))
+
+
+# ---------------------------------------------------------------------------
+# streaming quantile sketch
+# ---------------------------------------------------------------------------
+
+class TestQuantileSketch:
+    def _columns(self, n=4000, seed=0):
+        rng = np.random.default_rng(seed)
+        return np.stack([
+            rng.normal(size=n),                      # symmetric
+            rng.lognormal(0.0, 2.0, size=n),         # heavily skewed
+            np.full(n, 3.25),                        # constant
+            rng.integers(0, 5, size=n).astype(float),  # duplicate-heavy
+            rng.exponential(1.0, size=n),            # skewed positive
+        ], axis=1).astype(np.float32)
+
+    def test_merge_order_invariance_is_bitwise(self):
+        X = self._columns()
+        blocks = np.array_split(X, 7)
+
+        def merged(order):
+            acc = StreamingQuantileSketch(X.shape[1], 16)
+            for i in order:
+                part = StreamingQuantileSketch(X.shape[1], 16)
+                part.update(blocks[i])
+                acc.merge(part)
+            return acc.edges()
+
+        fwd = merged(range(7))
+        rev = merged(reversed(range(7)))
+        shuf = merged([3, 0, 6, 1, 5, 2, 4])
+        np.testing.assert_array_equal(fwd, rev)
+        np.testing.assert_array_equal(fwd, shuf)
+
+    def test_rank_error_within_one_bin_width(self):
+        X = self._columns(n=8000, seed=1)
+        n_bins = 16
+        sk = StreamingQuantileSketch(X.shape[1], n_bins)
+        for blk in np.array_split(X, 11):
+            part = StreamingQuantileSketch(X.shape[1], n_bins)
+            part.update(blk)
+            sk.merge(part)
+        approx = sk.edges()
+        for f in range(X.shape[1]):
+            col = np.sort(X[:, f])
+            for e in approx[f]:
+                if not np.isfinite(e):
+                    continue  # duplicate-collapse sentinel
+                # rank of the approximate edge vs its exact target must
+                # stay within one requested-bin width of SOME target
+                r = np.searchsorted(col, e) / col.size
+                targets = np.linspace(0, 1, n_bins + 1)[1:-1]
+                assert np.min(np.abs(targets - r)) <= 1.0 / n_bins, (
+                    f"feature {f}: edge {e} at rank {r} further than "
+                    f"1/{n_bins} from every quantile target"
+                )
+
+    def test_constant_and_duplicate_columns_match_exact(self):
+        X = self._columns(n=5000, seed=2)
+        n_bins = 16
+        exact = quantile_bin_edges(X, n_bins)
+        sk = StreamingQuantileSketch(X.shape[1], n_bins)
+        for blk in np.array_split(X, 5):
+            part = StreamingQuantileSketch(X.shape[1], n_bins)
+            part.update(blk)
+            sk.merge(part)
+        approx = sk.edges()
+        # few-distinct-value columns are never compressed -> exact
+        for f in (2, 3):
+            np.testing.assert_array_equal(approx[f], exact[f])
+
+    def test_dataset_sketch_entry_point(self):
+        X = self._columns(n=3000, seed=3)
+        ds = ChunkedDataset.from_arrays(X, None, block_rows=700)
+        edges = ds.sketch_bin_edges(n_bins=8)
+        assert edges.shape == (X.shape[1], 7)
+        assert edges.dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# resident-vs-streamed tree parity (shared grower code)
+# ---------------------------------------------------------------------------
+
+class TestStreamedResidentParity:
+    @pytest.mark.parametrize(
+        "cls,n,d,K,weighted,block_rows,seed",
+        [
+            (DistHistGradientBoostingClassifier, 500, 6, 2, False, 120, 1),
+            (DistHistGradientBoostingClassifier, 500, 6, 2, True, 120, 2),
+            # multiclass compiles fresh program families — slow tier;
+            # the smoke's holdout gate exercises them end to end
+            pytest.param(DistHistGradientBoostingClassifier,
+                         600, 5, 3, False, 128, 3,
+                         marks=pytest.mark.slow),
+            pytest.param(DistHistGradientBoostingClassifier,
+                         640, 4, 4, True, 100, 4,
+                         marks=pytest.mark.slow),
+            pytest.param(DistHistGradientBoostingRegressor,
+                         500, 6, 1, False, 120, 5,
+                         marks=pytest.mark.slow),
+            # 513 % 64 != 0: the ragged last block pads and masks
+            (DistHistGradientBoostingRegressor, 513, 6, 1, True, 64, 6),
+        ],
+    )
+    def test_trees_match_resident_kernel(self, cls, n, d, K, weighted,
+                                         block_rows, seed):
+        X, y, sw = _make(cls, n, d, K, weighted, seed)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=block_rows)
+        st = cls(**KW).fit(ds, sample_weight=sw)
+        pr = _resident_ref(cls(**KW), X, y, sw, st._meta["edges"])
+        for k in ("feat", "thr", "is_split"):
+            np.testing.assert_array_equal(
+                np.asarray(pr[k]), np.asarray(st._params[k]),
+                err_msg=f"heap leaf {k} diverged from the resident grower",
+            )
+        np.testing.assert_allclose(
+            np.asarray(st._params["leaf"], np.float64),
+            np.asarray(pr["leaf"], np.float64), atol=5e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(st._params["baseline"], np.float64),
+            np.asarray(pr["baseline"], np.float64), atol=5e-6,
+        )
+        assert int(st._params["n_iter"]) == int(pr["n_iter"])
+
+    @pytest.mark.slow
+    def test_decision_parity_survives_f32_gain_ties(self):
+        # deeper tree + more features: f32 block-sum order can flip an
+        # exact gain tie to a different (feat, thr) — the decision
+        # surface must still agree to float tolerance
+        cls = DistHistGradientBoostingClassifier
+        X, y, sw = _make(cls, 800, 8, 2, True, 8)
+        kw = dict(KW, max_iter=5, max_depth=5, max_bins=32,
+                  min_samples_leaf=3)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=256)
+        st = cls(**kw).fit(ds, sample_weight=sw)
+        pr = _resident_ref(cls(**kw), X, y, sw, st._meta["edges"])
+        ref = cls(**kw)
+        ref._params = pr
+        ref._meta = dict(st._meta)
+        ref.n_features_in_ = X.shape[1]
+        ref.classes_ = st.classes_
+        np.testing.assert_allclose(
+            ref.decision_function(X), st.decision_function(X), atol=1e-5,
+        )
+
+    def test_early_stopping_fires_at_same_round(self):
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(400, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        kw = dict(max_iter=60, max_depth=2, max_bins=16,
+                  min_samples_leaf=5, early_stopping=True,
+                  validation_fraction=None, n_iter_no_change=2,
+                  tol=1e-2, learning_rate=0.5)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=90)
+        st = DistHistGradientBoostingRegressor(**kw).fit(ds)
+        pr = _resident_ref(DistHistGradientBoostingRegressor(**kw),
+                           X, y, None, st._meta["edges"])
+        assert st.n_iter_ == int(pr["n_iter"]) < 60
+
+    def test_predict_roundtrip_and_accuracy(self):
+        cls = DistHistGradientBoostingClassifier
+        X, y, _ = _make(cls, 500, 6, 2, False, 11)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        st = cls(**KW).fit(ds)
+        res = cls(**KW).fit(X, y)
+        acc_s = (st.predict(X) == y).mean()
+        acc_r = (res.predict(X) == y).mean()
+        assert abs(acc_s - acc_r) <= 0.02
+        assert st.n_features_in_ == X.shape[1]
+        assert list(st.classes_) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# cache plumbing, accounting, config gates, faults
+# ---------------------------------------------------------------------------
+
+class TestStreamedGBDTPlumbing:
+    def _ds(self, n=500, d=6, block_rows=120, seed=0):
+        cls = DistHistGradientBoostingClassifier
+        X, y, _ = _make(cls, n, d, 2, False, seed)
+        return ChunkedDataset.from_arrays(X, y, block_rows=block_rows)
+
+    def test_raw_stream_read_exactly_twice_then_cache_hit(self):
+        ds = self._ds()
+        inv0 = ds.reader_invocations
+        DistHistGradientBoostingClassifier(**KW).fit(ds)
+        cold = ds.reader_invocations - inv0
+        # 2 seekability probes + 2 digest blocks + sketch pass + bin
+        # pass; boosting rounds add ZERO raw reads
+        assert cold <= 2 * ds.n_blocks + 4
+        inv1 = ds.reader_invocations
+        DistHistGradientBoostingClassifier(**KW).fit(ds)
+        # warm fit: only the seekability probe touches the raw stream
+        assert ds.reader_invocations - inv1 <= 2
+
+    def test_binned_byte_accounting_matches_pass_structure(self):
+        from skdist_tpu.models.streaming import stream_fit_estimator
+
+        ds = self._ds()
+        bk = TPUBackend()
+        est = DistHistGradientBoostingClassifier(**KW)
+        stream_fit_estimator(est, ds, backend=bk)
+        st = bk.last_round_stats
+        nbytes = ds.n_rows * ds.n_features
+        assert st["binned_bytes_cached"] == nbytes
+        # baseline pass + per round (max_depth hist passes + 1 update)
+        expect = nbytes * (1 + KW["max_iter"] * (KW["max_depth"] + 1))
+        assert st["binned_bytes_streamed"] == expect
+        bk2 = TPUBackend()
+        est2 = DistHistGradientBoostingClassifier(**KW)
+        stream_fit_estimator(est2, ds, backend=bk2)
+        assert bk2.last_round_stats["binned_bytes_cached"] == 0  # hit
+
+    def test_validation_fraction_over_stream_names_supported(self):
+        ds = self._ds(n=600)
+        est = DistHistGradientBoostingClassifier(
+            max_iter=4, early_stopping=True, validation_fraction=0.1,
+        )
+        with pytest.raises(ValueError,
+                           match=r"validation_fraction=None"):
+            est.fit(ds)
+        with pytest.raises(ValueError, match=r"early_stopping=False"):
+            est.fit(ds)
+
+    def test_packed_dataset_raises_typed(self):
+        pytest.importorskip("scipy")
+        from scipy import sparse as sp
+
+        rng = np.random.default_rng(0)
+        X = sp.random(300, 8, density=0.1, format="csr",
+                      random_state=0, dtype=np.float32)
+        y = rng.integers(0, 2, size=300)
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=100, pack=True)
+        with pytest.raises(TypeError, match="packed"):
+            DistHistGradientBoostingClassifier(**KW).fit(ds)
+
+    def test_y_required_when_dataset_carries_none(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        ds = ChunkedDataset.from_arrays(X, None, block_rows=100)
+        with pytest.raises(ValueError, match="needs labels"):
+            DistHistGradientBoostingClassifier(**KW).fit(ds)
+
+    def test_faults_replay_to_identical_ensemble(self):
+        from skdist_tpu.testing.faultinject import FaultInjector
+
+        cls = DistHistGradientBoostingClassifier
+        X, y, _ = _make(cls, 500, 6, 2, False, 0)
+        ref = cls(**KW).fit(
+            ChunkedDataset.from_arrays(X, y, block_rows=120))
+        ds = ChunkedDataset.from_arrays(X, y, block_rows=120)
+        inj = (FaultInjector()
+               .at_round(7, kind="transient")
+               .at_round(23, kind="preempt"))
+        with inj:
+            got = cls(**KW).fit(ds)
+        assert [k for _, k in inj.fired] == ["transient", "preempt"]
+        for k in ("feat", "thr", "is_split"):
+            np.testing.assert_array_equal(
+                np.asarray(ref._params[k]), np.asarray(got._params[k]))
+        np.testing.assert_allclose(
+            np.asarray(ref._params["leaf"], np.float64),
+            np.asarray(got._params["leaf"], np.float64), atol=1e-6)
+
+    @pytest.mark.slow
+    def test_streamed_fit_on_2d_mesh_matches_1d(self):
+        from skdist_tpu.models.streaming import stream_fit_estimator
+
+        ds = self._ds(seed=5)
+        est1 = DistHistGradientBoostingClassifier(**KW)
+        stream_fit_estimator(est1, ds, backend=TPUBackend())
+        est2 = DistHistGradientBoostingClassifier(**KW)
+        stream_fit_estimator(
+            est2, ds, backend=TPUBackend(data_axis_size=2))
+        for k in ("feat", "thr", "is_split"):
+            np.testing.assert_array_equal(
+                np.asarray(est1._params[k]), np.asarray(est2._params[k]))
+        np.testing.assert_allclose(
+            np.asarray(est1._params["leaf"], np.float64),
+            np.asarray(est2._params["leaf"], np.float64), atol=1e-6)
